@@ -1,0 +1,18 @@
+"""shadow-tpu: a TPU-native parallel discrete-event network simulator.
+
+Capabilities of Shadow 1.14.0 (RWails/shadow), re-architected for JAX/XLA:
+the per-round packet-propagation hot path (path latency, reliability draws,
+bandwidth shaping, queue drains) runs as one batched device kernel, while the
+CPU side keeps the deterministic event-order contract and runs protocol state
+machines and virtual processes.
+
+Three planes (see SURVEY.md §7):
+  * control plane  — shadow_tpu.core      (config, hosts, rounds, policies)
+  * data plane     — shadow_tpu.ops       (device-resident topology + packet
+                      batches, jit/vmap round step, pjit sharding)
+  * process plane  — shadow_tpu.process   (virtual processes / apps)
+"""
+
+__version__ = "0.1.0"
+
+from .core import stime  # noqa: F401

@@ -1,0 +1,110 @@
+"""Simulator options — the CLI flag surface.
+
+Mirrors the reference's Options (core/support/options.c): every knob the
+reference exposes has an equivalent here, plus the new ``tpu`` scheduler
+policy and device options.  Parsed with argparse; also constructible directly
+for tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import List, Optional
+
+SCHEDULER_POLICIES = ("global", "host", "steal", "thread", "threadXthread",
+                      "threadXhost", "tpu")
+QDISC_KINDS = ("fifo", "rr")
+ROUTER_QUEUE_KINDS = ("codel", "single", "static")
+TCP_CC_KINDS = ("reno", "aimd", "cubic")
+
+
+@dataclasses.dataclass
+class Options:
+    # Core (reference options.c flags)
+    workers: int = 0                     # --workers (0 = serial, nWorkers=0 mode)
+    scheduler_policy: str = "steal"      # --scheduler-policy (default steal, options.c:199)
+    seed: int = 1                        # --seed
+    runahead_ms: int = 0                 # --runahead (0 = derive from topology; floor 10ms)
+    bootstrap_end_sec: int = 0           # <shadow bootstraptime>: grace period, no drops
+    stop_time_sec: int = 60              # <shadow stoptime>
+    # TCP
+    tcp_congestion_control: str = "reno"  # --tcp-congestion-control
+    tcp_ssthresh: int = 0                 # --tcp-ssthresh (0 = unset)
+    tcp_windows: int = 1                  # --tcp-windows
+    # Interface / buffers
+    interface_qdisc: str = "fifo"        # --interface-qdisc
+    interface_buffer: int = 1024000      # --interface-buffer (bytes)
+    interface_batch_ms: int = 1          # --interface-batch (token refill interval)
+    router_queue: str = "codel"          # upstream AQM kind (reference host.c:205 default codel)
+    socket_recv_buffer: int = 174760     # --socket-recv-buffer (0 = autotune)
+    socket_send_buffer: int = 131072     # --socket-send-buffer (0 = autotune)
+    socket_autotune: bool = True
+    # CPU model
+    cpu_threshold_ns: int = -1           # --cpu-threshold (ns of delay before block; <=0 disables)
+    cpu_precision_ns: int = 200          # --cpu-precision
+    # Telemetry
+    heartbeat_interval_sec: int = 60     # --heartbeat-frequency
+    heartbeat_log_level: str = "message"
+    log_level: str = "message"           # --log-level
+    pcap_dir: Optional[str] = None
+    data_directory: str = "shadow.data"
+    data_template: Optional[str] = None
+    # TPU backend
+    tpu_max_inflight: int = 1 << 16      # padded packet-batch capacity
+    tpu_devices: int = 0                 # 0 = all local devices
+    # Misc
+    config_path: Optional[str] = None
+    test_mode: bool = False              # --test builtin example
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shadow-tpu",
+        description="TPU-native discrete-event network simulator "
+                    "(capabilities of Shadow 1.14.0, re-architected for JAX/XLA).")
+    p.add_argument("config_path", nargs="?", help="simulation config (.xml, .yaml, .json)")
+    p.add_argument("--workers", type=int, default=0)
+    p.add_argument("--scheduler-policy", choices=SCHEDULER_POLICIES, default="steal",
+                   dest="scheduler_policy")
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--runahead", type=int, default=0, dest="runahead_ms",
+                   help="minimum allowed lookahead window (ms)")
+    p.add_argument("--stop-time", type=int, default=None, dest="stop_time_sec")
+    p.add_argument("--bootstrap-end", type=int, default=None, dest="bootstrap_end_sec")
+    p.add_argument("--tcp-congestion-control", choices=TCP_CC_KINDS, default="reno",
+                   dest="tcp_congestion_control")
+    p.add_argument("--tcp-ssthresh", type=int, default=0, dest="tcp_ssthresh")
+    p.add_argument("--tcp-windows", type=int, default=1, dest="tcp_windows")
+    p.add_argument("--interface-qdisc", choices=QDISC_KINDS, default="fifo",
+                   dest="interface_qdisc")
+    p.add_argument("--interface-buffer", type=int, default=1024000, dest="interface_buffer")
+    p.add_argument("--interface-batch", type=int, default=1, dest="interface_batch_ms")
+    p.add_argument("--router-queue", choices=ROUTER_QUEUE_KINDS, default="codel",
+                   dest="router_queue")
+    p.add_argument("--socket-recv-buffer", type=int, default=174760, dest="socket_recv_buffer")
+    p.add_argument("--socket-send-buffer", type=int, default=131072, dest="socket_send_buffer")
+    p.add_argument("--cpu-threshold", type=int, default=-1, dest="cpu_threshold_ns")
+    p.add_argument("--cpu-precision", type=int, default=200, dest="cpu_precision_ns")
+    p.add_argument("--heartbeat-frequency", type=int, default=60, dest="heartbeat_interval_sec")
+    p.add_argument("--log-level", choices=("error", "critical", "warning", "message",
+                                           "info", "debug", "trace"), default="message",
+                   dest="log_level")
+    p.add_argument("--pcap-dir", default=None, dest="pcap_dir")
+    p.add_argument("--data-directory", default="shadow.data", dest="data_directory")
+    p.add_argument("--data-template", default=None, dest="data_template")
+    p.add_argument("--tpu-max-inflight", type=int, default=1 << 16, dest="tpu_max_inflight")
+    p.add_argument("--tpu-devices", type=int, default=0, dest="tpu_devices")
+    p.add_argument("--test", action="store_true", dest="test_mode",
+                   help="run the built-in example simulation")
+    return p
+
+
+def parse_args(argv: Optional[List[str]] = None) -> Options:
+    ns = build_parser().parse_args(argv)
+    opts = Options()
+    for f in dataclasses.fields(Options):
+        v = getattr(ns, f.name, None)
+        if v is not None:
+            setattr(opts, f.name, v)
+    return opts

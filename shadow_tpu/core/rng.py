@@ -1,0 +1,202 @@
+"""Deterministic, order-independent random numbers for the simulator.
+
+The reference derives all randomness from a seed hierarchy of ``rand_r``
+streams (utility/random.c:32, master.c:417 master->slave->per-host seeding).
+That design couples random draws to *execution order*, which would make the
+CPU scheduler policies and the batched TPU kernel diverge the moment events
+are reordered within a round.
+
+We instead use a **counter-based** generator — Threefry-2x32 (Salmon et al.,
+"Parallel Random Numbers: As Easy as 1, 2, 3", SC'11), the same block cipher
+JAX's PRNG is built on — keyed by a (stream, substream) pair and indexed by a
+64-bit counter.  A draw is a pure function ``threefry(key, counter)``:
+
+* the CPU event loop evaluates it with numpy (vectorized or scalar), and
+* the TPU round kernel evaluates the *identical* function with jax.numpy,
+
+so reliability drops, jitter draws, etc. are bitwise identical no matter which
+backend executes the packet hop or in what order packets are processed.
+
+The seed hierarchy of the reference is preserved in spirit: a root seed
+expands into named child streams via the same cipher (see :func:`derive`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+_MASK32 = np.uint32(0xFFFFFFFF)
+# Threefry-2x32 rotation constants (Salmon et al., Table 2).
+_ROTATIONS = (13, 15, 26, 6, 17, 29, 16, 24)
+_PARITY = 0x1BD11BDA  # SKEIN_KS_PARITY32
+
+
+def _rotl32_np(x: np.ndarray, d: int) -> np.ndarray:
+    return ((x << np.uint32(d)) | (x >> np.uint32(32 - d))) & _MASK32
+
+
+def threefry2x32_np(k0, k1, c0, c1) -> Tuple[np.ndarray, np.ndarray]:
+    """Threefry-2x32, 20 rounds, numpy (scalars or arrays of uint32)."""
+    with np.errstate(over="ignore"):
+        k0 = np.asarray(k0, dtype=np.uint32)
+        k1 = np.asarray(k1, dtype=np.uint32)
+        x0 = np.asarray(c0, dtype=np.uint32).copy()
+        x1 = np.asarray(c1, dtype=np.uint32).copy()
+        ks = (k0, k1, np.uint32(_PARITY) ^ k0 ^ k1)
+        x0 = (x0 + ks[0]).astype(np.uint32)
+        x1 = (x1 + ks[1]).astype(np.uint32)
+        for block in range(5):  # 5 blocks of 4 rounds = 20 rounds
+            rots = _ROTATIONS[0:4] if block % 2 == 0 else _ROTATIONS[4:8]
+            for r in rots:
+                x0 = (x0 + x1).astype(np.uint32)
+                x1 = _rotl32_np(x1, r)
+                x1 = x1 ^ x0
+            x0 = (x0 + ks[(block + 1) % 3]).astype(np.uint32)
+            x1 = (x1 + ks[(block + 2) % 3] + np.uint32(block + 1)).astype(np.uint32)
+    return x0, x1
+
+
+def threefry2x32_jnp(k0, k1, c0, c1):
+    """Threefry-2x32, 20 rounds, jax.numpy — bitwise identical to the numpy
+    version above (asserted by tests/test_rng.py)."""
+    import jax.numpy as jnp
+
+    k0 = jnp.asarray(k0, dtype=jnp.uint32)
+    k1 = jnp.asarray(k1, dtype=jnp.uint32)
+    x0 = jnp.asarray(c0, dtype=jnp.uint32)
+    x1 = jnp.asarray(c1, dtype=jnp.uint32)
+    ks = (k0, k1, jnp.uint32(_PARITY) ^ k0 ^ k1)
+    x0 = x0 + ks[0]
+    x1 = x1 + ks[1]
+    for block in range(5):
+        rots = _ROTATIONS[0:4] if block % 2 == 0 else _ROTATIONS[4:8]
+        for r in rots:
+            x0 = x0 + x1
+            x1 = (x1 << r) | (x1 >> (32 - r))
+            x1 = x1 ^ x0
+        x0 = x0 + ks[(block + 1) % 3]
+        x1 = x1 + ks[(block + 2) % 3] + jnp.uint32(block + 1)
+    return x0, x1
+
+
+def _split64(v) -> Tuple[np.ndarray, np.ndarray]:
+    v = np.asarray(v, dtype=np.uint64)
+    return (v & np.uint64(0xFFFFFFFF)).astype(np.uint32), (v >> np.uint64(32)).astype(np.uint32)
+
+
+def uniform_np(key: int, counter) -> np.ndarray:
+    """Uniform float64 in [0, 1) from a 64-bit key and 64-bit counter(s).
+
+    Uses the high lane's top 24 bits so the same construction is cheap and
+    exact in float32 on device (see :func:`uniform_jnp`).
+    """
+    k0, k1 = _split64(np.uint64(key & 0xFFFFFFFFFFFFFFFF))
+    c0, c1 = _split64(counter)
+    x0, _x1 = threefry2x32_np(k0, k1, c0, c1)
+    return (x0 >> np.uint32(8)).astype(np.float64) * (1.0 / (1 << 24))
+
+
+def uniform_jnp_pair(key: int, c_lo, c_hi):
+    """Device-side twin of :func:`uniform_np` with the 64-bit counter passed
+    as two uint32 lanes (works with or without jax x64 mode).
+
+    float32 with the same 24-bit mantissa construction — bitwise-equal
+    decisions for any threshold expressible in float32, which all
+    reliability values are.
+    """
+    import jax.numpy as jnp
+
+    kv = int(key) & 0xFFFFFFFFFFFFFFFF
+    k0 = jnp.uint32(kv & 0xFFFFFFFF)
+    k1 = jnp.uint32((kv >> 32) & 0xFFFFFFFF)
+    c0 = jnp.asarray(c_lo, dtype=jnp.uint32)
+    c1 = jnp.asarray(c_hi, dtype=jnp.uint32)
+    x0, _x1 = threefry2x32_jnp(k0, k1, c0, c1)
+    return (x0 >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def uniform_jnp(key, counter):
+    """Device-side uniform taking integer counters (any integer dtype whose
+    values fit 63 bits; splitting into 32-bit lanes is done here)."""
+    import jax.numpy as jnp
+
+    counter = jnp.asarray(counter)
+    c = counter.astype(jnp.int64) if counter.dtype.itemsize == 8 else counter.astype(jnp.uint32)
+    if c.dtype.itemsize == 8:
+        c_lo = (c & 0xFFFFFFFF).astype(jnp.uint32)
+        c_hi = (c >> 32).astype(jnp.uint32)
+    else:
+        c_lo = c
+        c_hi = jnp.zeros_like(c)
+    return uniform_jnp_pair(key, c_lo, c_hi)
+
+
+def bits64_np(key: int, counter) -> np.ndarray:
+    """64 random bits as uint64 from key + counter."""
+    k0, k1 = _split64(np.uint64(key & 0xFFFFFFFFFFFFFFFF))
+    c0, c1 = _split64(counter)
+    x0, x1 = threefry2x32_np(k0, k1, c0, c1)
+    return x0.astype(np.uint64) | (x1.astype(np.uint64) << np.uint64(32))
+
+
+def derive(key: int, *path: Any) -> int:
+    """Derive a child 64-bit key from a parent key and a path of labels.
+
+    Replaces the reference's seed hierarchy (master.c:417: master seeds slave,
+    slave seeds scheduler and each host).  Labels may be ints or strings;
+    strings are hashed with the cipher itself so derivation is stable across
+    runs and platforms (no Python hash randomization).
+    """
+    k = np.uint64(key & 0xFFFFFFFFFFFFFFFF)
+    for label in path:
+        if isinstance(label, str):
+            acc = np.uint64(1469598103934665603)  # FNV-1a offset basis
+            for b in label.encode("utf-8"):
+                acc = np.uint64((int(acc) ^ b) * 1099511628211 & 0xFFFFFFFFFFFFFFFF)
+            label_int = int(acc)
+        else:
+            label_int = int(label) & 0xFFFFFFFFFFFFFFFF
+        k = bits64_np(int(k), np.uint64(label_int))
+    return int(k)
+
+
+class RandomSource:
+    """A sequential deterministic stream, for host-side draws that have a
+    natural per-object ordering (e.g. a host's ephemeral-port allocator).
+
+    Mirrors the role of the reference's ``Random`` (utility/random.c) but is
+    built on the counter cipher, so streams never collide and reseeding is
+    never needed.
+    """
+
+    __slots__ = ("key", "counter")
+
+    def __init__(self, key: int):
+        self.key = int(key) & 0xFFFFFFFFFFFFFFFF
+        self.counter = 0
+
+    def next_u64(self) -> int:
+        v = int(bits64_np(self.key, np.uint64(self.counter)))
+        self.counter += 1
+        return v
+
+    def next_double(self) -> float:
+        v = float(uniform_np(self.key, np.uint64(self.counter)))
+        self.counter += 1
+        return v
+
+    def next_int(self, bound: int) -> int:
+        """Uniform int in [0, bound)."""
+        assert bound > 0
+        return self.next_u64() % bound
+
+    def next_bytes(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            out += int(self.next_u64()).to_bytes(8, "little")
+        return bytes(out[:n])
+
+    def spawn(self, *path: Any) -> "RandomSource":
+        return RandomSource(derive(self.key, *path))

@@ -1,0 +1,72 @@
+"""DNS: the global name <-> IP registry.
+
+Capability of the reference's DNS (routing/dns.c): assigns unique IPs from a
+counter while skipping restricted CIDR ranges (dns.c:30-66), registers
+(name, ip) pairs, resolves both directions; backs getaddrinfo emulation.
+Assignment order is deterministic (registration order), which matters for the
+determinism gate.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .address import Address, ip_to_int, int_to_ip
+
+
+def _in_range(ip: int, base: str, prefix: int) -> bool:
+    b = ip_to_int(base)
+    mask = ((1 << prefix) - 1) << (32 - prefix)
+    return (ip & mask) == (b & mask)
+
+
+def _is_restricted(ip: int) -> bool:
+    # Same ranges the reference refuses to hand out (dns.c:30-66):
+    # loopback, link-local, multicast/reserved, zero-net, broadcast.
+    return (
+        _in_range(ip, "127.0.0.0", 8)
+        or _in_range(ip, "0.0.0.0", 8)
+        or _in_range(ip, "169.254.0.0", 16)
+        or _in_range(ip, "224.0.0.0", 4)
+        or _in_range(ip, "240.0.0.0", 4)
+        or ip == ip_to_int("255.255.255.255")
+    )
+
+
+class DNS:
+    def __init__(self):
+        self._ip_counter = ip_to_int("11.0.0.1")
+        self._by_name: Dict[str, Address] = {}
+        self._by_ip: Dict[int, Address] = {}
+
+    def unique_ip(self) -> int:
+        ip = self._ip_counter
+        while _is_restricted(ip) or ip in self._by_ip:
+            ip += 1
+        self._ip_counter = ip + 1
+        return ip
+
+    def register(self, host_id: int, name: str, requested_ip: Optional[int] = None,
+                 mac: int = 0) -> Address:
+        if requested_ip is not None and not _is_restricted(requested_ip) \
+                and requested_ip not in self._by_ip:
+            ip = requested_ip
+        else:
+            ip = self.unique_ip()
+        addr = Address(host_id, ip, name, mac=mac)
+        self._by_name[name] = addr
+        self._by_ip[ip] = addr
+        return addr
+
+    def deregister(self, addr: Address) -> None:
+        self._by_name.pop(addr.name, None)
+        self._by_ip.pop(addr.ip, None)
+
+    def resolve_name(self, name: str) -> Optional[Address]:
+        return self._by_name.get(name)
+
+    def resolve_ip(self, ip: int) -> Optional[Address]:
+        return self._by_ip.get(ip)
+
+    def __len__(self) -> int:
+        return len(self._by_ip)

@@ -1,0 +1,103 @@
+"""Priority queues for events.
+
+The reference wraps GLib heaps with a membership hash for O(1) find/remove
+(utility/priority_queue.c) and a mutexed variant
+(utility/async_priority_queue.c).  We build on ``heapq`` with lazy deletion —
+removal marks the entry dead; dead entries are skipped on pop.
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+from typing import Any, Generic, List, Optional, Tuple, TypeVar
+
+T = TypeVar("T")
+
+
+class PriorityQueue(Generic[T]):
+    """Min-heap keyed by item.order_key() (or the item itself), with lazy
+    removal."""
+
+    __slots__ = ("_heap", "_entries", "_count")
+
+    def __init__(self):
+        self._heap: List[Tuple[Any, int, list]] = []
+        self._entries = {}  # id(item) -> entry
+        self._count = 0     # insertion tiebreak for identical keys
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def push(self, item: T, key=None) -> None:
+        if key is None:
+            key = item.order_key()
+        entry = [key, self._count, item, True]
+        self._count += 1
+        self._entries[id(item)] = entry
+        heapq.heappush(self._heap, entry)
+
+    def remove(self, item: T) -> bool:
+        entry = self._entries.pop(id(item), None)
+        if entry is None:
+            return False
+        entry[3] = False
+        entry[2] = None
+        return True
+
+    def __contains__(self, item: T) -> bool:
+        return id(item) in self._entries
+
+    def _prune(self) -> None:
+        while self._heap and not self._heap[0][3]:
+            heapq.heappop(self._heap)
+
+    def peek(self) -> Optional[T]:
+        self._prune()
+        return self._heap[0][2] if self._heap else None
+
+    def peek_key(self):
+        self._prune()
+        return self._heap[0][0] if self._heap else None
+
+    def pop(self) -> Optional[T]:
+        self._prune()
+        if not self._heap:
+            return None
+        entry = heapq.heappop(self._heap)
+        del self._entries[id(entry[2])]
+        return entry[2]
+
+
+class AsyncPriorityQueue(PriorityQueue[T]):
+    """Mutex-protected variant (reference utility/async_priority_queue.c)."""
+
+    __slots__ = ("_lock",)
+
+    def __init__(self):
+        super().__init__()
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return super().__len__()
+
+    def push(self, item: T, key=None) -> None:
+        with self._lock:
+            super().push(item, key)
+
+    def remove(self, item: T) -> bool:
+        with self._lock:
+            return super().remove(item)
+
+    def peek(self) -> Optional[T]:
+        with self._lock:
+            return super().peek()
+
+    def peek_key(self):
+        with self._lock:
+            return super().peek_key()
+
+    def pop(self) -> Optional[T]:
+        with self._lock:
+            return super().pop()

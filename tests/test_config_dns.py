@@ -1,0 +1,77 @@
+"""Configuration (legacy XML + YAML) and DNS registry tests."""
+
+import textwrap
+
+from shadow_tpu.core import configuration
+from shadow_tpu.routing.dns import DNS
+from shadow_tpu.routing.address import ip_to_int, int_to_ip
+
+LEGACY_XML = textwrap.dedent("""\
+    <shadow stoptime="3600" bootstraptime="300" environment="A=1;B=2">
+      <topology path="topology.graphml.xml" />
+      <plugin id="tgen" path="python:tgen" />
+      <host id="server" quantity="1" bandwidthdown="102400" bandwidthup="102400"
+            iphint="100.0.0.1" logpcap="true" cpufrequency="2500000">
+        <process plugin="tgen" starttime="1" arguments="server 80" />
+      </host>
+      <host id="client" quantity="10" typehint="client">
+        <process plugin="tgen" starttime="2" stoptime="600" arguments="client server 80" />
+      </host>
+    </shadow>
+""")
+
+
+def test_parse_legacy_xml():
+    cfg = configuration.parse_xml(LEGACY_XML)
+    assert cfg.stop_time_sec == 3600
+    assert cfg.bootstrap_end_sec == 300
+    assert cfg.environment == {"A": "1", "B": "2"}
+    assert cfg.topology_path == "topology.graphml.xml"
+    assert len(cfg.programs) == 1 and cfg.programs[0].path == "python:tgen"
+    assert len(cfg.hosts) == 2
+    server = cfg.hosts[0]
+    assert server.id == "server" and server.bandwidth_down_kibps == 102400
+    assert server.ip_hint == "100.0.0.1" and server.log_pcap
+    assert server.cpu_frequency_khz == 2500000
+    assert server.processes[0].arguments == "server 80"
+    client = cfg.hosts[1]
+    assert client.quantity == 10 and client.type_hint == "client"
+    assert client.processes[0].stop_time_sec == 600
+    assert cfg.total_process_count() == 11
+
+
+def test_parse_yaml_dict():
+    d = {
+        "general": {"stop_time": 100},
+        "network": {"graph": {"path": "g.graphml"}},
+        "hosts": {
+            "h1": {"processes": [{"path": "python:echo", "args": ["udp", "server"],
+                                  "start_time": 1}]},
+        },
+    }
+    cfg = configuration.parse_dict(d)
+    assert cfg.stop_time_sec == 100
+    assert cfg.topology_path == "g.graphml"
+    assert cfg.hosts[0].processes[0].arguments == "udp server"
+
+
+def test_dns_assignment_deterministic_and_restricted():
+    d1, d2 = DNS(), DNS()
+    a1 = [d1.register(i, f"h{i}") for i in range(50)]
+    a2 = [d2.register(i, f"h{i}") for i in range(50)]
+    assert [a.ip for a in a1] == [a.ip for a in a2]
+    assert len({a.ip for a in a1}) == 50
+    for a in a1:
+        assert not int_to_ip(a.ip).startswith("127.")
+    assert d1.resolve_name("h7").ip == a1[7].ip
+    assert d1.resolve_ip(a1[7].ip).name == "h7"
+
+
+def test_dns_requested_ip():
+    d = DNS()
+    want = ip_to_int("100.1.2.3")
+    a = d.register(0, "pinned", requested_ip=want)
+    assert a.ip == want
+    # duplicate request falls back to auto-assignment
+    b = d.register(1, "other", requested_ip=want)
+    assert b.ip != want

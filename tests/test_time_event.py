@@ -1,0 +1,60 @@
+"""Sim-time and event total-order tests (reference event.c:110-153 contract)."""
+
+import itertools
+
+from shadow_tpu.core import stime
+from shadow_tpu.core.event import Event
+from shadow_tpu.core.task import Task
+from shadow_tpu.utils.pqueue import PriorityQueue
+
+
+class FakeHost:
+    def __init__(self, hid):
+        self.id = hid
+        self.cpu = None
+
+
+def _noop(obj, arg):
+    pass
+
+
+def mk(t, dst, src, seq):
+    return Event(Task(_noop), t, FakeHost(dst), FakeHost(src), seq)
+
+
+def test_time_conversions():
+    assert stime.from_seconds(1.5) == 1_500_000_000
+    assert stime.from_millis(10) == 10_000_000
+    assert stime.to_seconds(2_000_000_000) == 2.0
+    assert stime.emulated_from_sim(0) == 946_684_800 * stime.SIM_TIME_SEC
+    assert stime.sim_from_emulated(stime.emulated_from_sim(123)) == 123
+
+
+def test_event_total_order():
+    # (time, dst, src, seq) lexicographic — every permutation sorts the same.
+    events = [mk(2, 0, 0, 0), mk(1, 1, 0, 0), mk(1, 0, 1, 0), mk(1, 0, 0, 1),
+              mk(1, 0, 0, 0), mk(3, 5, 5, 5)]
+    expected = sorted(events, key=lambda e: e.order_key())
+    for perm in itertools.permutations(events):
+        assert sorted(perm, key=lambda e: e.order_key()) == expected
+
+
+def test_pqueue_orders_events():
+    q = PriorityQueue()
+    evs = [mk(5, 1, 1, 0), mk(1, 0, 0, 0), mk(5, 0, 0, 0), mk(3, 2, 2, 2)]
+    for e in evs:
+        q.push(e)
+    popped = [q.pop() for _ in range(len(evs))]
+    assert popped == sorted(evs, key=lambda e: e.order_key())
+    assert q.pop() is None
+
+
+def test_pqueue_remove():
+    q = PriorityQueue()
+    a, b = mk(1, 0, 0, 0), mk(2, 0, 0, 0)
+    q.push(a); q.push(b)
+    assert a in q
+    assert q.remove(a)
+    assert not q.remove(a)
+    assert q.pop() is b
+    assert len(q) == 0
