@@ -118,18 +118,27 @@ def uniform_jnp_pair(key: int, c_lo, c_hi):
 
 
 def uniform_jnp(key, counter):
-    """Device-side uniform taking integer counters (any integer dtype whose
-    values fit 63 bits; splitting into 32-bit lanes is done here)."""
+    """Device-side uniform taking 64-bit integer counters.
+
+    Host-side (numpy/int) inputs are split into 32-bit lanes with numpy so
+    they are always exact.  Device arrays must already be an 8-byte integer
+    dtype (x64 mode, see shadow_tpu.ops) — a 4-byte device array is rejected
+    rather than silently dropping the counter's high bits, which would make
+    CPU and TPU drop decisions diverge for packet uids >= 2**32.
+    """
+    import jax
     import jax.numpy as jnp
 
+    if isinstance(counter, (int, np.integer, np.ndarray, list, tuple)):
+        c_lo, c_hi = _split64(np.asarray(counter, dtype=np.uint64))
+        return uniform_jnp_pair(key, c_lo, c_hi)
     counter = jnp.asarray(counter)
-    c = counter.astype(jnp.int64) if counter.dtype.itemsize == 8 else counter.astype(jnp.uint32)
-    if c.dtype.itemsize == 8:
-        c_lo = (c & 0xFFFFFFFF).astype(jnp.uint32)
-        c_hi = (c >> 32).astype(jnp.uint32)
-    else:
-        c_lo = c
-        c_hi = jnp.zeros_like(c)
+    if counter.dtype.itemsize != 8:
+        raise ValueError(
+            f"uniform_jnp needs a 64-bit counter dtype, got {counter.dtype}; "
+            "import shadow_tpu.ops to enable x64 or pass lanes to uniform_jnp_pair")
+    c_lo = (counter & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+    c_hi = (counter >> jnp.uint64(32)).astype(jnp.uint32)
     return uniform_jnp_pair(key, c_lo, c_hi)
 
 

@@ -112,7 +112,6 @@ class Worker:
                 self.engine.count_packet_drop(packet)
                 return
         latency = topo.latency_ns_ip(src_ip, dst_ip)
-        deliver_time = self.now + latency
         packet.add_status("INET_SENT")
         dst_host = self.engine.host_by_ip(dst_ip)
         if dst_host is None:
@@ -132,9 +131,12 @@ class Worker:
                 if ev is None:
                     break
                 self.now = ev.time
-                ev.execute(self)
-                self.last_event_time = ev.time
-                self.counters.count_free("event")
+                if ev.execute(self):
+                    self.last_event_time = ev.time
+                    self.counters.count_free("event")
+                # else: CPU model deferred it — the same Event object was
+                # re-pushed with a later time and will be accounted when it
+                # actually runs.
         finally:
             self.engine.merge_counters(self.counters)
             set_current_worker(None)

@@ -48,6 +48,8 @@ class DNS:
 
     def register(self, host_id: int, name: str, requested_ip: Optional[int] = None,
                  mac: int = 0) -> Address:
+        if name in self._by_name:
+            raise ValueError(f"hostname {name!r} is already registered")
         if requested_ip is not None and not _is_restricted(requested_ip) \
                 and requested_ip not in self._by_ip:
             ip = requested_ip

@@ -32,6 +32,11 @@ class PriorityQueue(Generic[T]):
     def push(self, item: T, key=None) -> None:
         if key is None:
             key = item.order_key()
+        if id(item) in self._entries:
+            # Re-push = reschedule: drop the stale heap entry so one item
+            # never has two live entries (the membership hash the reference's
+            # priority_queue.c maintains for the same reason).
+            self.remove(item)
         entry = [key, self._count, item, True]
         self._count += 1
         self._entries[id(item)] = entry

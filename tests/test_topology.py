@@ -1,0 +1,192 @@
+"""Topology tensor tests: GraphML parsing, Dijkstra parity semantics
+(0ms->1ms clamp, self paths, reliability accumulation, direct paths)."""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core import stime
+from shadow_tpu.routing.topology import (Topology, parse_graphml,
+                                         single_vertex_topology)
+
+GRAPHML = textwrap.dedent("""\
+    <?xml version="1.0" encoding="UTF-8"?>
+    <graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+      <key id="d0" for="node" attr.name="ip" attr.type="string"/>
+      <key id="d1" for="node" attr.name="bandwidthdown" attr.type="string"/>
+      <key id="d2" for="node" attr.name="bandwidthup" attr.type="string"/>
+      <key id="d3" for="node" attr.name="packetloss" attr.type="double"/>
+      <key id="d4" for="node" attr.name="type" attr.type="string"/>
+      <key id="d5" for="edge" attr.name="latency" attr.type="double"/>
+      <key id="d6" for="edge" attr.name="packetloss" attr.type="double"/>
+      <graph edgedefault="undirected">
+        <node id="a"><data key="d0">10.0.0.1</data><data key="d1">1000</data>
+          <data key="d2">1000</data><data key="d4">relay</data></node>
+        <node id="b"><data key="d0">10.0.0.2</data><data key="d1">2000</data>
+          <data key="d2">2000</data><data key="d4">client</data></node>
+        <node id="c"><data key="d0">10.1.0.1</data><data key="d3">0.1</data>
+          <data key="d4">client</data></node>
+        <node id="d"><data key="d0">10.2.0.1</data></node>
+        <edge source="a" target="b"><data key="d5">10.0</data><data key="d6">0.01</data></edge>
+        <edge source="b" target="c"><data key="d5">20.0</data><data key="d6">0.02</data></edge>
+        <edge source="a" target="c"><data key="d5">100.0</data><data key="d6">0.0</data></edge>
+        <edge source="c" target="d"><data key="d5">50.0</data></edge>
+      </graph>
+    </graphml>
+""")
+
+
+def make_topo():
+    return Topology.from_graphml(GRAPHML)
+
+
+def test_parse_graphml():
+    vs, es, directed, gattrs = parse_graphml(GRAPHML)
+    assert len(vs) == 4 and len(es) == 4 and not directed
+    assert vs[0].attrs["ip"] == "10.0.0.1"
+    assert es[0].latency_ms == 10.0 and es[0].packetloss == 0.01
+
+
+def test_shortest_path_latency_and_reliability():
+    t = make_topo()
+    ips = {name: i + 100 for i, name in enumerate("abc")}
+    t.attach_host(ips["a"], ip_hint="10.0.0.1")
+    t.attach_host(ips["b"], ip_hint="10.0.0.2")
+    t.attach_host(ips["c"], ip_hint="10.1.0.1")
+    t.finalize()
+    # a->c: via b (10+20=30ms) beats direct edge (100ms)
+    assert t.latency_ns_ip(ips["a"], ips["c"]) == 30 * stime.SIM_TIME_MS
+    # reliability a->c = (1-0.01)*(1-0.02) * vertex c loss (1-0.1)
+    np.testing.assert_allclose(t.reliability_ip(ips["a"], ips["c"]),
+                               0.99 * 0.98 * 0.9, rtol=1e-6)
+    # symmetric in an undirected graph; src vertex loss counts on c->a
+    np.testing.assert_allclose(t.reliability_ip(ips["c"], ips["a"]),
+                               0.9 * 0.98 * 0.99, rtol=1e-6)
+    # a->b direct edge
+    assert t.latency_ns_ip(ips["a"], ips["b"]) == 10 * stime.SIM_TIME_MS
+    # min latency = a<->b 10ms (self paths are 2*min >= 20ms)
+    assert t.min_latency_ns == 10 * stime.SIM_TIME_MS
+    # packet counters incremented by latency queries (one per send)
+    assert t.path_packet_counts.sum() == 2
+
+
+def test_self_path_two_hosts_same_vertex():
+    t = make_topo()
+    t.attach_host(201, ip_hint="10.0.0.1")
+    t.attach_host(202, ip_hint="10.0.0.1")  # same vertex
+    t.finalize()
+    # self path = 2 * cheapest incident edge (a-b 10ms), rel = 0.99**2
+    assert t.latency_ns_ip(201, 202) == 20 * stime.SIM_TIME_MS
+    np.testing.assert_allclose(t.reliability_ip(201, 202), 0.99 ** 2, rtol=1e-6)
+
+
+def test_zero_latency_clamped_to_1ms():
+    xml = GRAPHML.replace(">10.0<", ">0.0<")
+    t = Topology.from_graphml(xml)
+    t.attach_host(1, ip_hint="10.0.0.1")
+    t.attach_host(2, ip_hint="10.0.0.2")
+    t.finalize()
+    assert t.latency_ns_ip(1, 2) == 1 * stime.SIM_TIME_MS
+
+
+def test_attachment_hints():
+    t = make_topo()
+    # type filter narrows to b,c; ip prefix tiebreak picks b for 10.0.x
+    v = t.attach_host(7, ip_hint="10.0.0.9", type_hint="client")
+    assert t.vertices[v].gid == "b"
+    v2 = t.attach_host(8, type_hint="relay")
+    assert t.vertices[v2].gid == "a"
+
+
+def test_single_vertex_builtin():
+    t = single_vertex_topology(latency_ms=10.0)
+    assert t.is_complete
+    t.attach_host(1)
+    t.attach_host(2)
+    t.finalize()
+    # self-loop edge used twice: 20ms
+    assert t.latency_ns_ip(1, 2) == 20 * stime.SIM_TIME_MS
+    assert t.reliability_ip(1, 2) == 1.0
+
+
+def test_complete_graph_direct_path():
+    # two vertices with a direct edge each way = complete; Dijkstra bypassed
+    xml = textwrap.dedent("""\
+        <graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+          <key id="l" for="edge" attr.name="latency" attr.type="double"/>
+          <key id="p" for="edge" attr.name="packetloss" attr.type="double"/>
+          <graph edgedefault="undirected">
+            <node id="x"/><node id="y"/>
+            <edge source="x" target="y"><data key="l">5.0</data><data key="p">0.5</data></edge>
+          </graph>
+        </graphml>
+    """)
+    t = Topology.from_graphml(xml)
+    assert t.is_complete
+    t.attach_host(1, choice_rand=0)
+    t.attach_host(2, choice_rand=1)
+    t.finalize()
+    assert t.latency_ns_ip(1, 2) == 5 * stime.SIM_TIME_MS
+    np.testing.assert_allclose(t.reliability_ip(1, 2), 0.5, rtol=1e-6)
+
+
+def test_disconnected_attached_pair_raises():
+    xml = textwrap.dedent("""\
+        <graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+          <key id="l" for="edge" attr.name="latency" attr.type="double"/>
+          <graph edgedefault="undirected">
+            <node id="x"/><node id="y"/><node id="z"/>
+            <edge source="x" target="y"><data key="l">5.0</data></edge>
+          </graph>
+        </graphml>
+    """)
+    t = Topology.from_graphml(xml)
+    t.attach_host(1, choice_rand=0)   # x
+    t.attach_host(2, choice_rand=2)   # z (isolated)
+    with pytest.raises(ValueError):
+        t.finalize()
+
+
+def test_device_tensors_match_host():
+    t = make_topo()
+    for i, hint in enumerate(["10.0.0.1", "10.0.0.2", "10.1.0.1"]):
+        t.attach_host(300 + i, ip_hint=hint)
+    t.finalize()
+    lat_d, rel_d = t.device_tensors()
+    np.testing.assert_array_equal(np.asarray(lat_d), t.latency_ns)
+    np.testing.assert_array_equal(np.asarray(rel_d), t.reliability)
+    rows = t.ip_row_array([300, 301, 302])
+    assert rows.tolist() == [0, 1, 2]
+
+
+def test_prefer_direct_paths():
+    # incomplete graph with preferdirectpaths: adjacent pair uses the direct
+    # 100ms edge even though the 30ms two-hop path is shorter
+    xml = GRAPHML.replace(
+        '<graph edgedefault="undirected">',
+        '<key id="gd" for="graph" attr.name="preferdirectpaths" attr.type="string"/>'
+        '<graph edgedefault="undirected"><data key="gd">true</data>')
+    t = Topology.from_graphml(xml)
+    assert t.prefer_direct_paths and not t.is_complete
+    t.attach_host(1, ip_hint="10.0.0.1")
+    t.attach_host(2, ip_hint="10.1.0.1")
+    t.finalize()
+    assert t.latency_ns_ip(1, 2) == 100 * stime.SIM_TIME_MS
+
+
+def test_pqueue_repush_reschedules():
+    from shadow_tpu.utils.pqueue import PriorityQueue
+    from shadow_tpu.core.event import Event
+    from shadow_tpu.core.task import Task
+
+    class H:
+        def __init__(s, i): s.id = i; s.cpu = None
+    e = Event(Task(lambda o, a: None), 5, H(0), H(0), 0)
+    q = PriorityQueue()
+    q.push(e)
+    e.time = 1
+    q.push(e)  # re-push with new time must not leave two live entries
+    assert len(q) == 1
+    assert q.pop() is e
+    assert q.pop() is None
