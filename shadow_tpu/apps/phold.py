@@ -1,0 +1,40 @@
+"""PHOLD: the classic PDES scheduler benchmark (reference
+src/test/phold/test_phold.c): every host runs one phold process; each
+process repeatedly sends a small UDP message to a random peer, which
+triggers the peer to send onward.  Stresses the scheduler/event pipeline
+with uniform all-to-all traffic.
+
+Args: ["<n_hosts>", "<msgs_in_flight>", "<port>"] — peers are named
+``phold1..pholdN`` (quantity-expanded host names).
+"""
+
+from __future__ import annotations
+
+from .registry import register
+
+
+@register("phold")
+def main(api, args):
+    n_hosts = int(args[0]) if args else 2
+    seed_msgs = int(args[1]) if len(args) > 1 else 1
+    port = int(args[2]) if len(args) > 2 else 9000
+    fd = api.socket("udp")
+    api.bind(fd, ("0.0.0.0", port))
+
+    def pick_peer():
+        # deterministic per-host random peer (host-seeded RNG)
+        k = api.rand() % n_hosts
+        return f"phold{k + 1}"
+
+    me = api.gethostname()
+    for _ in range(seed_msgs):
+        peer = pick_peer()
+        if peer != me:
+            api.sendto(fd, b"phold", (peer, port))
+    while True:
+        data, _src = yield from api.recvfrom(fd)
+        if not data:
+            return 0
+        peer = pick_peer()
+        if peer != me:
+            api.sendto(fd, b"phold", (peer, port))

@@ -1,0 +1,31 @@
+"""App registry: plugin-path strings -> app callables."""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict
+
+_APPS: Dict[str, Callable] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _APPS[name] = fn
+        return fn
+    return deco
+
+
+def resolve(path: str) -> Callable:
+    """``python:echo`` / ``echo`` -> registered app; ``pkg.mod:fn`` -> import."""
+    name = path[7:] if path.startswith("python:") else path
+    _ensure_builtins()
+    if name in _APPS:
+        return _APPS[name]
+    if ":" in name:
+        mod, _, fn = name.partition(":")
+        return getattr(importlib.import_module(mod), fn)
+    raise ValueError(f"unknown program {path!r}; registered: {sorted(_APPS)}")
+
+
+def _ensure_builtins() -> None:
+    from . import echo, filetransfer, tgen, phold  # noqa: F401

@@ -1,0 +1,67 @@
+"""shadow-tpu command-line entry point (reference src/main/core/main.c
+main_runShadow, minus the LD_PRELOAD/exec bootstrap which lives in the
+native plugin plane).
+
+Usage:
+    shadow-tpu [options] config.xml|config.yaml
+    shadow-tpu --test          # built-in example simulation
+"""
+
+from __future__ import annotations
+
+import sys
+import textwrap
+from typing import List, Optional
+
+from .core import configuration
+from .core.controller import run_simulation
+from .core.logger import SimLogger, set_logger
+from .core.options import parse_args
+
+# TCP filetransfer once descriptor/tcp.py lands (SURVEY.md §7 stage 6);
+# UDP echo keeps --test honest until then.
+BUILTIN_TEST_CONFIG = textwrap.dedent("""\
+    <shadow stoptime="180">
+      <plugin id="echo" path="python:echo" />
+      <host id="server" bandwidthdown="102400" bandwidthup="102400">
+        <process plugin="echo" starttime="1" arguments="udp server 8000" />
+      </host>
+      <host id="client" quantity="10" bandwidthdown="10240" bandwidthup="5120">
+        <process plugin="echo" starttime="2"
+                 arguments="udp client server 8000 10 1024" />
+      </host>
+    </shadow>
+""")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    opts = parse_args(argv)
+    set_logger(SimLogger(level=opts.log_level))
+    if opts.test_mode:
+        cfg = configuration.parse_xml(BUILTIN_TEST_CONFIG)
+    elif opts.config_path:
+        try:
+            cfg = configuration.load(opts.config_path)
+        except FileNotFoundError:
+            print(f"error: config file not found: {opts.config_path}", file=sys.stderr)
+            return 2
+        except ValueError as e:
+            print(f"error: bad config {opts.config_path}: {e}", file=sys.stderr)
+            return 2
+        if opts.stop_time_sec:
+            cfg.stop_time_sec = cfg.stop_time_sec or opts.stop_time_sec
+    else:
+        print("error: provide a config file or --test", file=sys.stderr)
+        return 2
+    # CLI overrides config where explicitly provided
+    if opts.stop_time_sec and opts.stop_time_sec != 60:
+        cfg.stop_time_sec = opts.stop_time_sec
+    if opts.bootstrap_end_sec:
+        cfg.bootstrap_end_sec = opts.bootstrap_end_sec
+    opts.stop_time_sec = int(cfg.stop_time_sec)
+    opts.bootstrap_end_sec = int(cfg.bootstrap_end_sec)
+    return run_simulation(opts, cfg)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,100 @@
+"""Controller: top-level simulation driver (reference Master, core/master.c).
+
+Loads configuration + topology, registers programs and hosts into the
+Engine, computes the lookahead, runs the simulation, reports results
+(master_run :400 flow).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ..apps import registry as app_registry
+from ..host.host import Host, HostParams
+from ..process.process import Process
+from ..routing.address import ip_to_int
+from ..routing.topology import Topology, single_vertex_topology
+from . import stime
+from .configuration import Configuration
+from .engine import Engine
+from .logger import get_logger
+from .options import Options
+
+
+class Controller:
+    def __init__(self, options: Options, config: Configuration):
+        self.options = options
+        self.config = config
+        self.topology = self._load_topology()
+        self.engine = Engine(options, self.topology)
+        self._program_paths: Dict[str, str] = {}
+
+    def _load_topology(self) -> Topology:
+        cfg = self.config
+        if cfg.topology_text:
+            return Topology.from_graphml(cfg.topology_text)
+        if cfg.topology_path:
+            path = cfg.topology_path
+            if not os.path.isabs(path) and self.options.config_path:
+                base = os.path.dirname(os.path.abspath(self.options.config_path))
+                cand = os.path.join(base, path)
+                if os.path.exists(cand):
+                    path = cand
+            return Topology.from_file(path)
+        return single_vertex_topology()
+
+    def setup(self) -> None:
+        """Register programs and hosts (master.c:279-392)."""
+        opts = self.options
+        for prog in self.config.programs:
+            self._program_paths[prog.id] = prog.path
+
+        for hc in self.config.hosts:
+            for q in range(hc.quantity):
+                name = hc.id if hc.quantity == 1 else f"{hc.id}{q + 1}"
+                params = HostParams(
+                    name=name,
+                    bw_down_kibps=hc.bandwidth_down_kibps,
+                    bw_up_kibps=hc.bandwidth_up_kibps,
+                    qdisc=hc.qdisc or opts.interface_qdisc,
+                    router_queue=opts.router_queue,
+                    recv_buf_size=hc.socket_recv_buffer or opts.socket_recv_buffer,
+                    send_buf_size=hc.socket_send_buffer or opts.socket_send_buffer,
+                    autotune_recv=opts.socket_autotune and not hc.socket_recv_buffer,
+                    autotune_send=opts.socket_autotune and not hc.socket_send_buffer,
+                    cpu_frequency_khz=hc.cpu_frequency_khz,
+                    cpu_threshold_ns=opts.cpu_threshold_ns,
+                    cpu_precision_ns=opts.cpu_precision_ns,
+                    interface_buffer=hc.interface_buffer or opts.interface_buffer,
+                    heartbeat_interval_sec=(hc.heartbeat_interval_sec
+                                            or opts.heartbeat_interval_sec),
+                    log_pcap=hc.log_pcap,
+                    pcap_dir=hc.pcap_dir or opts.pcap_dir,
+                    ip_hint=hc.ip_hint, city_hint=hc.city_hint,
+                    country_hint=hc.country_hint, geocode_hint=hc.geocode_hint,
+                    type_hint=hc.type_hint)
+                host = Host(self.engine.next_host_id(), params, self.engine.root_key)
+                requested_ip = ip_to_int(hc.ip_hint) if hc.ip_hint else None
+                self.engine.add_host(host, requested_ip)
+                for pc in hc.processes:
+                    self._add_process(host, pc)
+        self.topology.finalize()
+
+    def _add_process(self, host: Host, pc) -> None:
+        path = self._program_paths.get(pc.plugin, pc.plugin)
+        app_main = app_registry.resolve(path)
+        args = pc.arguments.split() if pc.arguments else []
+        stop_ns = stime.from_seconds(pc.stop_time_sec) if pc.stop_time_sec else 0
+        Process(host, f"{host.name}.{pc.plugin}", app_main, args,
+                start_time_ns=stime.from_seconds(pc.start_time_sec),
+                stop_time_ns=stop_ns)
+
+    def run(self) -> int:
+        self.setup()
+        return self.engine.run()
+
+
+def run_simulation(options: Options, config: Configuration) -> int:
+    """One-call entry used by the CLI and tests."""
+    return Controller(options, config).run()

@@ -1,0 +1,33 @@
+"""Wire/protocol constants.
+
+Values match the reference's definitions.h so simulated byte/packet accounting
+is comparable (file:line cited per group).
+"""
+
+# Ethernet/IP framing (definitions.h:169-193)
+CONFIG_HEADER_SIZE_UDPIPETH = 42    # UDP+IP+ETH header bytes
+CONFIG_HEADER_SIZE_TCPIPETH = 66    # TCP+IP+ETH header bytes (with options)
+CONFIG_MTU = 1500
+CONFIG_DATAGRAM_MAX_SIZE = 65507
+CONFIG_TCP_MAX_SEGMENT_SIZE = CONFIG_MTU - (CONFIG_HEADER_SIZE_TCPIPETH - 14)  # IP payload minus TCP/IP hdr
+
+# Interface batching (network_interface.c:93-95, 207-214)
+INTERFACE_REFILL_INTERVAL_NS = 1_000_000        # 1 ms token refill
+INTERFACE_CAPACITY_FACTOR = 1                   # capacity = refill*factor + MTU
+CONFIG_RECEIVE_BATCH_TIME_NS = 10_000_000       # definitions.h:169
+
+# TCP buffer sizing (definitions.h:109-114)
+CONFIG_TCP_WMEM_MIN = 4096
+CONFIG_TCP_WMEM_DEFAULT = 16384
+CONFIG_TCP_WMEM_MAX = 4194304
+CONFIG_TCP_RMEM_MIN = 4096
+CONFIG_TCP_RMEM_DEFAULT = 87380
+CONFIG_TCP_RMEM_MAX = 6291456
+
+# TCP timers, in ms (definitions.h:115-131; NET_TCP_HZ = 1000 ms base)
+NET_TCP_HZ_MS = 1000
+CONFIG_TCP_RTO_INIT_MS = NET_TCP_HZ_MS
+CONFIG_TCP_RTO_MIN_MS = NET_TCP_HZ_MS // 5
+CONFIG_TCP_RTO_MAX_MS = NET_TCP_HZ_MS * 120
+CONFIG_TCP_DELACK_MIN_MS = NET_TCP_HZ_MS // 25
+CONFIG_TCP_DELACK_MAX_MS = NET_TCP_HZ_MS // 5

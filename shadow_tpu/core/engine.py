@@ -1,0 +1,242 @@
+"""Engine: the per-machine simulation engine (reference Slave, core/slave.c).
+
+Owns the Scheduler, host registry, DNS, program registry, data directories,
+object counters, and the round loop (slave_run :413, round loop :437-462):
+
+    while events remain:
+        window = [min_next_event_time, +lookahead)
+        workers drain their queues up to the window end     (parallel)
+        flush logger, heartbeat                              (main thread)
+        compute next window from global min next event time
+
+Multi-worker execution uses Python threads with two CountDownLatch barriers
+per round (the reference uses five; ours fold the start/prepare pairs).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time as _walltime
+from typing import Dict, List, Optional
+
+from ..routing.dns import DNS
+from ..utils.count_down_latch import CountDownLatch
+from . import stime
+from .counters import ObjectCounter
+from .logger import get_logger
+from .rng import RandomSource, derive, uniform_np
+from .scheduler import Scheduler
+from .worker import Worker, set_current_worker
+
+DEFAULT_LOOKAHEAD_NS = 10 * stime.SIM_TIME_MS  # master.c:133-146 default jump
+
+
+class Engine:
+    def __init__(self, options, topology, seed_key: Optional[int] = None):
+        self.options = options
+        self.topology = topology
+        self.root_key = seed_key if seed_key is not None else derive(options.seed, "root")
+        self.dns = DNS()
+        self.random = RandomSource(derive(self.root_key, "engine"))
+        self.hosts: Dict[int, object] = {}          # id -> Host
+        self.hosts_by_ip: Dict[int, object] = {}
+        self.hosts_by_name: Dict[str, object] = {}
+        self.end_time = options.stop_time_sec * stime.SIM_TIME_SEC
+        self.bootstrap_end = options.bootstrap_end_sec * stime.SIM_TIME_SEC
+        self.counters = ObjectCounter()
+        self._counters_lock = threading.Lock()
+        self.plugin_errors = 0
+        self.data_directory = options.data_directory
+        self.scheduler = Scheduler(self, options.scheduler_policy,
+                                   options.workers, derive(self.root_key, "sched"))
+        self._drop_key = derive(self.root_key, "packet_drop")
+        self._global_seq = 0
+        self._running = True
+        self._host_id_counter = 0
+        self.sim_start_wall: float = 0.0
+        self.rounds_executed = 0
+        self.events_executed = 0
+
+    # -- registry ----------------------------------------------------------
+    def add_host(self, host, requested_ip: Optional[int] = None) -> None:
+        """Register + set up a host (slave_addNewVirtualHost :296)."""
+        addr = self.dns.register(host.id, host.name, requested_ip)
+        host.setup(self, addr)
+        vidx = self.topology.attach_host(
+            addr.ip, ip_hint=host.params.ip_hint, city_hint=host.params.city_hint,
+            country_hint=host.params.country_hint,
+            geocode_hint=host.params.geocode_hint, type_hint=host.params.type_hint,
+            choice_rand=host.random.next_u64())
+        # fill in bandwidths from the topology vertex if unset (master.c:336-377)
+        if host.params.bw_down_kibps <= 0 or host.params.bw_up_kibps <= 0:
+            down, up = self.topology.vertex_bandwidth_kibps(vidx)
+            if host.params.bw_down_kibps <= 0:
+                host.params.bw_down_kibps = down or 102400
+            if host.params.bw_up_kibps <= 0:
+                host.params.bw_up_kibps = up or 102400
+            # rebuild the eth token buckets with resolved rates
+            eth = host.interfaces[addr.ip]
+            from ..host.network_interface import TokenBucket
+            eth.send_bucket = TokenBucket(host.params.bw_up_kibps)
+            eth.receive_bucket = TokenBucket(host.params.bw_down_kibps)
+        self.hosts[host.id] = host
+        self.hosts_by_ip[addr.ip] = host
+        self.hosts_by_name[host.name] = host
+        self.scheduler.add_host(host)
+        self.counters.count_new("host")
+
+    def next_host_id(self) -> int:
+        self._host_id_counter += 1
+        return self._host_id_counter
+
+    def host_by_ip(self, ip: int):
+        return self.hosts_by_ip.get(ip)
+
+    def host_by_name(self, name: str):
+        return self.hosts_by_name.get(name)
+
+    # -- deterministic draws ----------------------------------------------
+    def packet_drop_uniform(self, packet_uid: int) -> float:
+        """Order-independent drop draw keyed by packet uid (shared with the
+        TPU kernel; see ops/round_step.py)."""
+        import numpy as np
+        return float(uniform_np(self._drop_key, np.uint64(packet_uid)))
+
+    def count_packet_drop(self, packet) -> None:
+        self.counters.count_new("packet_drop")
+
+    # -- misc --------------------------------------------------------------
+    def is_running(self) -> bool:
+        return self._running
+
+    def next_global_sequence(self) -> int:
+        self._global_seq += 1
+        return self._global_seq
+
+    def merge_counters(self, c: ObjectCounter) -> None:
+        with self._counters_lock:
+            self.counters.merge(c)
+
+    def increment_plugin_error(self) -> None:
+        self.plugin_errors += 1
+
+    @property
+    def lookahead_ns(self) -> int:
+        if self.options.runahead_ms > 0:
+            return self.options.runahead_ms * stime.SIM_TIME_MS
+        m = getattr(self.topology, "min_latency_ns", 0)
+        if 0 < m < stime.SIM_TIME_MAX:
+            return m
+        return DEFAULT_LOOKAHEAD_NS
+
+    # -- boot events -------------------------------------------------------
+    def schedule_boot(self) -> None:
+        """Host boots + process starts at t=0 (host_boot :372-390)."""
+        boot_worker = Worker(0, self)
+        set_current_worker(boot_worker)
+        try:
+            for hid in sorted(self.hosts):
+                host = self.hosts[hid]
+                boot_worker.set_active_host(host)
+                host.boot()
+                for proc in host.processes:
+                    proc.schedule_start(boot_worker)
+                boot_worker.set_active_host(None)
+        finally:
+            set_current_worker(None)
+        self.merge_counters(boot_worker.counters)
+
+    # -- round loop --------------------------------------------------------
+    def run(self) -> int:
+        """The slave_run equivalent.  Returns process-style exit code."""
+        log = get_logger()
+        self.sim_start_wall = _walltime.monotonic()
+        self.schedule_boot()
+        lookahead = self.lookahead_ns
+        log.message("engine",
+                    f"starting simulation: {len(self.hosts)} hosts, "
+                    f"policy={self.scheduler.policy_name}, "
+                    f"workers={self.options.workers}, "
+                    f"lookahead={lookahead / 1e6:.3f} ms, "
+                    f"end={self.end_time / 1e9:.1f} s")
+        if self.options.workers == 0:
+            self._run_serial(lookahead)
+        else:
+            self._run_threaded(lookahead)
+        self._running = False
+        # teardown: hosts (and their descriptors) are reclaimed here
+        for host in self.hosts.values():
+            self.counters.count_free("host")
+        log.flush()
+        leaks = self.counters.leaks()
+        log.message("engine",
+                    f"simulation finished: {self.rounds_executed} rounds, "
+                    f"{self.events_executed} events, "
+                    f"{_walltime.monotonic() - self.sim_start_wall:.3f}s wall")
+        if leaks:
+            log.message("engine", self.counters.report())
+        log.flush()
+        return 1 if self.plugin_errors else 0
+
+    def _advance_window(self, lookahead: int) -> bool:
+        nxt = self.scheduler.next_event_time()
+        if nxt >= self.end_time or nxt >= stime.SIM_TIME_MAX:
+            return False
+        self.scheduler.window_start = nxt
+        self.scheduler.window_end = min(nxt + lookahead, self.end_time)
+        return True
+
+    def _run_serial(self, lookahead: int) -> None:
+        worker = Worker(0, self)
+        set_current_worker(worker)
+        try:
+            while self._advance_window(lookahead):
+                worker.round_end = self.scheduler.window_end
+                worker.run_round()
+                self.rounds_executed += 1
+                get_logger().flush()
+            self.events_executed = worker.counters._free.get("event", 0)
+        finally:
+            worker.finish()
+            set_current_worker(None)
+
+    def _run_threaded(self, lookahead: int) -> None:
+        n = self.scheduler.n_threads
+        start_latch = CountDownLatch(n + 1)
+        done_latch = CountDownLatch(n + 1)
+        stop_flag = {"stop": False}
+        workers = [Worker(i, self) for i in range(n)]
+
+        def body(worker: Worker) -> None:
+            set_current_worker(worker)
+            try:
+                while True:
+                    start_latch.count_down_await()
+                    if stop_flag["stop"]:
+                        break
+                    worker.round_end = self.scheduler.window_end
+                    worker.run_round()
+                    done_latch.count_down_await()
+            finally:
+                worker.finish()
+                set_current_worker(None)
+
+        threads = [threading.Thread(target=body, args=(w,), daemon=True,
+                                    name=f"worker-{w.id}") for w in workers]
+        for t in threads:
+            t.start()
+        try:
+            while self._advance_window(lookahead):
+                start_latch.count_down_await()
+                start_latch.reset()
+                done_latch.count_down_await()
+                done_latch.reset()
+                self.rounds_executed += 1
+                get_logger().flush()
+        finally:
+            stop_flag["stop"] = True
+            start_latch.count_down_await()
+            for t in threads:
+                t.join(timeout=30)
+        self.events_executed = self.counters._free.get("event", 0)

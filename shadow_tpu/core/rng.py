@@ -126,7 +126,6 @@ def uniform_jnp(key, counter):
     rather than silently dropping the counter's high bits, which would make
     CPU and TPU drop decisions diverge for packet uids >= 2**32.
     """
-    import jax
     import jax.numpy as jnp
 
     if isinstance(counter, (int, np.integer, np.ndarray, list, tuple)):

@@ -1,0 +1,364 @@
+"""Scheduler: event queues, round barriers, worker thread pool.
+
+Capability parity with the reference scheduler (core/scheduler/scheduler.c +
+the policy vtable scheduler_policy.h:40-51): a policy owns the event-queue
+topology (who stores which host's events, who may pop them); the scheduler
+drives rounds — conservative time windows [start, end) sized by the topology
+lookahead — with barriers between phases (the reference uses 5 CountDownLatch
+barriers per round, scheduler.c:35-42).
+
+Policies implemented (slave.c:104-120 name mapping):
+  * ``global``        — one queue, single thread (SP_SERIAL_GLOBAL)
+  * ``host``          — per-host queues, threads own fixed host sets
+  * ``steal``         — per-host queues + work stealing (default)
+  * ``thread``        — one queue per worker thread
+  * ``threadXthread`` — N×N mailbox queues
+  * ``threadXhost``   — per-(thread,host) queues
+  * ``tpu``           — per-host queues + device-batched packet hop
+                        (parallel/tpu_policy.py)
+
+The causality contract: an event pushed across hosts during a round is
+clamped to at least the next round barrier (reference
+scheduler_policy_host_steal.c:225-242); with lookahead = min path latency the
+clamp never actually fires for packet events, it is a safety net.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+from ..utils.count_down_latch import CountDownLatch
+from ..utils.pqueue import PriorityQueue
+from . import stime
+from .event import Event
+from .logger import get_logger
+
+
+class SchedulerPolicy:
+    """Vtable equivalent of scheduler_policy.h:40-51."""
+
+    def add_host(self, host, worker_id: int) -> None:
+        raise NotImplementedError
+
+    def assigned_hosts(self, worker_id: int) -> List:
+        raise NotImplementedError
+
+    def push(self, event: Event, worker_id: int, barrier: int) -> None:
+        raise NotImplementedError
+
+    def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
+        raise NotImplementedError
+
+    def next_time(self) -> int:
+        """Min event time across all queues (for the next round window)."""
+        raise NotImplementedError
+
+
+class GlobalSinglePolicy(SchedulerPolicy):
+    """One unlocked pqueue, one thread (scheduler_policy_global_single.c)."""
+
+    def __init__(self):
+        self.queue: PriorityQueue = PriorityQueue()
+        self.hosts: List = []
+
+    def add_host(self, host, worker_id: int) -> None:
+        self.hosts.append(host)
+
+    def assigned_hosts(self, worker_id: int) -> List:
+        return self.hosts if worker_id == 0 else []
+
+    def push(self, event: Event, worker_id: int, barrier: int) -> None:
+        if event.dst_host is not event.src_host and event.time < barrier:
+            event.time = barrier
+        self.queue.push(event)
+
+    def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
+        key = self.queue.peek_key()
+        if key is None or key[0] >= window_end:
+            return None
+        return self.queue.pop()
+
+    def next_time(self) -> int:
+        key = self.queue.peek_key()
+        return key[0] if key is not None else stime.SIM_TIME_MAX
+
+
+class HostQueuesPolicy(SchedulerPolicy):
+    """Per-host locked queues with fixed host->worker assignment — the
+    ``host`` policy (scheduler_policy_host_single.c); base for ``steal`` and
+    ``tpu``."""
+
+    def __init__(self):
+        self._host_queues: Dict[int, PriorityQueue] = {}
+        self._host_locks: Dict[int, threading.Lock] = {}
+        self._assignment: Dict[int, List] = {}       # worker -> hosts
+        self._host_worker: Dict[int, int] = {}       # host id -> worker
+
+    def add_host(self, host, worker_id: int) -> None:
+        self._host_queues[host.id] = PriorityQueue()
+        self._host_locks[host.id] = threading.Lock()
+        self._assignment.setdefault(worker_id, []).append(host)
+        self._host_worker[host.id] = worker_id
+
+    def assigned_hosts(self, worker_id: int) -> List:
+        return self._assignment.get(worker_id, [])
+
+    def push(self, event: Event, worker_id: int, barrier: int) -> None:
+        if event.dst_host is not event.src_host and event.time < barrier:
+            event.time = barrier
+        hid = event.dst_host.id if event.dst_host is not None else -1
+        if hid not in self._host_queues:
+            self._host_queues[hid] = PriorityQueue()
+            self._host_locks[hid] = threading.Lock()
+        with self._host_locks[hid]:
+            self._host_queues[hid].push(event)
+
+    def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
+        # pop the earliest event among this worker's hosts, honoring the
+        # global order key so same-window events execute deterministically
+        # per host (cross-host order within a window is free, as in the
+        # reference — causality is guaranteed by the lookahead window).
+        best = None
+        best_key = None
+        for host in self._assignment.get(worker_id, []):
+            q = self._host_queues[host.id]
+            with self._host_locks[host.id]:
+                key = q.peek_key()
+            if key is not None and key[0] < window_end:
+                if best_key is None or key < best_key:
+                    best, best_key = host, key
+        # also drain the detached (-1) queue from worker 0
+        if worker_id == 0 and -1 in self._host_queues:
+            with self._host_locks[-1]:
+                key = self._host_queues[-1].peek_key()
+            if key is not None and key[0] < window_end and (
+                    best_key is None or key < best_key):
+                with self._host_locks[-1]:
+                    return self._host_queues[-1].pop()
+        if best is None:
+            return None
+        with self._host_locks[best.id]:
+            return self._host_queues[best.id].pop()
+
+    def next_time(self) -> int:
+        t = stime.SIM_TIME_MAX
+        for hid, q in self._host_queues.items():
+            with self._host_locks[hid]:
+                key = q.peek_key()
+            if key is not None:
+                t = min(t, key[0])
+        return t
+
+
+class HostStealPolicy(HostQueuesPolicy):
+    """Work stealing on top of per-host queues
+    (scheduler_policy_host_steal.c): when a worker's own hosts are drained
+    for this window, it scans other workers' hosts and migrates one with
+    runnable events (host_migrate :172-196).  Migration only moves queue
+    ownership; host state follows because the thief executes the host's
+    events after the migration point."""
+
+    def __init__(self):
+        super().__init__()
+        self._steal_lock = threading.Lock()
+
+    def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
+        ev = super().pop(worker_id, window_end)
+        if ev is not None:
+            return ev
+        # steal: find any host with work in this window and take it over
+        with self._steal_lock:
+            for victim_worker, hosts in list(self._assignment.items()):
+                if victim_worker == worker_id:
+                    continue
+                for host in hosts:
+                    q = self._host_queues[host.id]
+                    with self._host_locks[host.id]:
+                        key = q.peek_key()
+                    if key is not None and key[0] < window_end:
+                        hosts.remove(host)
+                        self._assignment.setdefault(worker_id, []).append(host)
+                        self._host_worker[host.id] = worker_id
+                        return super().pop(worker_id, window_end)
+        return None
+
+
+class ThreadSinglePolicy(SchedulerPolicy):
+    """One locked queue per worker thread
+    (scheduler_policy_thread_single.c): all events for a worker's hosts land
+    in that worker's single queue."""
+
+    def __init__(self):
+        self._queues: Dict[int, PriorityQueue] = {}
+        self._locks: Dict[int, threading.Lock] = {}
+        self._assignment: Dict[int, List] = {}
+        self._host_worker: Dict[int, int] = {}
+
+    def add_host(self, host, worker_id: int) -> None:
+        self._assignment.setdefault(worker_id, []).append(host)
+        self._host_worker[host.id] = worker_id
+        if worker_id not in self._queues:
+            self._queues[worker_id] = PriorityQueue()
+            self._locks[worker_id] = threading.Lock()
+
+    def assigned_hosts(self, worker_id: int) -> List:
+        return self._assignment.get(worker_id, [])
+
+    def _queue_for(self, event: Event) -> int:
+        hid = event.dst_host.id if event.dst_host is not None else -1
+        return self._host_worker.get(hid, 0)
+
+    def push(self, event: Event, worker_id: int, barrier: int) -> None:
+        if event.dst_host is not event.src_host and event.time < barrier:
+            event.time = barrier
+        w = self._queue_for(event)
+        if w not in self._queues:
+            self._queues[w] = PriorityQueue()
+            self._locks[w] = threading.Lock()
+        with self._locks[w]:
+            self._queues[w].push(event)
+
+    def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
+        q = self._queues.get(worker_id)
+        if q is None:
+            return None
+        with self._locks[worker_id]:
+            key = q.peek_key()
+            if key is None or key[0] >= window_end:
+                return None
+            return q.pop()
+
+    def next_time(self) -> int:
+        t = stime.SIM_TIME_MAX
+        for w, q in self._queues.items():
+            with self._locks[w]:
+                key = q.peek_key()
+            if key is not None:
+                t = min(t, key[0])
+        return t
+
+
+class ThreadPerThreadPolicy(ThreadSinglePolicy):
+    """N×N mailboxes (scheduler_policy_thread_perthread.c): queue (i,j)
+    holds events pushed by worker i for worker j's hosts, so at most two
+    threads ever contend on a queue."""
+
+    def __init__(self):
+        super().__init__()
+        self._mailboxes: Dict[tuple, PriorityQueue] = {}
+        self._mlocks: Dict[tuple, threading.Lock] = {}
+
+    def push(self, event: Event, worker_id: int, barrier: int) -> None:
+        if event.dst_host is not event.src_host and event.time < barrier:
+            event.time = barrier
+        dst_worker = self._queue_for(event)
+        key = (worker_id, dst_worker)
+        if key not in self._mailboxes:
+            self._mailboxes[key] = PriorityQueue()
+            self._mlocks[key] = threading.Lock()
+        with self._mlocks[key]:
+            self._mailboxes[key].push(event)
+
+    def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
+        best_key, best_mb = None, None
+        for (src, dst), q in self._mailboxes.items():
+            if dst != worker_id:
+                continue
+            with self._mlocks[(src, dst)]:
+                key = q.peek_key()
+            if key is not None and key[0] < window_end and (
+                    best_key is None or key < best_key):
+                best_key, best_mb = key, (src, dst)
+        if best_mb is None:
+            return None
+        with self._mlocks[best_mb]:
+            return self._mailboxes[best_mb].pop()
+
+    def next_time(self) -> int:
+        t = stime.SIM_TIME_MAX
+        for key, q in self._mailboxes.items():
+            with self._mlocks[key]:
+                k = q.peek_key()
+            if k is not None:
+                t = min(t, k[0])
+        return t
+
+
+class ThreadPerHostPolicy(HostQueuesPolicy):
+    """Per-(thread,host) queues (scheduler_policy_thread_perhost.c).  With
+    our per-host locking the host-queue layout already gives the same
+    contention profile; kept as a named policy for config parity."""
+
+
+def make_policy(name: str) -> SchedulerPolicy:
+    if name == "global":
+        return GlobalSinglePolicy()
+    if name == "host":
+        return HostQueuesPolicy()
+    if name == "steal":
+        return HostStealPolicy()
+    if name == "thread":
+        return ThreadSinglePolicy()
+    if name == "threadXthread":
+        return ThreadPerThreadPolicy()
+    if name == "threadXhost":
+        return ThreadPerHostPolicy()
+    if name == "tpu":
+        from ..parallel.tpu_policy import TPUPolicy
+        return TPUPolicy()
+    raise ValueError(f"unknown scheduler policy {name!r}")
+
+
+class Scheduler:
+    """Drives rounds over worker threads (serial when n_workers == 0)."""
+
+    def __init__(self, engine, policy_name: str, n_workers: int, seed_key: int):
+        self.engine = engine
+        self.policy_name = policy_name
+        self.n_workers = max(0, n_workers)
+        self.n_threads = max(1, self.n_workers)
+        if self.n_workers == 0 and policy_name == "steal":
+            # reference falls back to a serial queue for 0 workers
+            # (scheduler.c:139-142)
+            policy_name = "global"
+            self.policy_name = "global"
+        self.policy = make_policy(policy_name)
+        self.seed_key = seed_key
+        self.window_start = 0
+        self.window_end = 1
+        self._next_host_worker = 0
+        self._host_count = 0
+        self._running = True
+        self._threads: List[threading.Thread] = []
+        self._workers: List = []
+        self._round_start_latch: Optional[CountDownLatch] = None
+        self._round_done_latch: Optional[CountDownLatch] = None
+
+    # -- host assignment (scheduler.c:437-531 random shuffle) --------------
+    def add_host(self, host) -> None:
+        # deterministic round-robin assignment; the reference shuffles with
+        # the scheduler seed — round-robin is equally balanced and stable
+        wid = self._next_host_worker
+        self._next_host_worker = (self._next_host_worker + 1) % self.n_threads
+        self.policy.add_host(host, wid)
+        self._host_count += 1
+
+    # -- push/pop (worker-facing) -----------------------------------------
+    def push(self, event: Event, worker) -> None:
+        self.policy.push(event, worker.id, self.window_end)
+
+    def pop(self, worker) -> Optional[Event]:
+        if not self._running:
+            return None
+        return self.policy.pop(worker.id, self.window_end)
+
+    def next_event_time(self) -> int:
+        return self.policy.next_time()
+
+    def stop(self) -> None:
+        self._running = False
+
+    @property
+    def is_running(self) -> bool:
+        return self._running

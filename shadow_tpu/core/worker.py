@@ -121,25 +121,24 @@ class Worker:
         self.schedule_task(task, latency, dst_host=dst_host)
 
     # -- event loop --------------------------------------------------------
-    def run(self) -> None:
-        """Pop-execute loop until the scheduler signals shutdown (reference
-        worker.c:149-216)."""
-        set_current_worker(self)
-        try:
-            while True:
-                ev = self.scheduler.pop(self)
-                if ev is None:
-                    break
-                self.now = ev.time
-                if ev.execute(self):
-                    self.last_event_time = ev.time
-                    self.counters.count_free("event")
-                # else: CPU model deferred it — the same Event object was
-                # re-pushed with a later time and will be accounted when it
-                # actually runs.
-        finally:
-            self.engine.merge_counters(self.counters)
-            set_current_worker(None)
+    def run_round(self) -> None:
+        """Drain this worker's runnable events for the current window
+        (reference worker.c:149-216 inner loop; the pop returns None at the
+        window end)."""
+        while True:
+            ev = self.scheduler.pop(self)
+            if ev is None:
+                break
+            self.now = ev.time
+            if ev.execute(self):
+                self.last_event_time = ev.time
+                self.counters.count_free("event")
+            # else: CPU model deferred it — the same Event object was
+            # re-pushed with a later time and will be accounted when it
+            # actually runs.
+
+    def finish(self) -> None:
+        self.engine.merge_counters(self.counters)
 
 
 def _deliver_packet_task(dst_host, packet) -> None:

@@ -1,0 +1,111 @@
+"""Simulated epoll.
+
+Capability of the reference's Epoll (host/descriptor/epoll.c): watches
+descriptor status bits via the listener mechanism, maintains a ready set,
+and — crucially — is the glue that resumes virtual processes: when a watched
+descriptor becomes ready, the owning process gets a ``process_continue``
+wakeup (epoll.c drives this in the reference; here the Process registers a
+wakeup callback).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from .base import Descriptor, S_CLOSED, S_READABLE, S_WRITABLE
+
+EPOLLIN = 0x001
+EPOLLOUT = 0x004
+EPOLLERR = 0x008
+EPOLLHUP = 0x010
+
+
+class Epoll(Descriptor):
+    def __init__(self, host, handle: int):
+        super().__init__(host, handle, "epoll")
+        self._watches: Dict[int, Tuple[Descriptor, int, object]] = {}  # fd -> (desc, events, data)
+        self._ready: Dict[int, int] = {}  # fd -> revents
+        self._wakeup_callbacks: List = []
+
+    # -- control -----------------------------------------------------------
+    def ctl_add(self, desc: Descriptor, events: int, data=None) -> None:
+        if desc.handle in self._watches:
+            raise FileExistsError("EEXIST")
+        self._watches[desc.handle] = (desc, events, data)
+        desc.add_listener(self._on_status)
+        self._refresh(desc)
+
+    def ctl_mod(self, desc: Descriptor, events: int, data=None) -> None:
+        if desc.handle not in self._watches:
+            raise FileNotFoundError("ENOENT")
+        self._watches[desc.handle] = (desc, events, data)
+        self._refresh(desc)
+
+    def ctl_del(self, desc: Descriptor) -> None:
+        if desc.handle not in self._watches:
+            raise FileNotFoundError("ENOENT")
+        del self._watches[desc.handle]
+        desc.remove_listener(self._on_status)
+        self._ready.pop(desc.handle, None)
+        self._update_own_status()
+
+    # -- status tracking ---------------------------------------------------
+    def _revents_for(self, desc: Descriptor, want: int) -> int:
+        r = 0
+        if (want & EPOLLIN) and desc.has_status(S_READABLE):
+            r |= EPOLLIN
+        if (want & EPOLLOUT) and desc.has_status(S_WRITABLE):
+            r |= EPOLLOUT
+        if desc.has_status(S_CLOSED):
+            r |= EPOLLHUP
+        return r
+
+    def _refresh(self, desc: Descriptor) -> None:
+        entry = self._watches.get(desc.handle)
+        if entry is None:
+            return
+        _, want, _ = entry
+        r = self._revents_for(desc, want)
+        if r:
+            newly = desc.handle not in self._ready
+            self._ready[desc.handle] = r
+            if newly:
+                self._notify_wakeups()
+        else:
+            self._ready.pop(desc.handle, None)
+        self._update_own_status()
+
+    def _on_status(self, desc: Descriptor, changed_bits: int) -> None:
+        self._refresh(desc)
+
+    def _update_own_status(self) -> None:
+        # an epoll fd is itself readable when it has ready events (epoll
+        # nesting works in the reference too)
+        self.adjust_status(S_READABLE, bool(self._ready))
+
+    # -- wakeup integration ------------------------------------------------
+    def add_wakeup_callback(self, cb) -> None:
+        if cb not in self._wakeup_callbacks:
+            self._wakeup_callbacks.append(cb)
+
+    def remove_wakeup_callback(self, cb) -> None:
+        if cb in self._wakeup_callbacks:
+            self._wakeup_callbacks.remove(cb)
+
+    def _notify_wakeups(self) -> None:
+        for cb in list(self._wakeup_callbacks):
+            cb()
+
+    # -- wait --------------------------------------------------------------
+    def wait(self, max_events: int = 64) -> List[Tuple[object, int]]:
+        """Non-blocking collect of (data, revents); blocking semantics are
+        provided by the process layer (green thread suspends until the
+        wakeup callback fires)."""
+        out = []
+        for fd, revents in list(self._ready.items())[:max_events]:
+            desc, want, data = self._watches[fd]
+            out.append((data if data is not None else fd, revents))
+        return out
+
+    def has_ready(self) -> bool:
+        return bool(self._ready)

@@ -1,0 +1,78 @@
+"""UDP socket.
+
+Thin wrapper over the packet pipeline like the reference's udp.c: sends chop
+user data into datagrams (<= CONFIG_DATAGRAM_MAX_SIZE) handed straight to
+the interface; arrivals queue whole packets for recvfrom.  Cites
+udp_sendUserData (udp.c:75) / udp_processPacket (udp.c:53).
+"""
+
+from __future__ import annotations
+
+from ..core import defs
+from ..routing.packet import Packet
+from .base import S_READABLE, S_WRITABLE, Socket
+
+
+class UDPSocket(Socket):
+    def __init__(self, host, handle: int, recv_buf_size: int, send_buf_size: int):
+        super().__init__(host, handle, "udp", recv_buf_size, send_buf_size)
+        self.adjust_status(S_WRITABLE, True)
+        self.default_interface = None   # set when bound
+
+    # -- send --------------------------------------------------------------
+    def send_user_data(self, data: bytes, dst_ip: int = 0, dst_port: int = 0) -> int:
+        host = self.host
+        if dst_ip == 0:
+            if self.peer_ip is None:
+                raise ConnectionError("EDESTADDRREQ: unconnected UDP send without address")
+            dst_ip, dst_port = self.peer_ip, self.peer_port
+        if not self.is_bound:
+            host.autobind_socket(self, dst_ip)
+        if len(data) > defs.CONFIG_DATAGRAM_MAX_SIZE:
+            raise ValueError("EMSGSIZE: datagram too large")
+        need = len(data) + defs.CONFIG_HEADER_SIZE_UDPIPETH
+        if not self.has_out_space(need):
+            return 0  # EWOULDBLOCK; caller retries when WRITABLE
+        packet = Packet.new_udp(host.next_packet_uid(), host.next_packet_priority(),
+                                self.bound_ip, self.bound_port, dst_ip, dst_port,
+                                data)
+        self.add_out_packet(packet)
+        iface = host.interface_for_ip(self.bound_ip)
+        if iface is not None:
+            iface.wants_send(self)
+        self._update_writable()
+        return len(data)
+
+    # -- receive -----------------------------------------------------------
+    def receive_user_data(self, nbytes: int):
+        if not self.in_packets:
+            return None
+        p = self.in_packets.popleft()
+        self.in_bytes -= p.total_size
+        data = p.payload[:nbytes]  # datagram semantics: excess is discarded
+        p.add_status("RCV_SOCKET_DELIVERED")
+        self._update_readable()
+        self._update_writable()
+        return data, p.src_ip, p.src_port
+
+    def push_in_packet(self, packet) -> None:
+        if not self.has_in_space(packet.total_size):
+            self.drop_packet(packet)
+            return
+        packet.add_status("RCV_SOCKET_BUFFERED")
+        self.in_packets.append(packet)
+        self.in_bytes += packet.total_size
+        self._update_readable()
+
+    # -- status upkeep -----------------------------------------------------
+    def _update_readable(self) -> None:
+        self.adjust_status(S_READABLE, bool(self.in_packets))
+
+    def _update_writable(self) -> None:
+        self.adjust_status(S_WRITABLE,
+                           self.out_bytes < self.send_buf_size and not self.closed)
+
+    def pull_out_packet(self):
+        p = super().pull_out_packet()
+        self._update_writable()
+        return p

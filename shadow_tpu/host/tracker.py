@@ -1,0 +1,99 @@
+"""Tracker: per-host metrics and heartbeat logging.
+
+Capability of the reference's Tracker (host/tracker.c): processing/delay
+time, per-interface packet/byte counters with local/remote and
+data/control/retransmit splits (:25-49), socket buffer stats, allocation
+tallies, and periodic heartbeat log lines.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict
+
+from ..core.logger import get_logger
+from ..routing.address import LOCALHOST_IP
+
+
+class _Counters:
+    __slots__ = ("packets_total", "bytes_total", "packets_control",
+                 "bytes_control", "packets_data", "bytes_data",
+                 "packets_retrans", "bytes_retrans")
+
+    def __init__(self):
+        self.packets_total = 0
+        self.bytes_total = 0
+        self.packets_control = 0
+        self.bytes_control = 0
+        self.packets_data = 0
+        self.bytes_data = 0
+        self.packets_retrans = 0
+        self.bytes_retrans = 0
+
+    def add(self, packet, retransmit: bool = False) -> None:
+        n = packet.total_size
+        self.packets_total += 1
+        self.bytes_total += n
+        if packet.payload_size == 0:
+            self.packets_control += 1
+            self.bytes_control += n
+        else:
+            self.packets_data += 1
+            self.bytes_data += n
+        if retransmit:
+            self.packets_retrans += 1
+            self.bytes_retrans += n
+
+    def snapshot(self) -> Dict[str, int]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+
+class Tracker:
+    def __init__(self, host):
+        self.host = host
+        self.processing_ns = 0
+        self.delay_ns = 0
+        self.delay_count = 0
+        # split local (loopback) vs remote, in vs out
+        self.in_local = _Counters()
+        self.in_remote = _Counters()
+        self.out_local = _Counters()
+        self.out_remote = _Counters()
+        self.drops = 0
+        self.allocated_bytes = 0
+        self.deallocated_bytes = 0
+        self.socket_stats: Dict[int, Dict[str, int]] = defaultdict(dict)
+
+    def add_input_bytes(self, packet, iface_ip: int) -> None:
+        c = self.in_local if iface_ip == LOCALHOST_IP else self.in_remote
+        c.add(packet)
+
+    def add_output_bytes(self, packet, iface_ip: int, retransmit: bool = False) -> None:
+        c = self.out_local if iface_ip == LOCALHOST_IP else self.out_remote
+        c.add(packet, retransmit)
+
+    def add_drop(self, packet) -> None:
+        self.drops += 1
+
+    def add_processing_time(self, ns: int) -> None:
+        self.processing_ns += ns
+
+    def add_virtual_delay(self, ns: int) -> None:
+        self.delay_ns += ns
+        self.delay_count += 1
+
+    def update_socket_stats(self, handle: int, rx_buf: int, rx_len: int,
+                            tx_buf: int, tx_len: int) -> None:
+        self.socket_stats[handle] = {"rx_buffer": rx_buf, "rx_length": rx_len,
+                                     "tx_buffer": tx_buf, "tx_length": tx_len}
+
+    def heartbeat(self, now: int) -> None:
+        r_in, r_out = self.in_remote, self.out_remote
+        get_logger().message(
+            "tracker",
+            f"[shadow-heartbeat] [{self.host.name}] "
+            f"rx={r_in.bytes_total} tx={r_out.bytes_total} "
+            f"rx_pkts={r_in.packets_total} tx_pkts={r_out.packets_total} "
+            f"retrans={r_out.packets_retrans} drops={self.drops} "
+            f"proc_ms={self.processing_ns / 1e6:.3f}",
+            sim_time=now)

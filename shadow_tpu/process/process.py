@@ -1,0 +1,550 @@
+"""Virtual processes: application code running under the simulated kernel.
+
+The reference runs *real binaries* via LD_PRELOAD interposition + rpth green
+threads (host/process.c: 257 process_emu_* syscalls, pth_gctx per process;
+SURVEY.md §2.4/§2.7).  The TPU rebuild keeps that capability split in two
+planes:
+
+* **Python plugin plane (this module)**: apps are Python generator
+  coroutines — the direct analog of rpth green threads under a virtual
+  clock.  Every syscall is a ``yield`` to the simulated kernel
+  (:class:`SyscallAPI`), which either completes it immediately or suspends
+  the green thread until a descriptor status change / timer wakes it —
+  exactly the descriptor->epoll->process_continue resumption chain of the
+  reference (process.c:1197 process_continue).
+* **Native plugin plane** (native/, later rounds): LD_PRELOAD interposer
+  for unmodified C binaries speaking the same virtual-kernel API over IPC.
+
+Determinism: threads resume in creation order; all syscall effects happen at
+the virtual time of the event that woke them.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..core import stime
+from ..core.logger import get_logger
+from ..core.task import Task
+from ..descriptor.base import S_CLOSED, S_READABLE, S_WRITABLE
+
+RUNNABLE = "runnable"
+BLOCKED = "blocked"
+DONE = "done"
+
+
+class _Syscall:
+    """Base class for yielded syscall requests."""
+    __slots__ = ()
+
+
+class _Block(_Syscall):
+    """Block until ``desc`` has any of ``bits`` (or is closed), with an
+    optional timeout.  Resumes with True if the condition fired, False on
+    timeout."""
+    __slots__ = ("desc", "bits", "timeout_ns")
+
+    def __init__(self, desc, bits, timeout_ns: int = -1):
+        self.desc = desc
+        self.bits = bits
+        self.timeout_ns = timeout_ns
+
+
+class _Sleep(_Syscall):
+    __slots__ = ("ns",)
+
+    def __init__(self, ns: int):
+        self.ns = ns
+
+
+class _Stop(_Syscall):
+    __slots__ = ()
+
+
+class GreenThread:
+    _ids = 0
+
+    def __init__(self, process: "Process", gen):
+        GreenThread._ids += 1
+        self.tid = GreenThread._ids
+        self.process = process
+        self.gen = gen
+        self.state = RUNNABLE
+        self.wake_value: Any = None
+        self.wake_exception: Optional[BaseException] = None
+        self._unblock_cb = None  # cleanup for registered waiters
+
+
+class Process:
+    """A virtual process on a Host (reference process.c capability)."""
+
+    def __init__(self, host, name: str, app_main: Callable, args: List[str],
+                 start_time_ns: int, stop_time_ns: int = 0):
+        self.host = host
+        self.name = name
+        self.pid = host.next_process_id()
+        self.app_main = app_main
+        self.args = args
+        self.start_time_ns = start_time_ns
+        self.stop_time_ns = stop_time_ns
+        self.threads: List[GreenThread] = []
+        self.api = SyscallAPI(self)
+        self.running = False
+        self.exited = False
+        self.exit_code: Optional[int] = None
+        self.return_values: Dict[int, Any] = {}
+        self._continue_scheduled = False
+        host.add_process(self)
+
+    # -- lifecycle ---------------------------------------------------------
+    def schedule_start(self, worker) -> None:
+        worker.schedule_task(Task(_process_start_task, self, None,
+                                  name=f"start:{self.name}"),
+                             self.start_time_ns, dst_host=self.host)
+        if self.stop_time_ns > 0:
+            worker.schedule_task(Task(_process_stop_task, self, None,
+                                      name=f"stop:{self.name}"),
+                                 self.stop_time_ns, dst_host=self.host)
+
+    def start(self) -> None:
+        if self.running or self.exited:
+            return
+        self.running = True
+        get_logger().info("process", f"starting process {self.name} (pid {self.pid})")
+        gen = self.app_main(self.api, self.args)
+        if not inspect.isgenerator(gen):
+            # app completed synchronously (no syscalls)
+            self.exited = True
+            self.exit_code = gen if isinstance(gen, int) else 0
+            return
+        self.spawn_thread(gen)
+        self.continue_()
+
+    def stop(self) -> None:
+        if self.exited:
+            return
+        for t in self.threads:
+            if t.state != DONE:
+                t.gen.close()
+                t.state = DONE
+        self._finish(exit_code=0)
+
+    def _finish(self, exit_code: int) -> None:
+        self.exited = True
+        self.running = False
+        self.exit_code = exit_code
+        get_logger().info("process",
+                          f"process {self.name} (pid {self.pid}) exited with {exit_code}")
+        if exit_code != 0 and self.host.engine is not None:
+            self.host.engine.increment_plugin_error()
+
+    # -- green threads -----------------------------------------------------
+    def spawn_thread(self, gen) -> GreenThread:
+        t = GreenThread(self, gen)
+        self.threads.append(t)
+        return t
+
+    def continue_(self) -> None:
+        """Resume all runnable green threads until everything blocks
+        (reference process_continue :1197-1275)."""
+        self._continue_scheduled = False
+        if self.exited:
+            return
+        progressed = True
+        while progressed:
+            progressed = False
+            for t in list(self.threads):
+                if t.state == RUNNABLE:
+                    progressed = True
+                    self._run_thread(t)
+        if all(t.state == DONE for t in self.threads) and not self.exited:
+            main_done = self.threads[0].state == DONE if self.threads else True
+            if main_done:
+                rv = self.return_values.get(self.threads[0].tid) if self.threads else 0
+                self._finish(exit_code=rv if isinstance(rv, int) else 0)
+
+    def _run_thread(self, t: GreenThread) -> None:
+        while t.state == RUNNABLE:
+            try:
+                if t.wake_exception is not None:
+                    exc, t.wake_exception = t.wake_exception, None
+                    req = t.gen.throw(exc)
+                else:
+                    req = t.gen.send(t.wake_value)
+                t.wake_value = None
+            except StopIteration as si:
+                t.state = DONE
+                self.return_values[t.tid] = si.value
+                return
+            except Exception as e:  # app crashed
+                t.state = DONE
+                get_logger().error("process",
+                                   f"process {self.name} thread {t.tid} crashed: {e!r}")
+                import traceback
+                get_logger().debug("process", traceback.format_exc())
+                self._finish(exit_code=1)
+                return
+            self._dispatch(t, req)
+
+    def _dispatch(self, t: GreenThread, req) -> None:
+        from ..core.worker import current_worker
+        w = current_worker()
+        if isinstance(req, _Sleep):
+            t.state = BLOCKED
+            if w is not None:
+                w.schedule_task(Task(_thread_wake_task, (self, t), None,
+                                     name="sleep_wake"), req.ns, dst_host=self.host)
+            return
+        if isinstance(req, _Block):
+            desc, bits = req.desc, req.bits
+            if desc.status & (bits | S_CLOSED):
+                t.wake_value = True  # condition already true; loop continues
+                return
+            t.state = BLOCKED
+            armed = [True]
+
+            def on_status(d, changed, _t=t, _bits=bits):
+                if armed[0] and d.status & (_bits | S_CLOSED):
+                    armed[0] = False
+                    d.remove_listener(on_status)
+                    _t.wake_value = True
+                    self._wake_thread(_t)
+
+            desc.add_listener(on_status)
+            t._unblock_cb = (desc, on_status)
+            if req.timeout_ns >= 0 and w is not None:
+
+                def on_timeout(_pair, _arg, _t=t, _desc=desc):
+                    if armed[0] and _t.state == BLOCKED:
+                        armed[0] = False
+                        _desc.remove_listener(on_status)
+                        _t.wake_value = False
+                        self._wake_thread(_t)
+
+                w.schedule_task(Task(on_timeout, None, None, name="block_timeout"),
+                                req.timeout_ns, dst_host=self.host)
+            return
+        if isinstance(req, _Stop):
+            t.state = DONE
+            return
+        # unknown yield: treat as cooperative yield point
+        t.wake_value = None
+
+    def _wake_thread(self, t: GreenThread) -> None:
+        if t.state != BLOCKED:
+            return
+        t.state = RUNNABLE
+        t._unblock_cb = None
+        self._schedule_continue()
+
+    def _schedule_continue(self) -> None:
+        """Coalesced process_continue wakeup event."""
+        if self._continue_scheduled or self.exited:
+            return
+        from ..core.worker import current_worker
+        w = current_worker()
+        if w is None:
+            self.continue_()
+            return
+        self._continue_scheduled = True
+        w.schedule_task(Task(_process_continue_task, self, None,
+                             name=f"continue:{self.name}"), 0, dst_host=self.host)
+
+
+def _process_start_task(process: Process, _arg) -> None:
+    process.start()
+
+
+def _process_stop_task(process: Process, _arg) -> None:
+    process.stop()
+
+
+def _process_continue_task(process: Process, _arg) -> None:
+    process.continue_()
+
+
+def _thread_wake_task(pair, _arg) -> None:
+    process, t = pair
+    process._wake_thread(t)
+    # sleep wake is itself the continue event
+    process._continue_scheduled = False
+    process.continue_()
+
+
+class SyscallAPI:
+    """The virtual-kernel call surface handed to apps.
+
+    Mirrors (at capability level) the reference's process_emu_* families
+    (process.c:1412-7671): sockets, epoll, timers, time, DNS, random, pipes,
+    sleeping, logging.  Blocking calls are generators — app code uses
+    ``yield from api.recv(fd, n)``; non-blocking variants return immediately.
+    """
+
+    def __init__(self, process: Process):
+        self.process = process
+        self.host = process.host
+
+    # -- time (process.c time family -> worker_getEmulatedTime) -----------
+    def now_ns(self) -> int:
+        from ..core.worker import current_worker
+        w = current_worker()
+        return w.now if w is not None else 0
+
+    def time(self) -> float:
+        """Emulated wall-clock seconds (epoch-offset like the reference)."""
+        return stime.emulated_from_sim(self.now_ns()) / stime.SIM_TIME_SEC
+
+    def sleep(self, seconds: float):
+        yield _Sleep(stime.from_seconds(seconds))
+
+    def usleep(self, usec: int):
+        yield _Sleep(usec * stime.SIM_TIME_US)
+
+    # -- identity / DNS ----------------------------------------------------
+    def gethostname(self) -> str:
+        return self.host.name
+
+    def gethostbyname(self, name: str) -> int:
+        addr = self.host.engine.dns.resolve_name(name)
+        if addr is None:
+            raise OSError(f"EAI_NONAME: unknown host {name!r}")
+        return addr.ip
+
+    def getaddrinfo(self, name: str, port: int) -> Tuple[int, int]:
+        return (self.gethostbyname(name), port)
+
+    # -- random (process.c rand family -> host Random) ---------------------
+    def rand(self) -> int:
+        return self.host.random.next_int(2 ** 31)
+
+    def random_bytes(self, n: int) -> bytes:
+        return self.host.random.next_bytes(n)
+
+    # -- sockets -----------------------------------------------------------
+    def socket(self, kind: str) -> int:
+        host = self.host
+        handle = host.allocate_handle()
+        if kind == "udp":
+            from ..descriptor.udp import UDPSocket
+            sock = UDPSocket(host, handle, host.params.recv_buf_size,
+                             host.params.send_buf_size)
+        elif kind == "tcp":
+            from ..descriptor.tcp import TCPSocket
+            sock = TCPSocket(host, handle, host.params.recv_buf_size,
+                             host.params.send_buf_size)
+        else:
+            raise ValueError(f"unsupported socket kind {kind!r}")
+        host._descriptors[handle] = sock
+        return handle
+
+    def _sock(self, fd: int):
+        s = self.host.descriptor_table_get(fd)
+        if s is None:
+            raise OSError(f"EBADF: {fd}")
+        return s
+
+    def bind(self, fd: int, addr: Tuple[Any, int]) -> None:
+        sock = self._sock(fd)
+        ip = self._resolve(addr[0])
+        port = addr[1]
+        if port == 0:
+            port = self.host.allocate_ephemeral_port(sock.kind, ip)
+        iface = self.host.interface_for_ip(ip)
+        if iface is None:
+            raise OSError("EADDRNOTAVAIL")
+        if iface.is_associated(sock.kind, port):
+            raise OSError("EADDRINUSE")
+        sock.bind_to(iface.address.ip, port)
+        iface.associate(sock, sock.kind, port)
+
+    def _resolve(self, name_or_ip) -> int:
+        if isinstance(name_or_ip, int):
+            return name_or_ip
+        if name_or_ip in ("", "0.0.0.0", None):
+            return self.host.default_address.ip
+        if name_or_ip in ("localhost", "127.0.0.1"):
+            from ..routing.address import LOCALHOST_IP
+            return LOCALHOST_IP
+        try:
+            from ..routing.address import ip_to_int
+            return ip_to_int(name_or_ip)
+        except Exception:
+            return self.gethostbyname(name_or_ip)
+
+    def sendto(self, fd: int, data: bytes, addr: Optional[Tuple[Any, int]] = None) -> int:
+        sock = self._sock(fd)
+        if addr is not None:
+            return sock.send_user_data(data, self._resolve(addr[0]), addr[1])
+        return sock.send_user_data(data)
+
+    def send(self, fd: int, data: bytes):
+        """Blocking send: waits for buffer space (generator)."""
+        sock = self._sock(fd)
+        total = 0
+        view = memoryview(bytes(data))
+        while total < len(view):
+            n = sock.send_user_data(bytes(view[total:]))
+            total += n
+            if total < len(view) and n == 0:
+                yield _Block(sock, S_WRITABLE)
+        return total
+
+    def recvfrom(self, fd: int, nbytes: int = 65536):
+        """Blocking receive (generator): returns (data, (src_ip, src_port))."""
+        sock = self._sock(fd)
+        while True:
+            r = sock.receive_user_data(nbytes)
+            if r is not None:
+                data, ip, port = r
+                return data, (ip, port)
+            if sock.closed or sock.has_status(S_CLOSED):
+                return b"", (0, 0)
+            yield _Block(sock, S_READABLE)
+
+    def recv(self, fd: int, nbytes: int = 65536):
+        data, _ = yield from self.recvfrom(fd, nbytes)
+        return data
+
+    def try_recvfrom(self, fd: int, nbytes: int = 65536):
+        """Non-blocking: None if nothing available."""
+        r = self._sock(fd).receive_user_data(nbytes)
+        if r is None:
+            return None
+        data, ip, port = r
+        return data, (ip, port)
+
+    def close(self, fd: int) -> None:
+        d = self.host.descriptor_table_get(fd)
+        if d is not None:
+            d.close()
+
+    # -- TCP-specific (listen/accept/connect implemented with the TCP stack;
+    # available once descriptor/tcp.py lands) ------------------------------
+    def listen(self, fd: int, backlog: int = 128) -> None:
+        self._sock(fd).listen(backlog)
+
+    def accept(self, fd: int):
+        sock = self._sock(fd)
+        while True:
+            child = sock.accept_child()
+            if child is not None:
+                return child.handle, (child.peer_ip, child.peer_port)
+            yield _Block(sock, S_READABLE)
+
+    def connect(self, fd: int, addr: Tuple[Any, int]):
+        sock = self._sock(fd)
+        ip = self._resolve(addr[0])
+        done = sock.connect_to(ip, addr[1])
+        if not done:
+            yield _Block(sock, S_WRITABLE)
+            err = sock.take_socket_error()
+            if err:
+                raise OSError(err)
+        return 0
+
+    # -- epoll -------------------------------------------------------------
+    def epoll_create(self) -> int:
+        from ..descriptor.epoll import Epoll
+        host = self.host
+        handle = host.allocate_handle()
+        ep = Epoll(host, handle)
+        host._descriptors[handle] = ep
+        return handle
+
+    def epoll_ctl(self, epfd: int, op: str, fd: int, events: int = 0, data=None) -> None:
+        ep = self._sock(epfd)
+        desc = self._sock(fd)
+        if op == "add":
+            ep.ctl_add(desc, events, data if data is not None else fd)
+        elif op == "mod":
+            ep.ctl_mod(desc, events, data if data is not None else fd)
+        elif op == "del":
+            ep.ctl_del(desc)
+        else:
+            raise ValueError(op)
+
+    def epoll_wait(self, epfd: int, timeout_sec: float = -1.0, max_events: int = 64):
+        """Blocking epoll_wait (generator)."""
+        ep = self._sock(epfd)
+        if ep.has_ready():
+            return ep.wait(max_events)
+        if timeout_sec == 0:
+            return []
+        if timeout_sec > 0:
+            deadline = self.now_ns() + stime.from_seconds(timeout_sec)
+            while not ep.has_ready():
+                remaining = deadline - self.now_ns()
+                if remaining <= 0:
+                    break
+                fired = yield _Block(ep, S_READABLE, timeout_ns=remaining)
+                if not fired:
+                    break
+            return ep.wait(max_events)
+        while not ep.has_ready():
+            yield _Block(ep, S_READABLE)
+        return ep.wait(max_events)
+
+    # -- timers ------------------------------------------------------------
+    def timerfd_create(self) -> int:
+        from ..descriptor.timer import Timer
+        host = self.host
+        handle = host.allocate_handle()
+        tm = Timer(host, handle)
+        host._descriptors[handle] = tm
+        return handle
+
+    def timerfd_settime(self, fd: int, initial_sec: float, interval_sec: float = 0.0) -> None:
+        self._sock(fd).arm(stime.from_seconds(initial_sec),
+                           stime.from_seconds(interval_sec))
+
+    def timerfd_read(self, fd: int) -> int:
+        return self._sock(fd).read_expirations()
+
+    # -- pipes -------------------------------------------------------------
+    def pipe(self) -> Tuple[int, int]:
+        from ..descriptor.channel import Channel
+        host = self.host
+        rh, wh = host.allocate_handle(), host.allocate_handle()
+        r, w = Channel.new_pipe(host, rh, wh)
+        host._descriptors[rh] = r
+        host._descriptors[wh] = w
+        return rh, wh
+
+    def socketpair(self) -> Tuple[int, int]:
+        from ..descriptor.channel import Channel
+        host = self.host
+        ha, hb = host.allocate_handle(), host.allocate_handle()
+        a, b = Channel.new_socketpair(host, ha, hb)
+        host._descriptors[ha] = a
+        host._descriptors[hb] = b
+        return ha, hb
+
+    def write(self, fd: int, data: bytes) -> int:
+        return self._sock(fd).send_user_data(data)
+
+    def read(self, fd: int, nbytes: int = 65536):
+        """Blocking read from a pipe/channel (generator)."""
+        d = self._sock(fd)
+        while True:
+            r = d.receive_user_data(nbytes)
+            if r is not None:
+                return r[0]
+            yield _Block(d, S_READABLE)
+
+    # -- threads (pthread family -> green threads) -------------------------
+    def spawn(self, gen_func, *args) -> int:
+        """pthread_create analog: runs another generator coroutine in this
+        process."""
+        t = self.process.spawn_thread(gen_func(*args))
+        return t.tid
+
+    def yield_(self):
+        """Cooperative yield (pth_yield)."""
+        yield None
+
+    # -- logging -----------------------------------------------------------
+    def log(self, text: str) -> None:
+        get_logger().message(f"app/{self.process.name}", text)
+
+

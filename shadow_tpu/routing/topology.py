@@ -151,15 +151,20 @@ class Topology:
                 best[k] = (l, rr)
         self._edge_lat: Dict[Tuple[int, int], float] = {k: v[0] for k, v in best.items()}
         self._edge_rel: Dict[Tuple[int, int], float] = {k: v[1] for k, v in best.items()}
+        # Integer-ns edge weights (ceil per edge, like the reference's final
+        # ms->ns ceil) keep all path sums exact: ns values < 2**53 are exact
+        # in the float64 scipy works in.  +1 per edge keeps zero-latency
+        # edges visible to CSR (scipy drops explicit zeros); the +hop_count
+        # bias is subtracted exactly in finalize()'s integer DP.
+        self._edge_ns: Dict[Tuple[int, int], int] = {
+            k: int(math.ceil(v[0] * stime.SIM_TIME_MS)) for k, v in best.items()}
         if best:
-            rr, cc = zip(*best.keys())
-            ll = [best[k][0] for k in best.keys()]
-            # scipy treats 0 weights as "no edge" in csr; bias by epsilon is
-            # wrong — instead store latency + tiny and subtract hop count
-            # later.  Cleaner: clamp true 0 edge latency to a negligible
-            # 1e-9 ms so connectivity is preserved and sums stay ~exact.
-            ll = [l if l > 0.0 else 1e-9 for l in ll]
-            self._csr = sp.csr_matrix((ll, (rr, cc)), shape=(n, n))
+            keys = list(best.keys())
+            rr = [k[0] for k in keys]
+            cc = [k[1] for k in keys]
+            ww = [self._edge_ns[k] + 1 for k in keys]
+            self._csr = sp.csr_matrix((np.array(ww, dtype=np.float64), (rr, cc)),
+                                      shape=(n, n))
         else:
             self._csr = sp.csr_matrix((n, n))
 
@@ -281,7 +286,7 @@ class Topology:
             return
         A = len(self.attached_vertices)
         n = len(self.vertices)
-        lat_ms = np.zeros((A, A), dtype=np.float64)
+        lat_ns = np.zeros((A, A), dtype=np.int64)
         rel = np.ones((A, A), dtype=np.float64)
 
         if A > 0 and self.is_complete:
@@ -290,22 +295,26 @@ class Topology:
                     if si == dj:
                         l, r = self._self_path(si)
                     else:
-                        l = self._edge_lat[(si, dj)]
+                        l = self._edge_ns[(si, dj)]
                         r = (self._edge_rel[(si, dj)]
                              * (1.0 - self._vloss[si]) * (1.0 - self._vloss[dj]))
-                    lat_ms[i, j] = l
+                    lat_ns[i, j] = l
                     rel[i, j] = r
         elif A > 0:
             from scipy.sparse.csgraph import dijkstra
             srcs = np.array(self.attached_vertices, dtype=np.int64)
             # _csr already contains both arc directions for undirected
-            # graphs, so always treat it as directed here.
+            # graphs, so always treat it as directed here.  Weights are
+            # integer ns + 1 per edge (see __init__); ns-scale values are
+            # exact in float64 and the hop bias is removed exactly below.
             dist, pred = dijkstra(self._csr, directed=True,
                                   indices=srcs, return_predecessors=True)
             for i, si in enumerate(self.attached_vertices):
                 order = np.argsort(dist[i], kind="stable")
-                # reliability DP along each predecessor chain, in distance order
+                # DP along each predecessor chain in distance order:
+                # reliability product and exact hop count.
                 relpath = np.full(n, np.nan)
+                hops = np.zeros(n, dtype=np.int64)
                 relpath[si] = 1.0
                 for v in order:
                     if not np.isfinite(dist[i][v]) or v == si:
@@ -315,17 +324,18 @@ class Topology:
                         continue
                     relpath[v] = relpath[p] * self._edge_rel.get((p, v),
                                     self._edge_rel.get((v, p), 1.0))
+                    hops[v] = hops[p] + 1
                 for j, dj in enumerate(self.attached_vertices):
                     if si == dj:
                         l, r = self._self_path(si)
-                        lat_ms[i, j] = l
+                        lat_ns[i, j] = l
                         rel[i, j] = r
                         continue
-                    if self.prefer_direct_paths and (si, dj) in self._edge_lat:
+                    if self.prefer_direct_paths and (si, dj) in self._edge_ns:
                         # preferdirectpaths graphs use the direct edge for
                         # adjacent pairs even when a multi-hop path is
                         # shorter (reference topology.c:2019, :1877-1928).
-                        lat_ms[i, j] = self._edge_lat[(si, dj)]
+                        lat_ns[i, j] = self._edge_ns[(si, dj)]
                         rel[i, j] = (self._edge_rel[(si, dj)]
                                      * (1.0 - self._vloss[si]) * (1.0 - self._vloss[dj]))
                         continue
@@ -334,14 +344,13 @@ class Topology:
                         raise ValueError(
                             f"no path between attached vertices "
                             f"{self.vertices[si].gid} and {self.vertices[dj].gid}")
-                    lat_ms[i, j] = d
+                    lat_ns[i, j] = int(d) - int(hops[dj])  # exact integer ns
                     rel[i, j] = (relpath[dj] * (1.0 - self._vloss[si])
                                  * (1.0 - self._vloss[dj]))
 
-        # 0ms -> 1ms clamp (reference topology.c:1848-1852), then ms -> ns
-        # with ceil (worker.c:276) so device int64 math is exact.
-        lat_ms = np.where(lat_ms <= 1e-6, 1.0, lat_ms)
-        self.latency_ns = np.ceil(lat_ms * stime.SIM_TIME_MS).astype(np.int64)
+        # 0 -> 1ms clamp (reference topology.c:1848-1852 clamps zero-latency
+        # shortest paths to 1 ms).
+        self.latency_ns = np.where(lat_ns <= 0, stime.SIM_TIME_MS, lat_ns).astype(np.int64)
         self.reliability = np.clip(rel, 0.0, 1.0).astype(np.float32)
         self.path_packet_counts = np.zeros((A, A), dtype=np.int64)
         if A > 0:
@@ -353,17 +362,18 @@ class Topology:
             f"min latency {self.min_latency_ns / 1e6:.3f} ms, "
             f"{'complete' if self.is_complete else 'sparse'} graph")
 
-    def _self_path(self, vertex_index: int) -> Tuple[float, float]:
-        """Cheapest incident edge used twice (topology.c:1545-1653)."""
+    def _self_path(self, vertex_index: int) -> Tuple[int, float]:
+        """Cheapest incident edge used twice (topology.c:1545-1653).
+        Returns (latency_ns, reliability)."""
         best_lat, best_rel = None, 1.0
-        for (u, w), l in self._edge_lat.items():
+        for (u, w), l in self._edge_ns.items():
             if u == vertex_index or w == vertex_index:
                 if best_lat is None or l < best_lat:
                     best_lat = l
                     best_rel = self._edge_rel[(u, w)]
         if best_lat is None:
-            return 1.0, 1.0  # isolated vertex: minimal 1ms self path
-        return 2.0 * best_lat, best_rel * best_rel
+            return stime.SIM_TIME_MS, 1.0  # isolated vertex: 1ms self path
+        return 2 * best_lat, best_rel * best_rel
 
     # -- queries (CPU side) ------------------------------------------------
     def row_for_ip(self, ip: int) -> Optional[int]:

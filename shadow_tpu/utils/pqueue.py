@@ -35,20 +35,24 @@ class PriorityQueue(Generic[T]):
         if id(item) in self._entries:
             # Re-push = reschedule: drop the stale heap entry so one item
             # never has two live entries (the membership hash the reference's
-            # priority_queue.c maintains for the same reason).
-            self.remove(item)
+            # priority_queue.c maintains for the same reason).  Calls the
+            # unlocked helper so AsyncPriorityQueue.push doesn't self-deadlock.
+            self._remove_impl(item)
         entry = [key, self._count, item, True]
         self._count += 1
         self._entries[id(item)] = entry
         heapq.heappush(self._heap, entry)
 
-    def remove(self, item: T) -> bool:
+    def _remove_impl(self, item: T) -> bool:
         entry = self._entries.pop(id(item), None)
         if entry is None:
             return False
         entry[3] = False
         entry[2] = None
         return True
+
+    def remove(self, item: T) -> bool:
+        return self._remove_impl(item)
 
     def __contains__(self, item: T) -> bool:
         return id(item) in self._entries
@@ -93,7 +97,7 @@ class AsyncPriorityQueue(PriorityQueue[T]):
 
     def remove(self, item: T) -> bool:
         with self._lock:
-            return super().remove(item)
+            return self._remove_impl(item)
 
     def peek(self) -> Optional[T]:
         with self._lock:
