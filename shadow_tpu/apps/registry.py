@@ -28,4 +28,4 @@ def resolve(path: str) -> Callable:
 
 
 def _ensure_builtins() -> None:
-    from . import echo, filetransfer, tgen, phold  # noqa: F401
+    from . import echo, filetransfer, tgen, phold, blast  # noqa: F401

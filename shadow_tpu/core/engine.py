@@ -179,6 +179,14 @@ class Engine:
         log.flush()
         return 1 if self.plugin_errors else 0
 
+    def _flush_round(self) -> None:
+        """Round-boundary hook for batching policies (tpu): run the device
+        step for the packets sent this round and push their delivery events
+        before the next window is computed."""
+        flush = getattr(self.scheduler.policy, "flush_round", None)
+        if flush is not None:
+            flush(self)
+
     def _advance_window(self, lookahead: int) -> bool:
         nxt = self.scheduler.next_event_time()
         if nxt >= self.end_time or nxt >= stime.SIM_TIME_MAX:
@@ -194,6 +202,7 @@ class Engine:
             while self._advance_window(lookahead):
                 worker.round_end = self.scheduler.window_end
                 worker.run_round()
+                self._flush_round()
                 self.rounds_executed += 1
                 get_logger().flush()
             self.events_executed = worker.counters._free.get("event", 0)
@@ -232,6 +241,7 @@ class Engine:
                 start_latch.reset()
                 done_latch.count_down_await()
                 done_latch.reset()
+                self._flush_round()
                 self.rounds_executed += 1
                 get_logger().flush()
         finally:

@@ -101,6 +101,11 @@ class Worker:
         """
         if not self.engine.is_running():
             return
+        offer = getattr(self.scheduler.policy, "offer_packet", None)
+        if offer is not None:
+            # tpu policy: defer the hop to the round's batched device step
+            offer(packet, self)
+            return
         topo = self.engine.topology
         src_ip, dst_ip = packet.src_ip, packet.dst_ip
         reliability = topo.reliability_ip(src_ip, dst_ip)

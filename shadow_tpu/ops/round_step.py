@@ -1,0 +1,169 @@
+"""The per-round device kernel: all packet hops in a window as one jitted step.
+
+Reference hot path (worker.c:243-304 ``worker_sendPacket``): for EACH packet,
+look up path reliability, draw a uniform, maybe drop, look up path latency,
+schedule delivery.  That is a per-packet scalar pipeline; on TPU the same
+work is one batched step over the round's whole packet set:
+
+    latency  = L[src_row, dst_row]          # int64 ns gather
+    rel      = R[src_row, dst_row]          # f32 gather
+    u        = threefry(drop_key, uid)      # counter-based, order-independent
+    keep     = bootstrap | rel >= 1 | u <= rel
+    deliver  = send_time + latency          # int64 ns, exact
+
+Determinism contract: the uniform is keyed by the packet uid, not execution
+order, and is the bitwise-identical construction the CPU policies use
+(core/rng.py), so the CPU and TPU schedulers drop exactly the same packets
+and compute exactly the same delivery times (int64 ns math on device; x64
+is enabled by the ops package __init__).
+
+Dynamic per-round packet counts vs XLA static shapes (SURVEY.md §7 hard
+part d): batches are padded to power-of-two buckets with a validity mask, so
+each bucket size compiles once and is reused.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rng import threefry2x32_jnp
+
+MIN_BUCKET = 256
+
+
+def bucket_size(n: int) -> int:
+    """Smallest power-of-two bucket >= n (min MIN_BUCKET) — bounds the number
+    of distinct compiled shapes to log2(max_batch)."""
+    b = MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _uniform_from_uid(key_lo: jnp.ndarray, key_hi: jnp.ndarray,
+                      uid_lo: jnp.ndarray, uid_hi: jnp.ndarray) -> jnp.ndarray:
+    """f32 uniform in [0,1) from the 64-bit drop key and 64-bit packet uid.
+    Same 24-bit-mantissa construction as core.rng.uniform_np, so comparisons
+    against f32 reliability values decide identically on CPU and device."""
+    x0, _ = threefry2x32_jnp(key_lo, key_hi, uid_lo, uid_hi)
+    return (x0 >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+@partial(jax.jit, donate_argnums=())
+def packet_hop_step(latency_ns: jnp.ndarray,     # int64 [A, A]
+                    reliability: jnp.ndarray,    # f32   [A, A]
+                    src_rows: jnp.ndarray,       # int32 [N]
+                    dst_rows: jnp.ndarray,       # int32 [N]
+                    uid_lo: jnp.ndarray,         # uint32 [N]
+                    uid_hi: jnp.ndarray,         # uint32 [N]
+                    send_times: jnp.ndarray,     # int64 [N]
+                    valid: jnp.ndarray,          # bool  [N]
+                    key_lo: jnp.ndarray,         # uint32 scalar
+                    key_hi: jnp.ndarray,         # uint32 scalar
+                    bootstrap_end: jnp.ndarray,  # int64 scalar
+                    barrier: jnp.ndarray,        # int64 scalar (round end clamp)
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One device step for a padded packet batch.
+
+    Returns (deliver_times int64 [N], keep bool [N]).  Invalid (padding) lanes
+    come back keep=False.  The barrier clamp mirrors the cross-host push clamp
+    (reference scheduler_policy_host_steal.c:225-242) — a safety net that
+    never fires when lookahead == min path latency.
+    """
+    lat = latency_ns[src_rows, dst_rows]
+    rel = reliability[src_rows, dst_rows]
+    u = _uniform_from_uid(key_lo, key_hi, uid_lo, uid_hi)
+    bootstrapping = send_times < bootstrap_end
+    keep = (bootstrapping | (rel >= jnp.float32(1.0)) | (u <= rel)) & valid
+    deliver = jnp.maximum(send_times + lat, barrier)
+    return deliver, keep
+
+
+class PacketHopKernel:
+    """Host-side wrapper owning the device-resident topology tensors and the
+    drop key; turns a round's (src_row, dst_row, uid, send_time) arrays into
+    (deliver_time, keep) numpy arrays with one device call."""
+
+    def __init__(self, topology, drop_key: int, bootstrap_end_ns: int):
+        lat, rel = topology.device_tensors()
+        self.latency = lat
+        self.reliability = rel
+        kv = int(drop_key) & 0xFFFFFFFFFFFFFFFF
+        self.key_lo = jnp.uint32(kv & 0xFFFFFFFF)
+        self.key_hi = jnp.uint32((kv >> 32) & 0xFFFFFFFF)
+        self.bootstrap_end = jnp.int64(bootstrap_end_ns)
+        self.device_calls = 0
+
+    def step(self, src_rows: np.ndarray, dst_rows: np.ndarray,
+             uids: np.ndarray, send_times: np.ndarray,
+             barrier_ns: int) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(src_rows)
+        if n == 0:
+            return (np.empty(0, dtype=np.int64), np.empty(0, dtype=bool))
+        b = bucket_size(n)
+
+        def pad(a, fill=0):
+            out = np.full(b, fill, dtype=a.dtype)
+            out[:n] = a
+            return out
+
+        uids = np.asarray(uids, dtype=np.uint64)
+        valid = np.zeros(b, dtype=bool)
+        valid[:n] = True
+        deliver, keep = packet_hop_step(
+            self.latency, self.reliability,
+            jnp.asarray(pad(np.asarray(src_rows, dtype=np.int32))),
+            jnp.asarray(pad(np.asarray(dst_rows, dtype=np.int32))),
+            jnp.asarray(pad((uids & np.uint64(0xFFFFFFFF)).astype(np.uint32))),
+            jnp.asarray(pad((uids >> np.uint64(32)).astype(np.uint32))),
+            jnp.asarray(pad(np.asarray(send_times, dtype=np.int64))),
+            jnp.asarray(valid),
+            self.key_lo, self.key_hi, self.bootstrap_end,
+            jnp.int64(barrier_ns))
+        self.device_calls += 1
+        return (np.asarray(deliver)[:n], np.asarray(keep)[:n])
+
+
+# ---------------------------------------------------------------------------
+# Multi-chip round step: the packet batch is sharded across the mesh (the
+# simulator's data-parallel axis); the path matrices are replicated (attached
+# vertex counts are small even for 10k-host graphs — SURVEY.md §3.5) or, for
+# huge graphs, row-sharded with an all-gather.  The per-shard min next event
+# time is combined with a psum-style collective over ICI, mirroring the
+# round-barrier reduction the CPU scheduler does with latches
+# (scheduler.c:359-414).
+# ---------------------------------------------------------------------------
+
+def make_sharded_hop_step(mesh, batch_axis: str = "pkt"):
+    """Build a pjit-ed round step over ``mesh``: batch sharded on
+    ``batch_axis``, matrices replicated, plus a global min-deliver-time
+    reduction (the next-round-window collective)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    batch_sharded = NamedSharding(mesh, P(batch_axis))
+    replicated = NamedSharding(mesh, P())
+
+    @partial(jax.jit,
+             in_shardings=(replicated, replicated,
+                           batch_sharded, batch_sharded, batch_sharded,
+                           batch_sharded, batch_sharded, batch_sharded,
+                           replicated, replicated, replicated, replicated),
+             out_shardings=(batch_sharded, batch_sharded, replicated))
+    def sharded_step(latency_ns, reliability, src_rows, dst_rows,
+                     uid_lo, uid_hi, send_times, valid,
+                     key_lo, key_hi, bootstrap_end, barrier):
+        deliver, keep = packet_hop_step(
+            latency_ns, reliability, src_rows, dst_rows, uid_lo, uid_hi,
+            send_times, valid, key_lo, key_hi, bootstrap_end, barrier)
+        # Global min over the sharded batch => XLA inserts the cross-device
+        # reduction (the ICI collective replacing the CPU latch barrier).
+        next_time = jnp.min(jnp.where(keep, deliver, jnp.int64(2**62)))
+        return deliver, keep, next_time
+
+    return sharded_step

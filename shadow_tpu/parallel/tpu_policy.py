@@ -1,0 +1,126 @@
+"""The ``tpu`` scheduler policy: per-host event queues + device-batched hops.
+
+This is the seventh scheduler policy (SURVEY.md §2.2; the reference's six
+live in core/scheduler.py).  Event storage and popping are identical to the
+``host`` policy; what changes is the inter-host packet hop
+(worker.c:243-304): instead of a per-packet reliability draw + latency
+lookup on the CPU, packets sent during a round are appended to a batch, and
+at the round barrier ONE jitted device step (ops/round_step.py) computes
+every drop decision and delivery time at once.  CPU<->TPU exchange happens
+only at round boundaries — the conservative lookahead window guarantees no
+intra-round causality violation, the same argument the reference's
+host-steal policy uses for its cross-host barrier clamp
+(scheduler_policy_host_steal.c:229-242).
+
+Parity: drops are keyed by packet uid through the same threefry cipher the
+CPU policies use, so a simulation under ``tpu`` delivers/drops exactly the
+same packets at exactly the same times as under ``global``/``steal``
+(asserted by tests/test_tpu_policy.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core.scheduler import HostQueuesPolicy
+from ..core.event import Event
+from ..core.task import Task
+from ..core.worker import _deliver_packet_task
+
+
+class TPUPolicy(HostQueuesPolicy):
+    def __init__(self):
+        super().__init__()
+        self._batch_lock = threading.Lock()
+        # pending hop: (packet, src_host, dst_host, seq, send_time)
+        self._pending: List[Tuple] = []
+        self._kernel = None
+        self._rows_by_ip = {}
+        self.packets_batched = 0
+        self.packets_dropped = 0
+
+    # -- worker-facing batching -------------------------------------------
+    def offer_packet(self, packet, worker) -> bool:
+        """Append a packet hop to the round batch (called from
+        Worker.send_packet in place of the scalar CPU path).  The source-host
+        event sequence id is claimed NOW so the deterministic order tuple
+        (time, dst, src, seq) reflects send order, as on the CPU path."""
+        engine = worker.engine
+        dst_host = engine.host_by_ip(packet.dst_ip)
+        if dst_host is None:
+            packet.add_status("INET_DROPPED")
+            return True
+        src_host = worker.active_host
+        seq_owner = src_host if src_host is not None else dst_host
+        seq = seq_owner.next_event_sequence()
+        with self._batch_lock:
+            self._pending.append(
+                (packet, src_host, dst_host, seq, worker.now))
+        self.packets_batched += 1
+        return True
+
+    # -- round-boundary flush ---------------------------------------------
+    def _ensure_kernel(self, engine):
+        if self._kernel is None:
+            from ..ops.round_step import PacketHopKernel
+            topo = engine.topology
+            self._kernel = PacketHopKernel(
+                topo, engine._drop_key, engine.bootstrap_end)
+            self._rows = topo  # row lookups go through topology
+        return self._kernel
+
+    def flush_round(self, engine) -> int:
+        """Run the device step for the round's batch and push the surviving
+        delivery events.  Called by the engine once per round, after workers
+        drain and before the next window is computed."""
+        with self._batch_lock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return 0
+        kernel = self._ensure_kernel(engine)
+        topo = engine.topology
+        n = len(pending)
+        src_rows = np.empty(n, dtype=np.int32)
+        dst_rows = np.empty(n, dtype=np.int32)
+        uids = np.empty(n, dtype=np.uint64)
+        send_times = np.empty(n, dtype=np.int64)
+        for i, (pkt, _s, _d, _q, t) in enumerate(pending):
+            src_rows[i] = topo.row_for_ip(pkt.src_ip)
+            dst_rows[i] = topo.row_for_ip(pkt.dst_ip)
+            uids[i] = pkt.uid
+            send_times[i] = t
+
+        barrier = engine.scheduler.window_end
+        deliver, keep = kernel.step(src_rows, dst_rows, uids, send_times, barrier)
+
+        delivered = 0
+        end_time = engine.end_time
+        for i, (pkt, src_host, dst_host, seq, _t) in enumerate(pending):
+            if not keep[i]:
+                pkt.add_status("INET_DROPPED")
+                engine.count_packet_drop(pkt)
+                self.packets_dropped += 1
+                continue
+            # per-path packet accounting, as the CPU latency lookup does
+            topo.path_packet_counts[src_rows[i], dst_rows[i]] += 1
+            t = int(deliver[i])
+            if t >= end_time:
+                continue
+            pkt.add_status("INET_SENT")
+            task = Task(_deliver_packet_task, dst_host, pkt,
+                        name="deliver_packet")
+            ev = Event(task, t, dst_host, src_host, seq)
+            engine.counters.count_new("event")
+            super().push(ev, 0, barrier)
+            delivered += 1
+        return delivered
+
+    def next_time(self) -> int:
+        # A non-empty batch means there are future deliveries not yet pushed;
+        # flush_round always runs before next_time in the engine loop, so the
+        # base implementation is correct — assert the contract in debug runs.
+        assert not self._pending, "flush_round must run before next_time"
+        return super().next_time()

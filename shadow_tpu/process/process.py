@@ -94,6 +94,7 @@ class Process:
         self.exited = False
         self.exit_code: Optional[int] = None
         self.return_values: Dict[int, Any] = {}
+        self.app_state: Any = None  # apps may park observable state here (tests)
         self._continue_scheduled = False
         host.add_process(self)
 

@@ -1,0 +1,128 @@
+"""CPU<->TPU scheduler-policy parity (SURVEY.md §7 stage 5 verification).
+
+The ``tpu`` policy batches every inter-host packet hop of a round into one
+jitted device step.  Because drop draws are keyed by packet uid through the
+same threefry cipher on both paths, a simulation must produce IDENTICAL
+results (same packets dropped, same delivery times, same app behavior) under
+``global`` (scalar CPU hops) and ``tpu`` (batched device hops).  This is the
+event-order-parity gate from BASELINE.md, reference analog:
+src/test/determinism + strip_log_for_compare.py.
+"""
+
+import textwrap
+
+import numpy as np
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+
+LOSSY_TOPOLOGY = textwrap.dedent("""\
+    <graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+      <key id="d0" for="node" attr.name="bandwidthdown" attr.type="int"/>
+      <key id="d1" for="node" attr.name="bandwidthup" attr.type="int"/>
+      <key id="d2" for="edge" attr.name="latency" attr.type="double"/>
+      <key id="d3" for="edge" attr.name="packetloss" attr.type="double"/>
+      <key id="d4" for="node" attr.name="ip" attr.type="string"/>
+      <graph edgedefault="undirected">
+        <node id="a"><data key="d0">10240</data><data key="d1">10240</data>
+              <data key="d4">11.0.0.1</data></node>
+        <node id="b"><data key="d0">10240</data><data key="d1">10240</data>
+              <data key="d4">11.0.0.2</data></node>
+        <edge source="a" target="b">
+          <data key="d2">25.0</data><data key="d3">0.15</data>
+        </edge>
+        <edge source="a" target="a"><data key="d2">1.0</data></edge>
+        <edge source="b" target="b"><data key="d2">1.0</data></edge>
+      </graph>
+    </graphml>
+""")
+
+
+def make_config(n_msgs=40, stoptime=120):
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="{stoptime}">
+          <topology><![CDATA[{LOSSY_TOPOLOGY}]]></topology>
+          <plugin id="src" path="python:source" />
+          <plugin id="sink" path="python:sink" />
+          <host id="server" iphint="11.0.0.1">
+            <process plugin="sink" starttime="1" arguments="udp 8000" />
+          </host>
+          <host id="client" iphint="11.0.0.2">
+            <process plugin="src"
+                     starttime="2" arguments="udp server 8000 {n_msgs} 256 0.05" />
+          </host>
+        </shadow>
+    """)
+    return configuration.parse_xml(xml)
+
+
+def run_policy(policy, workers=0, seed=11):
+    cfg = make_config()
+    opts = Options(scheduler_policy=policy, workers=workers,
+                   stop_time_sec=cfg.stop_time_sec, seed=seed)
+    ctrl = Controller(opts, cfg)
+    rc = ctrl.run()
+    assert rc == 0
+    server = ctrl.engine.host_by_name("server")
+    client = ctrl.engine.host_by_name("client")
+    sink_proc = server.processes[0]
+    received = getattr(sink_proc.app_state, "received", None)
+    return {
+        "server_in": server.tracker.in_remote.packets_data,
+        "client_out": client.tracker.out_remote.packets_data,
+        "drops": ctrl.engine.counters._new.get("packet_drop", 0),
+        "received": received,
+        "rounds": ctrl.engine.rounds_executed,
+        "ctrl": ctrl,
+    }
+
+
+def test_tpu_policy_matches_cpu_exactly():
+    cpu = run_policy("global")
+    tpu = run_policy("tpu")
+    # drops keyed by uid => identical loss pattern, identical arrivals
+    assert cpu["drops"] > 0, "test must exercise lossy links"
+    assert tpu["drops"] == cpu["drops"]
+    assert tpu["server_in"] == cpu["server_in"]
+    assert tpu["client_out"] == cpu["client_out"]
+    assert tpu["rounds"] == cpu["rounds"]
+
+
+def test_tpu_policy_delivery_times_match():
+    """Arrival timestamps recorded by the sink are bit-identical."""
+    cpu = run_policy("global")
+    tpu = run_policy("tpu")
+    cpu_times = getattr(cpu["ctrl"].engine.host_by_name("server")
+                        .processes[0].app_state, "arrival_times", None)
+    tpu_times = getattr(tpu["ctrl"].engine.host_by_name("server")
+                        .processes[0].app_state, "arrival_times", None)
+    if cpu_times is None:
+        # sink app does not record times; fall back to tracker byte counts
+        assert cpu["server_in"] == tpu["server_in"]
+        return
+    assert list(cpu_times) == list(tpu_times)
+
+
+def test_tpu_policy_deterministic_double_run():
+    a = run_policy("tpu")
+    b = run_policy("tpu")
+    assert (a["drops"], a["server_in"], a["rounds"]) == \
+           (b["drops"], b["server_in"], b["rounds"])
+
+
+def test_tpu_policy_seed_sensitivity():
+    a = run_policy("tpu", seed=11)
+    b = run_policy("tpu", seed=12)
+    # different seed => different uid keys is NOT true (uids are structural);
+    # but the drop key derives from the seed, so the loss pattern changes
+    assert (a["drops"], a["server_in"]) != (b["drops"], b["server_in"]) or \
+        a["drops"] == 0
+
+
+def test_bucketing_compiles_once_per_size():
+    from shadow_tpu.ops.round_step import bucket_size
+    assert bucket_size(1) == 256
+    assert bucket_size(256) == 256
+    assert bucket_size(257) == 512
+    assert bucket_size(5000) == 8192
