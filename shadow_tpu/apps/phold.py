@@ -21,12 +21,26 @@ def main(api, args):
     fd = api.socket("udp")
     api.bind(fd, ("0.0.0.0", port))
 
+    name = api.gethostname()
+    try:
+        # quantity-expanded names are phold1..pholdN; quantity=1 gives bare
+        # "phold"; traffic injectors may have unrelated names
+        me_idx = int(name[5:]) - 1 if name.startswith("phold") and name[5:] else -1
+    except ValueError:
+        me_idx = -1
+
     def pick_peer():
-        # deterministic per-host random peer (host-seeded RNG)
-        k = api.rand() % n_hosts
+        # deterministic per-host random peer, never self (classic PHOLD:
+        # every hop forwards the message, keeping the population constant)
+        if n_hosts <= 1 or me_idx < 0:
+            k = api.rand() % n_hosts if n_hosts > 0 else 0
+        else:
+            k = api.rand() % (n_hosts - 1)
+            if k >= me_idx:
+                k += 1
         return f"phold{k + 1}"
 
-    me = api.gethostname()
+    me = name
     for _ in range(seed_msgs):
         peer = pick_peer()
         if peer != me:

@@ -48,13 +48,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError as e:
             print(f"error: bad config {opts.config_path}: {e}", file=sys.stderr)
             return 2
-        if opts.stop_time_sec:
-            cfg.stop_time_sec = cfg.stop_time_sec or opts.stop_time_sec
     else:
         print("error: provide a config file or --test", file=sys.stderr)
         return 2
-    # CLI overrides config where explicitly provided
-    if opts.stop_time_sec and opts.stop_time_sec != 60:
+    # an explicit --stop-time wins over the config; the config wins over the
+    # Options default
+    if opts.stop_time_explicit:
+        cfg.stop_time_sec = opts.stop_time_sec
+    elif not cfg.stop_time_sec:
         cfg.stop_time_sec = opts.stop_time_sec
     if opts.bootstrap_end_sec:
         cfg.bootstrap_end_sec = opts.bootstrap_end_sec

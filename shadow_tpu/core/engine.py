@@ -215,6 +215,7 @@ class Engine:
         start_latch = CountDownLatch(n + 1)
         done_latch = CountDownLatch(n + 1)
         stop_flag = {"stop": False}
+        errors: List[BaseException] = []
         workers = [Worker(i, self) for i in range(n)]
 
         def body(worker: Worker) -> None:
@@ -224,8 +225,11 @@ class Engine:
                     start_latch.count_down_await()
                     if stop_flag["stop"]:
                         break
-                    worker.round_end = self.scheduler.window_end
-                    worker.run_round()
+                    try:
+                        worker.round_end = self.scheduler.window_end
+                        worker.run_round()
+                    except BaseException as e:  # surface, don't deadlock the latch
+                        errors.append(e)
                     done_latch.count_down_await()
             finally:
                 worker.finish()
@@ -241,6 +245,8 @@ class Engine:
                 start_latch.reset()
                 done_latch.count_down_await()
                 done_latch.reset()
+                if errors:
+                    raise errors[0]
                 self._flush_round()
                 self.rounds_executed += 1
                 get_logger().flush()

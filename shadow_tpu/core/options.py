@@ -28,6 +28,7 @@ class Options:
     runahead_ms: int = 0                 # --runahead (0 = derive from topology; floor 10ms)
     bootstrap_end_sec: int = 0           # <shadow bootstraptime>: grace period, no drops
     stop_time_sec: int = 60              # <shadow stoptime>
+    stop_time_explicit: bool = False     # --stop-time given on the CLI
     # TCP
     tcp_congestion_control: str = "reno"  # --tcp-congestion-control
     tcp_ssthresh: int = 0                 # --tcp-ssthresh (0 = unset)
@@ -107,4 +108,5 @@ def parse_args(argv: Optional[List[str]] = None) -> Options:
         v = getattr(ns, f.name, None)
         if v is not None:
             setattr(opts, f.name, v)
+    opts.stop_time_explicit = ns.stop_time_sec is not None
     return opts

@@ -50,17 +50,25 @@ class SchedulerPolicy:
     def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
         raise NotImplementedError
 
+    def done(self, event: Event, worker_id: int) -> None:
+        """Called by the worker after executing a popped event (lets policies
+        that migrate hosts know the host's state is no longer in use)."""
+
     def next_time(self) -> int:
         """Min event time across all queues (for the next round window)."""
         raise NotImplementedError
 
 
 class GlobalSinglePolicy(SchedulerPolicy):
-    """One unlocked pqueue, one thread (scheduler_policy_global_single.c)."""
+    """One global pqueue drained by worker 0 only — the serial total-order
+    policy (scheduler_policy_global_single.c).  Locked so stray pushes from
+    other threads (e.g. a misconfigured --workers N run) stay safe; pops from
+    workers other than 0 return nothing, preserving the serial guarantee."""
 
     def __init__(self):
         self.queue: PriorityQueue = PriorityQueue()
         self.hosts: List = []
+        self._lock = threading.Lock()
 
     def add_host(self, host, worker_id: int) -> None:
         self.hosts.append(host)
@@ -71,16 +79,21 @@ class GlobalSinglePolicy(SchedulerPolicy):
     def push(self, event: Event, worker_id: int, barrier: int) -> None:
         if event.dst_host is not event.src_host and event.time < barrier:
             event.time = barrier
-        self.queue.push(event)
+        with self._lock:
+            self.queue.push(event)
 
     def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
-        key = self.queue.peek_key()
-        if key is None or key[0] >= window_end:
+        if worker_id != 0:
             return None
-        return self.queue.pop()
+        with self._lock:
+            key = self.queue.peek_key()
+            if key is None or key[0] >= window_end:
+                return None
+            return self.queue.pop()
 
     def next_time(self) -> int:
-        key = self.queue.peek_key()
+        with self._lock:
+            key = self.queue.peek_key()
         return key[0] if key is not None else stime.SIM_TIME_MAX
 
 
@@ -94,10 +107,27 @@ class HostQueuesPolicy(SchedulerPolicy):
         self._host_locks: Dict[int, threading.Lock] = {}
         self._assignment: Dict[int, List] = {}       # worker -> hosts
         self._host_worker: Dict[int, int] = {}       # host id -> worker
+        self._create_lock = threading.Lock()         # lazy queue creation
+        # Per-host execution locks, held from pop() to done(): a host's
+        # events never execute on two threads at once, even across a
+        # work-stealing migration (the reference guarantees this with its
+        # unprocessed/processed host lists + ordered dual-locking,
+        # scheduler_policy_host_steal.c:366-416).
+        self._exec_locks: Dict[int, threading.Lock] = {}
+
+    def _queue_for_host(self, hid: int) -> PriorityQueue:
+        q = self._host_queues.get(hid)
+        if q is None:
+            with self._create_lock:
+                q = self._host_queues.get(hid)
+                if q is None:
+                    self._host_locks[hid] = threading.Lock()
+                    self._exec_locks[hid] = threading.Lock()
+                    q = self._host_queues[hid] = PriorityQueue()
+        return q
 
     def add_host(self, host, worker_id: int) -> None:
-        self._host_queues[host.id] = PriorityQueue()
-        self._host_locks[host.id] = threading.Lock()
+        self._queue_for_host(host.id)
         self._assignment.setdefault(worker_id, []).append(host)
         self._host_worker[host.id] = worker_id
 
@@ -108,38 +138,61 @@ class HostQueuesPolicy(SchedulerPolicy):
         if event.dst_host is not event.src_host and event.time < barrier:
             event.time = barrier
         hid = event.dst_host.id if event.dst_host is not None else -1
-        if hid not in self._host_queues:
-            self._host_queues[hid] = PriorityQueue()
-            self._host_locks[hid] = threading.Lock()
+        q = self._queue_for_host(hid)
         with self._host_locks[hid]:
-            self._host_queues[hid].push(event)
+            q.push(event)
 
     def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
         # pop the earliest event among this worker's hosts, honoring the
         # global order key so same-window events execute deterministically
         # per host (cross-host order within a window is free, as in the
         # reference — causality is guaranteed by the lookahead window).
-        best = None
-        best_key = None
-        for host in self._assignment.get(worker_id, []):
-            q = self._host_queues[host.id]
-            with self._host_locks[host.id]:
-                key = q.peek_key()
-            if key is not None and key[0] < window_end:
-                if best_key is None or key < best_key:
-                    best, best_key = host, key
-        # also drain the detached (-1) queue from worker 0
-        if worker_id == 0 and -1 in self._host_queues:
-            with self._host_locks[-1]:
-                key = self._host_queues[-1].peek_key()
-            if key is not None and key[0] < window_end and (
-                    best_key is None or key < best_key):
+        excluded: set = set()
+        while True:
+            best = None
+            best_key = None
+            for host in list(self._assignment.get(worker_id, [])):
+                if host.id in excluded:
+                    continue
+                q = self._host_queues[host.id]
+                with self._host_locks[host.id]:
+                    key = q.peek_key()
+                if key is not None and key[0] < window_end:
+                    if best_key is None or key < best_key:
+                        best, best_key = host, key
+            # also drain the detached (-1) queue from worker 0
+            if worker_id == 0 and -1 in self._host_queues:
                 with self._host_locks[-1]:
-                    return self._host_queues[-1].pop()
-        if best is None:
-            return None
-        with self._host_locks[best.id]:
-            return self._host_queues[best.id].pop()
+                    key = self._host_queues[-1].peek_key()
+                    if key is not None and key[0] < window_end and (
+                            best_key is None or key < best_key):
+                        return self._host_queues[-1].pop()
+            if best is None:
+                return None
+            exec_lock = self._exec_locks[best.id]
+            if not exec_lock.acquire(blocking=False):
+                # another thread is mid-event on this host (stealing race);
+                # look at the remaining hosts instead
+                excluded.add(best.id)
+                continue
+            with self._host_locks[best.id]:
+                # re-check under the queue lock: a thief may have drained it
+                key = self._host_queues[best.id].peek_key()
+                if key is None or key[0] >= window_end:
+                    exec_lock.release()
+                    excluded.add(best.id)
+                    continue
+                return self._host_queues[best.id].pop()
+
+    def done(self, event: Event, worker_id: int) -> None:
+        """Release the host execution lock taken by pop()."""
+        hid = event.dst_host.id if event.dst_host is not None else -1
+        lk = self._exec_locks.get(hid)
+        if lk is not None and lk.locked():
+            try:
+                lk.release()
+            except RuntimeError:  # pragma: no cover - not ours (detached)
+                pass
 
     def next_time(self) -> int:
         t = stime.SIM_TIME_MAX
@@ -167,12 +220,18 @@ class HostStealPolicy(HostQueuesPolicy):
         ev = super().pop(worker_id, window_end)
         if ev is not None:
             return ev
-        # steal: find any host with work in this window and take it over
+        # steal: find a host with runnable work that nobody is mid-event on
+        # and take it over.  Exclusive execution is enforced by the per-host
+        # exec locks in the base pop(), so even a racy migration here cannot
+        # run one host on two threads; the busy check just avoids migrating
+        # hosts that are actively being drained.
         with self._steal_lock:
             for victim_worker, hosts in list(self._assignment.items()):
                 if victim_worker == worker_id:
                     continue
-                for host in hosts:
+                for host in list(hosts):
+                    if self._exec_locks[host.id].locked():
+                        continue
                     q = self._host_queues[host.id]
                     with self._host_locks[host.id]:
                         key = q.peek_key()
@@ -352,6 +411,9 @@ class Scheduler:
         if not self._running:
             return None
         return self.policy.pop(worker.id, self.window_end)
+
+    def event_done(self, event: Event, worker) -> None:
+        self.policy.done(event, worker.id)
 
     def next_event_time(self) -> int:
         return self.policy.next_time()

@@ -135,12 +135,16 @@ class Worker:
             if ev is None:
                 break
             self.now = ev.time
-            if ev.execute(self):
-                self.last_event_time = ev.time
-                self.counters.count_free("event")
-            # else: CPU model deferred it — the same Event object was
-            # re-pushed with a later time and will be accounted when it
-            # actually runs.
+            try:
+                if ev.execute(self):
+                    self.last_event_time = ev.time
+                    self.counters.count_free("event")
+                # else: CPU model deferred it — the same Event object was
+                # re-pushed with a later time and will be accounted when it
+                # actually runs.
+            finally:
+                # release the host execution lock taken by the policy pop
+                self.scheduler.event_done(ev, self)
 
     def finish(self) -> None:
         self.engine.merge_counters(self.counters)

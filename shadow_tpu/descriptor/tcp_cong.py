@@ -1,0 +1,148 @@
+"""Pluggable TCP congestion control.
+
+Capability parity with the reference's CC vtable (tcp_cong.h:17-31 hooks:
+duplicateAck / fastRecovery / newAck / timeout / ssthresh) and its
+``--tcp-congestion-control`` choices reno/aimd/cubic (options.c,
+tcp.c:2514 tcpCongestion_getType).  The reference ships Reno
+(tcp_cong_reno.c); we implement all three advertised algorithms.
+
+Windows are in bytes; ``mss`` is the segment size used for increments.
+"""
+
+from __future__ import annotations
+
+INIT_CWND_SEGMENTS = 10       # Linux default initial window (RFC 6928)
+
+
+class CongestionControl:
+    """Base vtable: slow start + congestion avoidance scaffolding."""
+
+    name = "base"
+
+    def __init__(self, mss: int, ssthresh: int = 0):
+        self.mss = mss
+        self.cwnd = INIT_CWND_SEGMENTS * mss
+        # 0 = "infinite" until first loss
+        self.ssthresh = ssthresh if ssthresh > 0 else (1 << 30)
+        self.in_fast_recovery = False
+        self.recovery_point = 0       # snd_nxt at loss detection
+        self._avoid_acc = 0           # byte accumulator for CA increments
+
+    # -- hooks (tcp_cong.h:17-31) -----------------------------------------
+    def on_new_ack(self, acked_bytes: int, snd_una: int, now_ns: int) -> None:
+        if self.in_fast_recovery:
+            if snd_una >= self.recovery_point:
+                self._exit_recovery()
+            else:
+                return  # partial ACK: stay in recovery
+        if self.cwnd < self.ssthresh:
+            self.cwnd += min(acked_bytes, self.mss)   # slow start
+        else:
+            self._congestion_avoidance(acked_bytes, now_ns)
+
+    def on_duplicate_ack(self, count: int, snd_nxt: int) -> bool:
+        """Returns True when the caller should fast-retransmit (3rd dup)."""
+        if count == 3 and not self.in_fast_recovery:
+            self._enter_recovery(snd_nxt)
+            return True
+        if self.in_fast_recovery:
+            self.cwnd += self.mss   # window inflation per extra dup
+        return False
+
+    def on_timeout(self) -> None:
+        self.ssthresh = max(self.cwnd // 2, 2 * self.mss)
+        self.cwnd = self.mss
+        self.in_fast_recovery = False
+        self._avoid_acc = 0
+
+    # -- internals ---------------------------------------------------------
+    def _enter_recovery(self, snd_nxt: int) -> None:
+        self.ssthresh = max(self.cwnd // 2, 2 * self.mss)
+        self.cwnd = self.ssthresh + 3 * self.mss
+        self.in_fast_recovery = True
+        self.recovery_point = snd_nxt
+
+    def _exit_recovery(self) -> None:
+        self.cwnd = self.ssthresh
+        self.in_fast_recovery = False
+        self._avoid_acc = 0
+
+    def _congestion_avoidance(self, acked_bytes: int, now_ns: int) -> None:
+        # +1 MSS per cwnd of acked bytes (Reno linear growth)
+        self._avoid_acc += acked_bytes
+        if self._avoid_acc >= self.cwnd:
+            self._avoid_acc -= self.cwnd
+            self.cwnd += self.mss
+
+
+class Reno(CongestionControl):
+    """NewReno-style fast recovery (reference tcp_cong_reno.c)."""
+
+    name = "reno"
+
+
+class AIMD(CongestionControl):
+    """Plain additive-increase/multiplicative-decrease: like Reno but with
+    no window inflation during recovery (the reference's 'aimd' choice)."""
+
+    name = "aimd"
+
+    def on_duplicate_ack(self, count: int, snd_nxt: int) -> bool:
+        if count == 3 and not self.in_fast_recovery:
+            self._enter_recovery(snd_nxt)
+            self.cwnd = self.ssthresh  # no +3 inflation
+            return True
+        return False
+
+
+class Cubic(CongestionControl):
+    """CUBIC (RFC 9438): window growth is a cubic function of time since
+    the last congestion event, independent of RTT."""
+
+    name = "cubic"
+    C = 0.4          # scaling constant (RFC 9438 §4.1)
+    BETA = 0.7       # multiplicative decrease factor
+
+    def __init__(self, mss: int, ssthresh: int = 0):
+        super().__init__(mss, ssthresh)
+        self.w_max = 0.0          # window before last reduction (bytes)
+        self.epoch_start_ns = 0
+        self.k = 0.0              # time to regrow to w_max (seconds)
+
+    def _enter_recovery(self, snd_nxt: int) -> None:
+        self.w_max = float(self.cwnd)
+        self.ssthresh = max(int(self.cwnd * self.BETA), 2 * self.mss)
+        self.cwnd = self.ssthresh
+        self.in_fast_recovery = True
+        self.recovery_point = snd_nxt
+        self.epoch_start_ns = 0   # new epoch starts at next ACK
+
+    def on_timeout(self) -> None:
+        self.w_max = float(self.cwnd)
+        super().on_timeout()
+        self.epoch_start_ns = 0
+
+    def _congestion_avoidance(self, acked_bytes: int, now_ns: int) -> None:
+        if self.epoch_start_ns == 0:
+            self.epoch_start_ns = now_ns
+            wm = max(self.w_max, float(self.cwnd))
+            self.k = ((wm - self.cwnd) / (self.C * self.mss)) ** (1.0 / 3.0) \
+                if wm > self.cwnd else 0.0
+        t = (now_ns - self.epoch_start_ns) / 1e9
+        target = self.w_max + self.C * self.mss * (t - self.k) ** 3
+        if target > self.cwnd:
+            # approach the cubic target over the next RTT-ish step
+            self.cwnd += max(self.mss // 8,
+                             int((target - self.cwnd) / 8))
+        else:
+            super()._congestion_avoidance(acked_bytes, now_ns)
+
+
+def make_congestion_control(kind: str, mss: int, ssthresh: int = 0) -> CongestionControl:
+    if kind == "reno":
+        return Reno(mss, ssthresh)
+    if kind == "aimd":
+        return AIMD(mss, ssthresh)
+    if kind == "cubic":
+        return Cubic(mss, ssthresh)
+    raise ValueError(f"unknown congestion control {kind!r}")

@@ -69,8 +69,15 @@ class UDPSocket(Socket):
         self.adjust_status(S_READABLE, bool(self.in_packets))
 
     def _update_writable(self) -> None:
+        # WRITABLE must imply a max-size datagram send will succeed, or a
+        # blocking sender spins on (send -> 0, block-on-writable -> already
+        # set) without ever advancing virtual time.  Clamped to the buffer
+        # size so tiny configured send buffers can still become writable
+        # (they just can't take a max-size datagram without draining first).
+        max_need = min(defs.CONFIG_DATAGRAM_MAX_SIZE
+                       + defs.CONFIG_HEADER_SIZE_UDPIPETH, self.send_buf_size)
         self.adjust_status(S_WRITABLE,
-                           self.out_bytes < self.send_buf_size and not self.closed)
+                           self.has_out_space(max_need) and not self.closed)
 
     def pull_out_packet(self):
         p = super().pull_out_packet()

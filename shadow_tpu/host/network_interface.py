@@ -158,7 +158,10 @@ class NetworkInterface:
                 packet = self._arrivals[0]
                 src = "local"
             elif self.router is not None:
-                packet = self.router.peek()
+                # peek the packet that will actually be delivered (the AQM
+                # may drop queued packets on the way) so the token spend
+                # matches the delivered bytes exactly
+                packet = self.router.peek_deliverable(now)
                 src = "router"
             else:
                 packet = None
@@ -170,10 +173,7 @@ class NetworkInterface:
             if src == "local":
                 self._arrivals.popleft()
             else:
-                got = self.router.dequeue(now)
-                if got is None:
-                    continue  # AQM dropped everything buffered
-                packet = got
+                packet = self.router.dequeue(now)
             packet.add_status("RCV_INTERFACE_RECEIVED")
             if self.pcap is not None:
                 self.pcap.write_packet(now, packet)

@@ -194,6 +194,10 @@ class Router:
     def __init__(self, queue: QueueManager, interface=None):
         self.queue = queue
         self.interface = interface
+        # Staging slot: the AQM's dequeue both drops and returns packets, so
+        # the interface peeks the *actual* next deliverable packet here (and
+        # charges bandwidth tokens for its true size) before taking it.
+        self._staged = None
 
     def enqueue(self, packet) -> None:
         """Arrival from the internet core (router.c:104-122): AQM admit or
@@ -211,7 +215,24 @@ class Router:
             self.interface.on_router_ready()
 
     def dequeue(self, now: int):
+        if self._staged is not None:
+            p, self._staged = self._staged, None
+            return p
         return self.queue.dequeue(now)
 
+    def peek_deliverable(self, now: int):
+        """The next packet that WILL be delivered (AQM drops applied), left
+        staged until :meth:`dequeue` takes it.  Lets the interface size its
+        token spend to the delivered packet, not a packet the AQM is about
+        to drop."""
+        if self._staged is None:
+            self._staged = self.queue.dequeue(now)
+        return self._staged
+
     def peek(self):
+        if self._staged is not None:
+            return self._staged
         return self.queue.peek()
+
+    def __len__(self) -> int:
+        return len(self.queue) + (1 if self._staged is not None else 0)

@@ -1,0 +1,60 @@
+"""Cross-policy equivalence on PHOLD (the reference's scheduler stress test,
+src/test/phold/test_phold.c): uniform all-to-all traffic run under every
+scheduler policy and worker count must produce identical traffic totals —
+per-host RNG draws are sequential per host, and packet drops are keyed by
+uid, so results are policy- and thread-count-independent."""
+
+import textwrap
+
+import pytest
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+
+N_HOSTS = 8
+
+CONFIG_XML = textwrap.dedent(f"""\
+    <shadow stoptime="8">
+      <plugin id="phold" path="python:phold" />
+      <host id="phold" quantity="{N_HOSTS}" bandwidthdown="10240" bandwidthup="10240">
+        <process plugin="phold" starttime="1" arguments="{N_HOSTS} 2 9000" />
+      </host>
+    </shadow>
+""")
+
+
+def run_phold(policy, workers):
+    cfg = configuration.parse_xml(CONFIG_XML)
+    opts = Options(scheduler_policy=policy, workers=workers,
+                   stop_time_sec=cfg.stop_time_sec)
+    ctrl = Controller(opts, cfg)
+    rc = ctrl.run()
+    assert rc == 0
+    totals = tuple(
+        (h.tracker.out_remote.packets_data, h.tracker.in_remote.packets_data)
+        for h in (ctrl.engine.host_by_name(f"phold{i + 1}")
+                  for i in range(N_HOSTS)))
+    return totals
+
+
+@pytest.fixture(scope="module")
+def serial_totals():
+    return run_phold("global", 0)
+
+
+@pytest.mark.parametrize("policy,workers", [
+    ("host", 4), ("steal", 2), ("steal", 4),
+    ("thread", 2), ("threadXthread", 4), ("threadXhost", 4),
+    ("tpu", 0), ("tpu", 2),
+])
+def test_policy_equivalence(policy, workers, serial_totals):
+    assert run_phold(policy, workers) == serial_totals
+
+
+def test_phold_population_constant(serial_totals):
+    """The fix for self-directed messages: every host keeps forwarding, so
+    everyone sends and receives plenty of messages over 20s."""
+    for out_pkts, in_pkts in serial_totals:
+        assert out_pkts >= 2, serial_totals
+        assert in_pkts >= 2, serial_totals
