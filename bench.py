@@ -45,8 +45,7 @@ def build_topology(n_hosts: int = 256):
                                    packetloss=float(rng.uniform(0.0, 0.05))))
     topo = Topology(verts, edges, directed=False, graph_attrs={})
     for i in range(n_hosts):
-        topo.attach_host(1000 + i, ip_hint=None, choice_rand=i)
-        topo._record_attachment(i, 1000 + i)  # one host per vertex
+        topo.attach_host(1000 + i, ip_hint=None, choice_rand=i)  # one host per vertex
     topo.finalize()
     return topo
 

@@ -1,0 +1,158 @@
+// Retransmit tally: interval arithmetic over TCP sequence ranges.
+//
+// Native C++ equivalent of the reference's shadow-remora library
+// (src/main/host/descriptor/tcp_retransmit_tally.cc/.h): tracks
+// sacked / retransmitted / marked-lost sequence ranges as sorted disjoint
+// interval sets and computes the lost set under the dup-ACK threshold rule
+// (threshold 3, header :68).  Exposed through a C ABI (header :29-47 in the
+// reference does the same) loaded from Python via ctypes
+// (shadow_tpu/descriptor/retransmit_tally.py).
+//
+// Build: make -C native  (produces shadow_tpu/native/libshadow_tally.so)
+
+#include <algorithm>
+#include <cstdint>
+#include <utility>
+#include <vector>
+
+namespace {
+
+using Range = std::pair<int64_t, int64_t>;  // [begin, end)
+using Ranges = std::vector<Range>;
+
+// Insert [b,e) into a sorted disjoint set, merging overlaps/adjacency.
+void insert_range(Ranges &rs, int64_t b, int64_t e) {
+  if (b >= e) return;
+  Ranges out;
+  out.reserve(rs.size() + 1);
+  size_t i = 0;
+  while (i < rs.size() && rs[i].second < b) out.push_back(rs[i++]);
+  while (i < rs.size() && rs[i].first <= e) {
+    b = std::min(b, rs[i].first);
+    e = std::max(e, rs[i].second);
+    ++i;
+  }
+  out.emplace_back(b, e);
+  while (i < rs.size()) out.push_back(rs[i++]);
+  rs.swap(out);
+}
+
+// Remove [b,e) from a sorted disjoint set.
+void subtract_range(Ranges &rs, int64_t b, int64_t e) {
+  if (b >= e) return;
+  Ranges out;
+  out.reserve(rs.size() + 1);
+  for (const auto &r : rs) {
+    if (r.second <= b || r.first >= e) {
+      out.push_back(r);
+      continue;
+    }
+    if (r.first < b) out.emplace_back(r.first, b);
+    if (r.second > e) out.emplace_back(e, r.second);
+  }
+  rs.swap(out);
+}
+
+// Drop everything below `lo` (cumulative ACK advanced).
+void clamp_below(Ranges &rs, int64_t lo) { subtract_range(rs, INT64_MIN / 2, lo); }
+
+int64_t total_len(const Ranges &rs) {
+  int64_t n = 0;
+  for (const auto &r : rs) n += r.second - r.first;
+  return n;
+}
+
+bool contains_all(const Ranges &rs, int64_t b, int64_t e) {
+  for (const auto &r : rs)
+    if (r.first <= b && e <= r.second) return true;
+  return false;
+}
+
+struct Tally {
+  Ranges sacked;
+  Ranges retransmitted;
+  Ranges lost;
+};
+
+}  // namespace
+
+extern "C" {
+
+void *tally_new() { return new Tally(); }
+void tally_free(void *t) { delete static_cast<Tally *>(t); }
+
+void tally_mark_sacked(void *t, int64_t b, int64_t e) {
+  auto *ty = static_cast<Tally *>(t);
+  insert_range(ty->sacked, b, e);
+  // sacked data is no longer lost and needs no further retransmits
+  subtract_range(ty->lost, b, e);
+  subtract_range(ty->retransmitted, b, e);
+}
+
+void tally_mark_retransmitted(void *t, int64_t b, int64_t e) {
+  auto *ty = static_cast<Tally *>(t);
+  insert_range(ty->retransmitted, b, e);
+  subtract_range(ty->lost, b, e);
+}
+
+void tally_mark_lost(void *t, int64_t b, int64_t e) {
+  auto *ty = static_cast<Tally *>(t);
+  insert_range(ty->lost, b, e);
+  subtract_range(ty->retransmitted, b, e);
+  // anything already sacked is not lost
+  for (const auto &r : ty->sacked) subtract_range(ty->lost, r.first, r.second);
+}
+
+void tally_advance_una(void *t, int64_t una) {
+  auto *ty = static_cast<Tally *>(t);
+  clamp_below(ty->sacked, una);
+  clamp_below(ty->retransmitted, una);
+  clamp_below(ty->lost, una);
+}
+
+// Dup-ACK threshold rule: with >=3 dup ACKs, everything in [una, highest
+// sacked) that is neither sacked nor already retransmitted is lost.
+void tally_update_lost(void *t, int64_t una, int64_t /*nxt*/, int dup_acks) {
+  auto *ty = static_cast<Tally *>(t);
+  if (dup_acks < 3 || ty->sacked.empty()) return;
+  int64_t hi = ty->sacked.back().second;
+  if (hi <= una) return;
+  Ranges lost;
+  lost.emplace_back(una, hi);
+  for (const auto &r : ty->sacked) subtract_range(lost, r.first, r.second);
+  for (const auto &r : ty->retransmitted) subtract_range(lost, r.first, r.second);
+  for (const auto &r : lost) insert_range(ty->lost, r.first, r.second);
+}
+
+int tally_lost_count(void *t) {
+  return static_cast<int>(static_cast<Tally *>(t)->lost.size());
+}
+
+// Copies up to max_pairs (b,e) int64 pairs into out; returns pairs written.
+int tally_get_lost(void *t, int64_t *out, int max_pairs) {
+  auto *ty = static_cast<Tally *>(t);
+  int n = 0;
+  for (const auto &r : ty->lost) {
+    if (n >= max_pairs) break;
+    out[2 * n] = r.first;
+    out[2 * n + 1] = r.second;
+    ++n;
+  }
+  return n;
+}
+
+void tally_clear_lost(void *t) { static_cast<Tally *>(t)->lost.clear(); }
+
+int64_t tally_total_sacked(void *t) { return total_len(static_cast<Tally *>(t)->sacked); }
+int64_t tally_total_lost(void *t) { return total_len(static_cast<Tally *>(t)->lost); }
+
+int tally_is_sacked(void *t, int64_t b, int64_t e) {
+  return contains_all(static_cast<Tally *>(t)->sacked, b, e) ? 1 : 0;
+}
+
+int64_t tally_highest_sacked(void *t) {
+  auto *ty = static_cast<Tally *>(t);
+  return ty->sacked.empty() ? -1 : ty->sacked.back().second;
+}
+
+}  // extern "C"

@@ -224,8 +224,11 @@ class HostStealPolicy(HostQueuesPolicy):
         # and take it over.  Exclusive execution is enforced by the per-host
         # exec locks in the base pop(), so even a racy migration here cannot
         # run one host on two threads; the busy check just avoids migrating
-        # hosts that are actively being drained.
-        with self._steal_lock:
+        # hosts that are actively being drained.  The O(hosts) victim scan
+        # runs lock-free on list snapshots; only the migration itself takes
+        # the steal lock, so concurrent idle workers scan in parallel.
+        while True:
+            candidate = victim = None
             for victim_worker, hosts in list(self._assignment.items()):
                 if victim_worker == worker_id:
                     continue
@@ -236,11 +239,22 @@ class HostStealPolicy(HostQueuesPolicy):
                     with self._host_locks[host.id]:
                         key = q.peek_key()
                     if key is not None and key[0] < window_end:
-                        hosts.remove(host)
-                        self._assignment.setdefault(worker_id, []).append(host)
-                        self._host_worker[host.id] = worker_id
-                        return super().pop(worker_id, window_end)
-        return None
+                        candidate, victim = host, victim_worker
+                        break
+                if candidate is not None:
+                    break
+            if candidate is None:
+                return None
+            with self._steal_lock:
+                hosts = self._assignment.get(victim, [])
+                if candidate in hosts:  # still the victim's: migrate it
+                    hosts.remove(candidate)
+                    self._assignment.setdefault(worker_id, []).append(candidate)
+                    self._host_worker[candidate.id] = worker_id
+            ev = super().pop(worker_id, window_end)
+            if ev is not None:
+                return ev
+            # raced with another thief or the queue drained; rescan
 
 
 class ThreadSinglePolicy(SchedulerPolicy):

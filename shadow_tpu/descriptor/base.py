@@ -106,6 +106,8 @@ class Socket(Transport):
         self.peer_ip: Optional[int] = None
         self.peer_port: Optional[int] = None
         self.unix_path: Optional[str] = None
+        # (iface, binding-key) pairs maintained by NetworkInterface.associate
+        self._associations: List[tuple] = []
         self.adjust_status(S_ACTIVE, True)
 
     # -- naming ------------------------------------------------------------
@@ -116,6 +118,18 @@ class Socket(Transport):
     def bind_to(self, ip: int, port: int) -> None:
         self.bound_ip = ip
         self.bound_port = port
+
+    def close(self) -> None:
+        """Release every interface binding this socket holds, then close."""
+        if self.closed:
+            return
+        for iface, key in list(self._associations):
+            # only drop bindings that still refer to this socket — a stale
+            # pair must not evict another socket's live binding
+            if iface._bindings.get(key) is self:
+                del iface._bindings[key]
+        self._associations.clear()
+        super().close()
 
     # -- output queue (interface side) ------------------------------------
     def add_out_packet(self, packet) -> None:

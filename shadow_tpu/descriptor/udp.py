@@ -29,8 +29,12 @@ class UDPSocket(Socket):
         if not self.is_bound:
             host.autobind_socket(self, dst_ip)
         if len(data) > defs.CONFIG_DATAGRAM_MAX_SIZE:
-            raise ValueError("EMSGSIZE: datagram too large")
+            raise OSError("EMSGSIZE: datagram too large")
         need = len(data) + defs.CONFIG_HEADER_SIZE_UDPIPETH
+        if need > self.send_buf_size:
+            # can never fit even in an empty buffer: returning 0 would make a
+            # blocking sender spin at one virtual instant forever
+            raise OSError("EMSGSIZE: datagram exceeds send buffer")
         if not self.has_out_space(need):
             return 0  # EWOULDBLOCK; caller retries when WRITABLE
         packet = Packet.new_udp(host.next_packet_uid(), host.next_packet_priority(),

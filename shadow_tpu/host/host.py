@@ -168,16 +168,20 @@ class Host:
         return h
 
     # -- port management ---------------------------------------------------
-    def allocate_ephemeral_port(self, protocol: str, iface_ip: int) -> int:
+    def allocate_ephemeral_port(self, protocol: str, iface_ip: int,
+                                ifaces=None) -> int:
         """Deterministic ephemeral port scan (reference uses host random;
-        we scan from a rotating cursor for speed and determinism)."""
-        iface = self.interface_for_ip(iface_ip)
+        we scan from a rotating cursor for speed and determinism).  Pass
+        ``ifaces`` to require the port free on several interfaces at once
+        (wildcard binds claim every interface)."""
+        check = ifaces if ifaces is not None else [self.interface_for_ip(iface_ip)]
         for _ in range(MAX_PORT - MIN_EPHEMERAL_PORT + 1):
             port = self._next_port
             self._next_port += 1
             if self._next_port > MAX_PORT:
                 self._next_port = MIN_EPHEMERAL_PORT
-            if iface is None or not iface.is_associated(protocol, port):
+            if all(i is None or not i.is_associated(protocol, port)
+                   for i in check):
                 return port
         raise OSError("EADDRINUSE: ephemeral ports exhausted")
 

@@ -82,11 +82,21 @@ class NetworkInterface:
 
     def associate(self, socket, protocol: str, port: int, peer_ip: int = 0,
                   peer_port: int = 0) -> None:
-        self._bindings[self._key(protocol, port, peer_ip, peer_port)] = socket
+        key = self._key(protocol, port, peer_ip, peer_port)
+        self._bindings[key] = socket
+        # back-reference so Socket.close can drop every binding it holds
+        # (a wildcard bind associates on multiple interfaces)
+        assoc = getattr(socket, "_associations", None)
+        if assoc is not None and (self, key) not in assoc:
+            assoc.append((self, key))
 
     def disassociate(self, protocol: str, port: int, peer_ip: int = 0,
                      peer_port: int = 0) -> None:
-        self._bindings.pop(self._key(protocol, port, peer_ip, peer_port), None)
+        key = self._key(protocol, port, peer_ip, peer_port)
+        sock = self._bindings.pop(key, None)
+        assoc = getattr(sock, "_associations", None) if sock is not None else None
+        if assoc and (self, key) in assoc:
+            assoc.remove((self, key))
 
     def is_associated(self, protocol: str, port: int, peer_ip: int = 0,
                       peer_port: int = 0) -> bool:

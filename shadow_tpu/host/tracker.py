@@ -70,6 +70,11 @@ class Tracker:
 
     def add_output_bytes(self, packet, iface_ip: int, retransmit: bool = False) -> None:
         c = self.out_local if iface_ip == LOCALHOST_IP else self.out_remote
+        # TCP marks retransmissions in the packet audit trail (the reference's
+        # split comes from packet delivery-status flags too, tracker.c:25-49)
+        if not retransmit and packet.statuses and \
+                "SND_TCP_ENQUEUE_RETRANSMIT" in packet.statuses:
+            retransmit = True
         c.add(packet, retransmit)
 
     def add_drop(self, packet) -> None:

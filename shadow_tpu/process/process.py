@@ -347,17 +347,25 @@ class SyscallAPI:
 
     def bind(self, fd: int, addr: Tuple[Any, int]) -> None:
         sock = self._sock(fd)
+        wildcard = addr[0] in ("", "0.0.0.0", None, 0)
         ip = self._resolve(addr[0])
-        port = addr[1]
-        if port == 0:
-            port = self.host.allocate_ephemeral_port(sock.kind, ip)
         iface = self.host.interface_for_ip(ip)
         if iface is None:
             raise OSError("EADDRNOTAVAIL")
-        if iface.is_associated(sock.kind, port):
+        # INADDR_ANY claims the port on every interface (loopback + eth),
+        # like the reference's bind-to-any association — so both the
+        # ephemeral-port scan and the in-use check must cover every
+        # interface it will claim
+        targets = list(set(self.host.interfaces.values())) if wildcard else [iface]
+        port = addr[1]
+        if port == 0:
+            port = self.host.allocate_ephemeral_port(sock.kind, ip,
+                                                     ifaces=targets)
+        if any(t.is_associated(sock.kind, port) for t in targets):
             raise OSError("EADDRINUSE")
         sock.bind_to(iface.address.ip, port)
-        iface.associate(sock, sock.kind, port)
+        for t in targets:
+            t.associate(sock, sock.kind, port)
 
     def _resolve(self, name_or_ip) -> int:
         if isinstance(name_or_ip, int):

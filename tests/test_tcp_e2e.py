@@ -1,0 +1,252 @@
+"""TCP stack tests: the reference's loopback/lossless/lossy matrix
+(src/test/tcp/*.test.shadow.config.xml) adapted to the rebuilt stack, plus
+retransmit-tally unit tests (native C++ lib vs pure-Python parity)."""
+
+import textwrap
+
+import pytest
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+from shadow_tpu.descriptor.retransmit_tally import (PyTally, native_available,
+                                                    make_tally)
+
+LOSSY_GRAPHML = textwrap.dedent("""\
+    <?xml version="1.0" encoding="UTF-8"?>
+    <graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+      <key id="d0" for="node" attr.name="ip" attr.type="string"/>
+      <key id="d5" for="edge" attr.name="latency" attr.type="double"/>
+      <key id="d6" for="edge" attr.name="packetloss" attr.type="double"/>
+      <graph edgedefault="undirected">
+        <node id="v0"><data key="d0">10.0.0.1</data></node>
+        <node id="v1"><data key="d0">10.0.0.2</data></node>
+        <edge source="v0" target="v1">
+          <data key="d5">10.0</data><data key="d6">{loss}</data>
+        </edge>
+        <edge source="v0" target="v0"><data key="d5">1.0</data></edge>
+        <edge source="v1" target="v1"><data key="d5">1.0</data></edge>
+      </graph>
+    </graphml>
+""")
+
+
+def two_host_xml(client_args, loss=0.0, stop=120, server_args="tcp server 8000",
+                 plugin="echo"):
+    topo = LOSSY_GRAPHML.format(loss=loss) if loss >= 0 else None
+    topo_el = f"<topology><![CDATA[{topo}]]></topology>" if topo else ""
+    return textwrap.dedent(f"""\
+        <shadow stoptime="{stop}">
+          {topo_el}
+          <plugin id="app" path="python:{plugin}" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240" iphint="10.0.0.1">
+            <process plugin="app" starttime="1" arguments="{server_args}" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240" iphint="10.0.0.2">
+            <process plugin="app" starttime="2" arguments="{client_args}" />
+          </host>
+        </shadow>
+    """)
+
+
+def run_sim(xml, policy="global", workers=0, stop=120, seed=42):
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = stop
+    opts = Options(scheduler_policy=policy, workers=workers,
+                   stop_time_sec=stop, seed=seed)
+    ctrl = Controller(opts, cfg)
+    rc = ctrl.run()
+    return rc, ctrl
+
+
+def client_proc(ctrl):
+    return ctrl.engine.host_by_name("client").processes[0]
+
+
+# ---------------------------------------------------------------------------
+# handshake + echo matrix
+# ---------------------------------------------------------------------------
+
+def test_tcp_echo_lossless():
+    rc, ctrl = run_sim(two_host_xml("tcp client server 8000 5 2048"))
+    assert rc == 0
+    p = client_proc(ctrl)
+    assert p.exited and p.exit_code == 0
+
+
+def test_tcp_echo_lossy():
+    """10% loss: retransmit/SACK machinery must still deliver everything."""
+    rc, ctrl = run_sim(two_host_xml("tcp client server 8000 5 2048", loss=0.1,
+                                    stop=300), stop=300)
+    assert rc == 0
+    p = client_proc(ctrl)
+    assert p.exited and p.exit_code == 0
+
+
+def test_tcp_echo_loopback():
+    xml = textwrap.dedent("""\
+        <shadow stoptime="60">
+          <plugin id="echo" path="python:echo" />
+          <host id="box" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="echo" starttime="1" arguments="tcp server 8000" />
+            <process plugin="echo" starttime="2"
+                     arguments="tcp client localhost 8000 5 2048" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml, stop=60)
+    assert rc == 0
+    box = ctrl.engine.host_by_name("box")
+    assert box.processes[1].exit_code == 0
+
+
+def test_tcp_bulk_transfer_lossless():
+    """Bulk download exercises cwnd growth + flow control (256 KiB)."""
+    rc, ctrl = run_sim(two_host_xml(
+        "client server 80 2", server_args="server 80 262144",
+        plugin="filetransfer", stop=300), stop=300)
+    assert rc == 0
+    p = client_proc(ctrl)
+    assert p.exited and p.exit_code == 0
+
+
+def test_tcp_bulk_transfer_lossy():
+    """64 KiB through 5% loss: SACK-driven recovery, no livelock."""
+    rc, ctrl = run_sim(two_host_xml(
+        "client server 80 1", server_args="server 80 65536",
+        plugin="filetransfer", loss=0.05, stop=600), stop=600)
+    assert rc == 0
+    p = client_proc(ctrl)
+    assert p.exited and p.exit_code == 0
+    # loss actually happened and was repaired
+    server = ctrl.engine.host_by_name("server")
+    assert server.tracker.out_remote.packets_retrans > 0
+
+
+def test_tcp_lossy_deterministic():
+    xml = two_host_xml("tcp client server 8000 3 4096", loss=0.1, stop=300)
+    rc1, c1 = run_sim(xml, stop=300)
+    rc2, c2 = run_sim(xml, stop=300)
+    assert rc1 == rc2 == 0
+    assert c1.engine.events_executed == c2.engine.events_executed
+    assert c1.engine.rounds_executed == c2.engine.rounds_executed
+
+
+def test_tcp_parallel_host_policy():
+    rc, ctrl = run_sim(two_host_xml("tcp client server 8000 5 2048"),
+                       policy="host", workers=2)
+    assert rc == 0
+    assert client_proc(ctrl).exit_code == 0
+
+
+# ---------------------------------------------------------------------------
+# epoll-driven (nonblocking) server — reference tcp-nonblocking-epoll tests
+# ---------------------------------------------------------------------------
+
+def _register_epoll_echo():
+    from shadow_tpu.apps.registry import register, _APPS  # noqa
+
+    if "epoll_echo" in _APPS:
+        return
+
+    @register("epoll_echo")
+    def epoll_echo(api, args):
+        port = int(args[0]) if args else 8000
+        lfd = api.socket("tcp")
+        api.bind(lfd, ("0.0.0.0", port))
+        api.listen(lfd)
+        epfd = api.epoll_create()
+        api.epoll_ctl(epfd, "add", lfd, 1)  # EPOLLIN-ish: readable
+        conns = set()
+        while True:
+            events = yield from api.epoll_wait(epfd)
+            for fd, _ev in events:
+                if fd == lfd:
+                    cfd, _peer = yield from api.accept(lfd)
+                    conns.add(cfd)
+                    api.epoll_ctl(epfd, "add", cfd, 1)
+                else:
+                    data = api.try_recvfrom(fd)
+                    if data is None:
+                        continue
+                    buf = data[0]
+                    if not buf:
+                        api.epoll_ctl(epfd, "del", fd)
+                        api.close(fd)
+                        conns.discard(fd)
+                        continue
+                    yield from api.send(fd, buf)
+
+
+def test_tcp_epoll_server():
+    _register_epoll_echo()
+    xml = textwrap.dedent("""\
+        <shadow stoptime="120">
+          <plugin id="srv" path="python:epoll_echo" />
+          <plugin id="cli" path="python:echo" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="srv" starttime="1" arguments="8000" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="cli" starttime="2"
+                     arguments="tcp client server 8000 4 1024" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert client_proc(ctrl).exit_code == 0
+
+
+# ---------------------------------------------------------------------------
+# retransmit tally: native/python parity + semantics
+# ---------------------------------------------------------------------------
+
+OPS = [
+    ("mark_sacked", 100, 200),
+    ("mark_sacked", 300, 400),
+    ("mark_retransmitted", 0, 50),
+    ("update_lost", 0, 500, 3),
+    ("mark_sacked", 450, 500),
+    ("update_lost", 0, 500, 4),
+    ("advance_una", 250),
+]
+
+
+def apply_ops(t):
+    for op, *args in OPS:
+        getattr(t, op)(*args)
+    return t
+
+
+def test_pytally_semantics():
+    t = apply_ops(PyTally())
+    # after una=250: sacked keeps [300,400)+[450,500); lost covers the
+    # unsacked/unretransmitted gaps above una
+    assert t.total_sacked() == 150
+    lost = t.lost_ranges()
+    assert (250, 300) in lost and (400, 450) in lost
+    assert t.is_sacked(310, 390)
+    assert not t.is_sacked(200, 310)
+    assert t.highest_sacked() == 500
+
+
+@pytest.mark.skipif(not native_available(), reason="native tally not built")
+def test_native_tally_matches_python():
+    py = apply_ops(PyTally())
+    nat = apply_ops(make_tally())
+    assert type(nat).__name__ == "NativeTally"
+    assert nat.lost_ranges() == py.lost_ranges()
+    assert nat.total_sacked() == py.total_sacked()
+    assert nat.total_lost() == py.total_lost()
+    assert nat.highest_sacked() == py.highest_sacked()
+    nat.close()
+
+
+def test_tally_sack_clears_lost():
+    t = make_tally()
+    t.mark_lost(0, 100)
+    t.mark_sacked(25, 75)
+    lost = t.lost_ranges()
+    assert (0, 25) in lost and (75, 100) in lost and len(lost) == 2
+    t.close()
