@@ -1,0 +1,77 @@
+/* shadow_tpu native-plugin protocol: the wire format between the LD_PRELOAD
+ * interposer (shim.cc) and the Python virtual kernel
+ * (shadow_tpu/process/native.py — keep the Python constants in sync).
+ *
+ * Capability parity target: the reference's preload/interposer.c +
+ * process.c process_emu_* surface (SURVEY.md §2.7).  Where the reference
+ * routes interposed libc calls to in-process emu functions, we route them
+ * over an inherited socketpair to the simulator process; the plugin only
+ * executes between a response and its next request, which serializes plugin
+ * code against the virtual clock exactly like the reference's
+ * one-green-thread-at-a-time pth scheduling (process.c:1197).
+ *
+ * Framing (little-endian, over SOCK_STREAM socketpair):
+ *   request:  u32 len | u32 op | i64 a | i64 b | i64 c | i64 d | payload
+ *             (len = total bytes including the 40-byte header)
+ *   response: u32 len | u32 flags | i64 ret | i64 vtime_ns | payload
+ *             (len = total bytes including the 24-byte header; ret < 0 is
+ *              -errno; vtime_ns = current virtual time, cached by the shim
+ *              so clock_gettime needs no round trip)
+ */
+#ifndef SHADOW_TPU_PRELOAD_PROTOCOL_H
+#define SHADOW_TPU_PRELOAD_PROTOCOL_H
+
+#include <stdint.h>
+
+#define SHADOW_TPU_ENV_FD "SHADOW_TPU_FD"
+#define SHADOW_TPU_ENV_EPOCH "SHADOW_TPU_EPOCH_NS"
+
+/* Application-visible fds for simulated descriptors are
+ * handle + SHADOW_TPU_SIM_FD_BASE; the wire protocol carries raw handles. */
+#define SHADOW_TPU_SIM_FD_BASE 512
+#define SHADOW_TPU_SIM_FD_MAX 65536
+
+enum shadow_tpu_op {
+  SHD_OP_SOCKET = 1,        /* a=domain b=type c=protocol -> fd */
+  SHD_OP_BIND = 2,          /* a=fd b=ipv4(host order) c=port */
+  SHD_OP_LISTEN = 3,        /* a=fd b=backlog */
+  SHD_OP_ACCEPT = 4,        /* a=fd b=nonblock -> fd, payload u32 ip u16 port */
+  SHD_OP_CONNECT = 5,       /* a=fd b=ip c=port d=nonblock */
+  SHD_OP_SEND = 6,          /* a=fd b=nonblock, payload data -> n */
+  SHD_OP_SENDTO = 7,        /* a=fd b=nonblock c=ip d=port, payload -> n */
+  SHD_OP_RECV = 8,          /* a=fd b=maxlen c=nonblock -> payload data */
+  SHD_OP_RECVFROM = 9,      /* a=fd b=maxlen c=nonblock -> u32 ip u16 port data */
+  SHD_OP_CLOSE = 10,        /* a=fd */
+  SHD_OP_EPOLL_CREATE = 11, /* -> fd */
+  SHD_OP_EPOLL_CTL = 12,    /* a=epfd b=op(1/2/3) c=fd d=events, payload u64 data */
+  SHD_OP_EPOLL_WAIT = 13,   /* a=epfd b=maxevents c=timeout_ms ->
+                               payload n*(u32 events, u64 data) */
+  SHD_OP_POLL = 14,         /* a=nfds b=timeout_ms, payload n*(i32 fd, i16 ev)
+                               -> payload n*i16 revents */
+  SHD_OP_GETTIME = 15,      /* -> vtime in header */
+  SHD_OP_SLEEP = 16,        /* a=ns */
+  SHD_OP_GETADDRINFO = 17,  /* payload name -> payload u32 ip */
+  SHD_OP_GETHOSTNAME = 18,  /* -> payload name */
+  SHD_OP_RANDOM = 19,       /* a=nbytes -> payload bytes */
+  SHD_OP_SETSOCKOPT = 20,   /* a=fd b=level c=optname, payload optval */
+  SHD_OP_GETSOCKOPT = 21,   /* a=fd b=level c=optname -> payload i32 */
+  SHD_OP_GETSOCKNAME = 22,  /* a=fd -> payload u32 ip u16 port */
+  SHD_OP_GETPEERNAME = 23,  /* a=fd -> payload u32 ip u16 port */
+  SHD_OP_SHUTDOWN = 24,     /* a=fd b=how */
+  SHD_OP_FCNTL = 25,        /* a=fd b=cmd c=arg (F_GETFL/F_SETFL only) */
+  SHD_OP_IOCTL = 26,        /* a=fd b=request (FIONREAD -> ret) */
+  SHD_OP_OPEN_RANDOM = 27,  /* -> fd (deterministic /dev/urandom) */
+  SHD_OP_READ = 28,         /* a=fd b=maxlen c=nonblock -> payload data */
+  SHD_OP_WRITE = 29,        /* a=fd b=nonblock, payload data -> n */
+  SHD_OP_EXIT = 30,         /* a=exit code (courtesy; EOF also works) */
+  SHD_OP_LOG = 31,          /* payload text */
+  SHD_OP_TIMERFD_CREATE = 32, /* -> fd */
+  SHD_OP_TIMERFD_SETTIME = 33, /* a=fd b=initial_ns c=interval_ns */
+  SHD_OP_PIPE = 34,         /* -> ret=read fd, payload u32 write fd */
+};
+
+#define SHD_REQ_HDR_LEN 40u
+#define SHD_RESP_HDR_LEN 24u
+#define SHD_MAX_PAYLOAD (1u << 20)
+
+#endif /* SHADOW_TPU_PRELOAD_PROTOCOL_H */

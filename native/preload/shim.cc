@@ -1,0 +1,1106 @@
+/* libshadow_preload.so — LD_PRELOAD interposer for running real, unmodified
+ * binaries inside the shadow_tpu simulator.
+ *
+ * Capability parity with the reference's interposition substrate
+ * (preload/interposer.c PRELOADDEF tables + process.c's 257 process_emu_*
+ * functions, SURVEY.md §2.7), redesigned for the split-process architecture:
+ * the plugin is a real OS process; every interposed libc call is forwarded
+ * over an inherited socketpair (fd in $SHADOW_TPU_FD) to the simulator,
+ * which executes it against the virtual kernel at the current virtual time.
+ * A call that would block simply doesn't get its response until the virtual
+ * clock makes it ready — so real blocking apps run unmodified under a
+ * discrete-event clock, the same capability rpth's green threads provided
+ * in-process for the reference.
+ *
+ * When $SHADOW_TPU_FD is absent every interceptor passes straight through
+ * to libc, so the same binary runs natively — the dual-execution test
+ * oracle the reference uses (SURVEY.md §4).
+ *
+ * Determinism: one transaction at a time (global mutex); the plugin only
+ * executes between a response and its next request; time is the simulator's
+ * virtual time, cached from every response header.
+ */
+
+#define _GNU_SOURCE 1
+#include "protocol.h"
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <poll.h>
+#include <pthread.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/ioctl.h>
+#include <sys/random.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <sys/timerfd.h>
+#include <sys/uio.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <set>
+
+/* ---------------------------------------------------------------- state -- */
+
+static int g_sock = -1;              /* protocol socketpair fd            */
+static int64_t g_vtime_ns = 0;       /* cached virtual time               */
+static int64_t g_epoch_ns = 0;       /* emulated-epoch offset             */
+static int g_active = 0;             /* simulator attached?               */
+static pthread_mutex_t g_lock = PTHREAD_MUTEX_INITIALIZER;
+
+/* App-visible fds for simulated descriptors are allocated densely from
+ * SHADOW_TPU_SIM_FD_BASE so they stay below FD_SETSIZE (select must work);
+ * this table maps appfd -> simulator handle (cf. the reference's
+ * shadow-fd vs OS-fd split, host.c shadowToOSHandleMap). */
+static unsigned char g_sim_fd[SHADOW_TPU_SIM_FD_MAX];
+static int64_t g_appfd_handle[SHADOW_TPU_SIM_FD_MAX];
+
+/* real libc entry points (dlsym RTLD_NEXT, like interposer.c SETSYM_OR_FAIL) */
+#define REAL(name) real_##name
+#define DECL_REAL(ret, name, ...) static ret (*real_##name)(__VA_ARGS__)
+DECL_REAL(int, socket, int, int, int);
+DECL_REAL(int, bind, int, const struct sockaddr *, socklen_t);
+DECL_REAL(int, listen, int, int);
+DECL_REAL(int, accept, int, struct sockaddr *, socklen_t *);
+DECL_REAL(int, accept4, int, struct sockaddr *, socklen_t *, int);
+DECL_REAL(int, connect, int, const struct sockaddr *, socklen_t);
+DECL_REAL(ssize_t, send, int, const void *, size_t, int);
+DECL_REAL(ssize_t, sendto, int, const void *, size_t, int,
+          const struct sockaddr *, socklen_t);
+DECL_REAL(ssize_t, sendmsg, int, const struct msghdr *, int);
+DECL_REAL(ssize_t, recv, int, void *, size_t, int);
+DECL_REAL(ssize_t, recvfrom, int, void *, size_t, int, struct sockaddr *,
+          socklen_t *);
+DECL_REAL(ssize_t, recvmsg, int, struct msghdr *, int);
+DECL_REAL(ssize_t, read, int, void *, size_t);
+DECL_REAL(ssize_t, write, int, const void *, size_t);
+DECL_REAL(ssize_t, readv, int, const struct iovec *, int);
+DECL_REAL(ssize_t, writev, int, const struct iovec *, int);
+DECL_REAL(int, close, int);
+DECL_REAL(int, shutdown, int, int);
+DECL_REAL(int, epoll_create, int);
+DECL_REAL(int, epoll_create1, int);
+DECL_REAL(int, epoll_ctl, int, int, int, struct epoll_event *);
+DECL_REAL(int, epoll_wait, int, struct epoll_event *, int, int);
+DECL_REAL(int, epoll_pwait, int, struct epoll_event *, int, int,
+          const sigset_t *);
+DECL_REAL(int, poll, struct pollfd *, nfds_t, int);
+DECL_REAL(int, select, int, fd_set *, fd_set *, fd_set *, struct timeval *);
+DECL_REAL(int, gettimeofday, struct timeval *, void *);
+DECL_REAL(int, clock_gettime, clockid_t, struct timespec *);
+DECL_REAL(time_t, time, time_t *);
+DECL_REAL(int, nanosleep, const struct timespec *, struct timespec *);
+DECL_REAL(int, clock_nanosleep, clockid_t, int, const struct timespec *,
+          struct timespec *);
+DECL_REAL(unsigned int, sleep, unsigned int);
+DECL_REAL(int, usleep, useconds_t);
+DECL_REAL(int, getaddrinfo, const char *, const char *,
+          const struct addrinfo *, struct addrinfo **);
+DECL_REAL(void, freeaddrinfo, struct addrinfo *);
+DECL_REAL(struct hostent *, gethostbyname, const char *);
+DECL_REAL(int, gethostname, char *, size_t);
+DECL_REAL(ssize_t, getrandom, void *, size_t, unsigned int);
+DECL_REAL(int, getentropy, void *, size_t);
+DECL_REAL(int, open, const char *, int, ...);
+DECL_REAL(int, open64, const char *, int, ...);
+DECL_REAL(int, openat, int, const char *, int, ...);
+DECL_REAL(int, fcntl, int, int, ...);
+DECL_REAL(int, ioctl, int, unsigned long, ...);
+DECL_REAL(int, getsockopt, int, int, int, void *, socklen_t *);
+DECL_REAL(int, setsockopt, int, int, int, const void *, socklen_t);
+DECL_REAL(int, getsockname, int, struct sockaddr *, socklen_t *);
+DECL_REAL(int, getpeername, int, struct sockaddr *, socklen_t *);
+DECL_REAL(int, pipe, int[2]);
+DECL_REAL(int, pipe2, int[2], int);
+DECL_REAL(int, timerfd_create, int, int);
+DECL_REAL(int, timerfd_settime, int, int, const struct itimerspec *,
+          struct itimerspec *);
+DECL_REAL(int, dup, int);
+DECL_REAL(int, dup2, int, int);
+
+static void resolve_reals(void) {
+#define SET(name) \
+  do { \
+    if (!real_##name) \
+      *(void **)(&real_##name) = dlsym(RTLD_NEXT, #name); \
+  } while (0)
+  SET(socket); SET(bind); SET(listen); SET(accept); SET(accept4);
+  SET(connect); SET(send); SET(sendto); SET(sendmsg); SET(recv);
+  SET(recvfrom); SET(recvmsg); SET(read); SET(write); SET(readv);
+  SET(writev); SET(close); SET(shutdown); SET(epoll_create);
+  SET(epoll_create1); SET(epoll_ctl); SET(epoll_wait); SET(epoll_pwait);
+  SET(poll); SET(select); SET(gettimeofday); SET(clock_gettime); SET(time);
+  SET(nanosleep); SET(clock_nanosleep); SET(sleep); SET(usleep);
+  SET(getaddrinfo); SET(freeaddrinfo); SET(gethostbyname); SET(gethostname);
+  SET(getrandom); SET(getentropy); SET(open); SET(open64); SET(openat);
+  SET(fcntl); SET(ioctl); SET(getsockopt); SET(setsockopt);
+  SET(getsockname); SET(getpeername); SET(pipe); SET(pipe2);
+  SET(timerfd_create); SET(timerfd_settime); SET(dup); SET(dup2);
+#undef SET
+}
+
+static int64_t transact0(uint32_t op, int64_t a, int64_t b, int64_t c,
+                         int64_t d);
+
+__attribute__((constructor)) static void shim_init(void) {
+  resolve_reals();
+  const char *fd_str = getenv(SHADOW_TPU_ENV_FD);
+  if (fd_str && *fd_str) {
+    g_sock = atoi(fd_str);
+    g_active = 1;
+    const char *ep = getenv(SHADOW_TPU_ENV_EPOCH);
+    g_epoch_ns = ep ? strtoll(ep, NULL, 10) : 0;
+    /* sync the cached clock to the process's virtual start time (the
+     * reference's plugins see worker_getEmulatedTime from their first
+     * instruction; our cache must match before main() runs) */
+    transact0(SHD_OP_GETTIME, 0, 0, 0, 0);
+  }
+}
+
+static inline int is_sim_fd(int fd) {
+  return g_active && fd >= SHADOW_TPU_SIM_FD_BASE && fd < SHADOW_TPU_SIM_FD_MAX
+         && g_sim_fd[fd];
+}
+
+static inline int64_t to_handle(int fd) { return g_appfd_handle[fd]; }
+
+/* lowest-free allocation keeps appfds small and deterministic */
+static int to_appfd(int64_t handle) {
+  for (int fd = SHADOW_TPU_SIM_FD_BASE; fd < SHADOW_TPU_SIM_FD_MAX; fd++) {
+    if (!g_sim_fd[fd]) {
+      g_sim_fd[fd] = 1;
+      g_appfd_handle[fd] = handle;
+      return fd;
+    }
+  }
+  errno = EMFILE;
+  return -1;
+}
+
+/* ------------------------------------------------------------- transport -- */
+
+static int raw_read_full(void *buf, size_t n) {
+  char *p = (char *)buf;
+  while (n > 0) {
+    ssize_t r = syscall(SYS_read, g_sock, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (r == 0) return -1; /* simulator went away */
+    p += r;
+    n -= (size_t)r;
+  }
+  return 0;
+}
+
+static int raw_write_full(const void *buf, size_t n) {
+  const char *p = (const char *)buf;
+  while (n > 0) {
+    /* MSG_NOSIGNAL: a torn-down simulator must not SIGPIPE the plugin */
+    ssize_t r = syscall(SYS_sendto, g_sock, p, n, MSG_NOSIGNAL, NULL, 0);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    p += r;
+    n -= (size_t)r;
+  }
+  return 0;
+}
+
+/* One protocol transaction.  Returns the response's ret field (errno already
+ * set for negatives); *resp_payload and *resp_len describe payload bytes copied
+ * into resp_buf (caller-provided, resp_cap bytes, excess discarded). */
+static int64_t transact(uint32_t op, int64_t a, int64_t b, int64_t c,
+                        int64_t d, const void *payload, uint32_t payload_len,
+                        void *resp_buf, uint32_t resp_cap,
+                        uint32_t *resp_len) {
+  if (resp_len) *resp_len = 0;
+  if (!g_active) {
+    errno = ENOSYS;
+    return -1;
+  }
+  pthread_mutex_lock(&g_lock);
+  unsigned char hdr[SHD_REQ_HDR_LEN];
+  uint32_t len = SHD_REQ_HDR_LEN + payload_len;
+  memcpy(hdr, &len, 4);
+  memcpy(hdr + 4, &op, 4);
+  memcpy(hdr + 8, &a, 8);
+  memcpy(hdr + 16, &b, 8);
+  memcpy(hdr + 24, &c, 8);
+  memcpy(hdr + 32, &d, 8);
+  if (raw_write_full(hdr, sizeof hdr) != 0 ||
+      (payload_len && raw_write_full(payload, payload_len) != 0)) {
+    pthread_mutex_unlock(&g_lock);
+    errno = EPIPE;
+    return -1;
+  }
+  unsigned char rhdr[SHD_RESP_HDR_LEN];
+  if (raw_read_full(rhdr, sizeof rhdr) != 0) {
+    pthread_mutex_unlock(&g_lock);
+    /* Simulator closed the channel: the virtual host was shut down.  Exit
+     * quietly like a process whose machine powered off. */
+    syscall(SYS_exit_group, 0);
+    errno = EPIPE;
+    return -1;
+  }
+  uint32_t rlen;
+  int64_t ret, vtime;
+  memcpy(&rlen, rhdr, 4);
+  memcpy(&ret, rhdr + 8, 8);
+  memcpy(&vtime, rhdr + 16, 8);
+  g_vtime_ns = vtime;
+  uint32_t plen = rlen - SHD_RESP_HDR_LEN;
+  uint32_t want = plen < resp_cap ? plen : resp_cap;
+  if (want && raw_read_full(resp_buf, want) != 0) {
+    pthread_mutex_unlock(&g_lock);
+    errno = EPIPE;
+    return -1;
+  }
+  /* drain any excess the caller's buffer couldn't hold */
+  uint32_t excess = plen - want;
+  while (excess > 0) {
+    char sink[512];
+    uint32_t step = excess < sizeof sink ? excess : (uint32_t)sizeof sink;
+    if (raw_read_full(sink, step) != 0) break;
+    excess -= step;
+  }
+  pthread_mutex_unlock(&g_lock);
+  if (resp_len) *resp_len = want;
+  if (ret < 0) {
+    errno = (int)-ret;
+    return -1;
+  }
+  return ret;
+}
+
+static int64_t transact0(uint32_t op, int64_t a, int64_t b, int64_t c,
+                         int64_t d) {
+  return transact(op, a, b, c, d, NULL, 0, NULL, 0, NULL);
+}
+
+/* --------------------------------------------------------------- helpers -- */
+
+static int sockaddr_to_ip_port(const struct sockaddr *addr, socklen_t len,
+                               uint32_t *ip, uint16_t *port) {
+  if (!addr || len < (socklen_t)sizeof(struct sockaddr_in) ||
+      addr->sa_family != AF_INET)
+    return -1;
+  const struct sockaddr_in *sin = (const struct sockaddr_in *)addr;
+  *ip = ntohl(sin->sin_addr.s_addr);
+  *port = ntohs(sin->sin_port);
+  return 0;
+}
+
+static void fill_sockaddr(struct sockaddr *addr, socklen_t *alen, uint32_t ip,
+                          uint16_t port) {
+  if (!addr || !alen) return;
+  struct sockaddr_in sin;
+  memset(&sin, 0, sizeof sin);
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = htonl(ip);
+  sin.sin_port = htons(port);
+  socklen_t n = *alen < (socklen_t)sizeof sin ? *alen : (socklen_t)sizeof sin;
+  memcpy(addr, &sin, n);
+  *alen = sizeof sin;
+}
+
+static void mark_sim_fd(int appfd, int on) {
+  if (appfd >= 0 && appfd < SHADOW_TPU_SIM_FD_MAX) g_sim_fd[appfd] = (unsigned char)(on != 0);
+}
+
+/* nonblock bookkeeping lives simulator-side (OP_FCNTL), but sends also carry
+ * the per-call MSG_DONTWAIT bit */
+static int64_t nb_flag(int flags) { return (flags & MSG_DONTWAIT) ? 1 : 0; }
+
+/* ----------------------------------------------------------------- time -- */
+
+extern "C" int gettimeofday(struct timeval *tv, void *tz) {
+  if (!g_active) return REAL(gettimeofday)(tv, tz);
+  if (tv) {
+    int64_t emu = g_epoch_ns + g_vtime_ns;
+    tv->tv_sec = emu / 1000000000LL;
+    tv->tv_usec = (emu % 1000000000LL) / 1000;
+  }
+  return 0;
+}
+
+extern "C" int clock_gettime(clockid_t clk, struct timespec *ts) {
+  if (!g_active) return REAL(clock_gettime)(clk, ts);
+  int64_t t = g_vtime_ns;
+  if (clk == CLOCK_REALTIME || clk == CLOCK_REALTIME_COARSE ||
+      clk == CLOCK_TAI)
+    t += g_epoch_ns;
+  if (ts) {
+    ts->tv_sec = t / 1000000000LL;
+    ts->tv_nsec = t % 1000000000LL;
+  }
+  return 0;
+}
+
+extern "C" time_t time(time_t *out) {
+  if (!g_active) return REAL(time)(out);
+  time_t t = (time_t)((g_epoch_ns + g_vtime_ns) / 1000000000LL);
+  if (out) *out = t;
+  return t;
+}
+
+extern "C" int nanosleep(const struct timespec *req, struct timespec *rem) {
+  if (!g_active) return REAL(nanosleep)(req, rem);
+  if (!req) { errno = EFAULT; return -1; }
+  int64_t ns = (int64_t)req->tv_sec * 1000000000LL + req->tv_nsec;
+  if (transact0(SHD_OP_SLEEP, ns, 0, 0, 0) < 0) return -1;
+  if (rem) { rem->tv_sec = 0; rem->tv_nsec = 0; }
+  return 0;
+}
+
+extern "C" int clock_nanosleep(clockid_t clk, int flags,
+                               const struct timespec *req,
+                               struct timespec *rem) {
+  if (!g_active) return REAL(clock_nanosleep)(clk, flags, req, rem);
+  if (!req) return EFAULT;
+  int64_t ns = (int64_t)req->tv_sec * 1000000000LL + req->tv_nsec;
+  if (flags & TIMER_ABSTIME) {
+    int64_t now = g_vtime_ns +
+                  ((clk == CLOCK_REALTIME) ? g_epoch_ns : 0);
+    ns = ns > now ? ns - now : 0;
+  }
+  if (transact0(SHD_OP_SLEEP, ns, 0, 0, 0) < 0) return errno;
+  if (rem) { rem->tv_sec = 0; rem->tv_nsec = 0; }
+  return 0;
+}
+
+extern "C" unsigned int sleep(unsigned int seconds) {
+  if (!g_active) return REAL(sleep)(seconds);
+  transact0(SHD_OP_SLEEP, (int64_t)seconds * 1000000000LL, 0, 0, 0);
+  return 0;
+}
+
+extern "C" int usleep(useconds_t usec) {
+  if (!g_active) return REAL(usleep)(usec);
+  return transact0(SHD_OP_SLEEP, (int64_t)usec * 1000LL, 0, 0, 0) < 0 ? -1 : 0;
+}
+
+/* -------------------------------------------------------------- sockets -- */
+
+extern "C" int socket(int domain, int type, int protocol) {
+  resolve_reals();
+  int base_type = type & ~(SOCK_NONBLOCK | SOCK_CLOEXEC);
+  if (!g_active || (domain != AF_INET && domain != AF_INET6) ||
+      (base_type != SOCK_STREAM && base_type != SOCK_DGRAM))
+    return REAL(socket)(domain, type, protocol);
+  int64_t h = transact0(SHD_OP_SOCKET, domain, base_type, protocol, 0);
+  if (h < 0) return -1;
+  int fd = to_appfd(h);
+  mark_sim_fd(fd, 1);
+  if (type & SOCK_NONBLOCK)
+    transact0(SHD_OP_FCNTL, h, F_SETFL, O_NONBLOCK, 0);
+  return fd;
+}
+
+extern "C" int bind(int fd, const struct sockaddr *addr, socklen_t len) {
+  if (!is_sim_fd(fd)) return REAL(bind)(fd, addr, len);
+  uint32_t ip; uint16_t port;
+  if (sockaddr_to_ip_port(addr, len, &ip, &port) != 0) {
+    errno = EINVAL;
+    return -1;
+  }
+  return transact0(SHD_OP_BIND, to_handle(fd), ip, port, 0) < 0 ? -1 : 0;
+}
+
+extern "C" int listen(int fd, int backlog) {
+  if (!is_sim_fd(fd)) return REAL(listen)(fd, backlog);
+  return transact0(SHD_OP_LISTEN, to_handle(fd), backlog, 0, 0) < 0 ? -1 : 0;
+}
+
+static int do_accept(int fd, struct sockaddr *addr, socklen_t *alen,
+                     int flags) {
+  unsigned char buf[8];
+  uint32_t got = 0;
+  int64_t h = transact(SHD_OP_ACCEPT, to_handle(fd),
+                       (flags & SOCK_NONBLOCK) ? 1 : 0, 0, 0, NULL, 0, buf,
+                       sizeof buf, &got);
+  if (h < 0) return -1;
+  int newfd = to_appfd(h);
+  mark_sim_fd(newfd, 1);
+  if (got >= 6) {
+    uint32_t ip;
+    uint16_t port;
+    memcpy(&ip, buf, 4);
+    memcpy(&port, buf + 4, 2);
+    fill_sockaddr(addr, alen, ip, port);
+  }
+  if (flags & SOCK_NONBLOCK)
+    transact0(SHD_OP_FCNTL, h, F_SETFL, O_NONBLOCK, 0);
+  return newfd;
+}
+
+extern "C" int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
+  if (!is_sim_fd(fd)) return REAL(accept)(fd, addr, alen);
+  return do_accept(fd, addr, alen, 0);
+}
+
+extern "C" int accept4(int fd, struct sockaddr *addr, socklen_t *alen,
+                       int flags) {
+  if (!is_sim_fd(fd)) return REAL(accept4)(fd, addr, alen, flags);
+  return do_accept(fd, addr, alen, flags);
+}
+
+extern "C" int connect(int fd, const struct sockaddr *addr, socklen_t len) {
+  if (!is_sim_fd(fd)) return REAL(connect)(fd, addr, len);
+  uint32_t ip; uint16_t port;
+  if (sockaddr_to_ip_port(addr, len, &ip, &port) != 0) {
+    errno = EINVAL;
+    return -1;
+  }
+  return transact0(SHD_OP_CONNECT, to_handle(fd), ip, port, 0) < 0 ? -1 : 0;
+}
+
+extern "C" ssize_t send(int fd, const void *buf, size_t n, int flags) {
+  if (!is_sim_fd(fd)) return REAL(send)(fd, buf, n, flags);
+  if (n > SHD_MAX_PAYLOAD) n = SHD_MAX_PAYLOAD;
+  return (ssize_t)transact(SHD_OP_SEND, to_handle(fd), nb_flag(flags), 0, 0,
+                           buf, (uint32_t)n, NULL, 0, NULL);
+}
+
+extern "C" ssize_t sendto(int fd, const void *buf, size_t n, int flags,
+                          const struct sockaddr *addr, socklen_t alen) {
+  if (!is_sim_fd(fd)) return REAL(sendto)(fd, buf, n, flags, addr, alen);
+  if (n > SHD_MAX_PAYLOAD) n = SHD_MAX_PAYLOAD;
+  if (!addr)
+    return (ssize_t)transact(SHD_OP_SEND, to_handle(fd), nb_flag(flags), 0, 0,
+                             buf, (uint32_t)n, NULL, 0, NULL);
+  uint32_t ip; uint16_t port;
+  if (sockaddr_to_ip_port(addr, alen, &ip, &port) != 0) {
+    errno = EINVAL;
+    return -1;
+  }
+  return (ssize_t)transact(SHD_OP_SENDTO, to_handle(fd), nb_flag(flags), ip,
+                           port, buf, (uint32_t)n, NULL, 0, NULL);
+}
+
+extern "C" ssize_t recv(int fd, void *buf, size_t n, int flags) {
+  if (!is_sim_fd(fd)) return REAL(recv)(fd, buf, n, flags);
+  size_t total = 0;
+  do {
+    uint32_t got = 0;
+    int64_t r = transact(SHD_OP_RECV, to_handle(fd), (int64_t)(n - total),
+                         nb_flag(flags), 0, NULL, 0, (char *)buf + total,
+                         (uint32_t)(n - total), &got);
+    if (r < 0) return total ? (ssize_t)total : -1;
+    if (got == 0) return (ssize_t)total; /* EOF */
+    total += got;
+  } while ((flags & MSG_WAITALL) && total < n);
+  return (ssize_t)total;
+}
+
+extern "C" ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
+                            struct sockaddr *addr, socklen_t *alen) {
+  if (!is_sim_fd(fd)) return REAL(recvfrom)(fd, buf, n, flags, addr, alen);
+  if (!addr) return recv(fd, buf, n, flags);
+  /* payload: u32 ip, u16 port, data */
+  size_t cap = (n > SHD_MAX_PAYLOAD ? SHD_MAX_PAYLOAD : n) + 6;
+  unsigned char *tmp = (unsigned char *)malloc(cap);
+  if (!tmp) { errno = ENOMEM; return -1; }
+  uint32_t got = 0;
+  int64_t r = transact(SHD_OP_RECVFROM, to_handle(fd), (int64_t)n,
+                       nb_flag(flags), 0, NULL, 0, tmp, (uint32_t)cap, &got);
+  if (r < 0) { free(tmp); return -1; }
+  if (got < 6) { free(tmp); return 0; }
+  uint32_t ip;
+  uint16_t port;
+  memcpy(&ip, tmp, 4);
+  memcpy(&port, tmp + 4, 2);
+  fill_sockaddr(addr, alen, ip, port);
+  uint32_t dlen = got - 6;
+  size_t out = dlen < n ? dlen : n;
+  memcpy(buf, tmp + 6, out);
+  free(tmp);
+  return (ssize_t)out;
+}
+
+extern "C" ssize_t sendmsg(int fd, const struct msghdr *msg, int flags) {
+  if (!is_sim_fd(fd)) return REAL(sendmsg)(fd, msg, flags);
+  if (!msg) { errno = EFAULT; return -1; }
+  /* flatten iovecs */
+  size_t total = 0;
+  for (size_t i = 0; i < msg->msg_iovlen; i++)
+    total += msg->msg_iov[i].iov_len;
+  if (total > SHD_MAX_PAYLOAD) total = SHD_MAX_PAYLOAD;
+  char *flat = (char *)malloc(total ? total : 1);
+  size_t off = 0;
+  for (size_t i = 0; i < msg->msg_iovlen && off < total; i++) {
+    size_t l = msg->msg_iov[i].iov_len;
+    if (l > total - off) l = total - off;
+    memcpy(flat + off, msg->msg_iov[i].iov_base, l);
+    off += l;
+  }
+  ssize_t r;
+  if (msg->msg_name) {
+    uint32_t ip; uint16_t port;
+    if (sockaddr_to_ip_port((const struct sockaddr *)msg->msg_name,
+                            msg->msg_namelen, &ip, &port) != 0) {
+      free(flat);
+      errno = EINVAL;
+      return -1;
+    }
+    r = (ssize_t)transact(SHD_OP_SENDTO, to_handle(fd), nb_flag(flags), ip,
+                          port, flat, (uint32_t)off, NULL, 0, NULL);
+  } else {
+    r = (ssize_t)transact(SHD_OP_SEND, to_handle(fd), nb_flag(flags), 0, 0,
+                          flat, (uint32_t)off, NULL, 0, NULL);
+  }
+  free(flat);
+  return r;
+}
+
+extern "C" ssize_t recvmsg(int fd, struct msghdr *msg, int flags) {
+  if (!is_sim_fd(fd)) return REAL(recvmsg)(fd, msg, flags);
+  if (!msg || msg->msg_iovlen == 0) { errno = EINVAL; return -1; }
+  msg->msg_controllen = 0;
+  msg->msg_flags = 0;
+  socklen_t alen = msg->msg_namelen;
+  ssize_t r = recvfrom(fd, msg->msg_iov[0].iov_base, msg->msg_iov[0].iov_len,
+                       flags, (struct sockaddr *)msg->msg_name,
+                       msg->msg_name ? &alen : NULL);
+  if (r >= 0 && msg->msg_name) msg->msg_namelen = alen;
+  return r;
+}
+
+extern "C" int shutdown(int fd, int how) {
+  if (!is_sim_fd(fd)) return REAL(shutdown)(fd, how);
+  return transact0(SHD_OP_SHUTDOWN, to_handle(fd), how, 0, 0) < 0 ? -1 : 0;
+}
+
+extern "C" int getsockopt(int fd, int level, int optname, void *optval,
+                          socklen_t *optlen) {
+  if (!is_sim_fd(fd)) return REAL(getsockopt)(fd, level, optname, optval, optlen);
+  int32_t v = 0;
+  uint32_t got = 0;
+  if (transact(SHD_OP_GETSOCKOPT, to_handle(fd), level, optname, 0, NULL, 0,
+               &v, sizeof v, &got) < 0)
+    return -1;
+  if (optval && optlen && *optlen >= (socklen_t)sizeof v) {
+    memcpy(optval, &v, sizeof v);
+    *optlen = sizeof v;
+  }
+  return 0;
+}
+
+extern "C" int setsockopt(int fd, int level, int optname, const void *optval,
+                          socklen_t optlen) {
+  if (!is_sim_fd(fd)) return REAL(setsockopt)(fd, level, optname, optval, optlen);
+  return transact(SHD_OP_SETSOCKOPT, to_handle(fd), level, optname, 0, optval,
+                  optlen, NULL, 0, NULL) < 0 ? -1 : 0;
+}
+
+static int name_query(int op, int fd, struct sockaddr *addr, socklen_t *alen) {
+  unsigned char buf[6];
+  uint32_t got = 0;
+  if (transact((uint32_t)op, to_handle(fd), 0, 0, 0, NULL, 0, buf, sizeof buf,
+               &got) < 0)
+    return -1;
+  if (got >= 6) {
+    uint32_t ip;
+    uint16_t port;
+    memcpy(&ip, buf, 4);
+    memcpy(&port, buf + 4, 2);
+    fill_sockaddr(addr, alen, ip, port);
+  }
+  return 0;
+}
+
+extern "C" int getsockname(int fd, struct sockaddr *addr, socklen_t *alen) {
+  if (!is_sim_fd(fd)) return REAL(getsockname)(fd, addr, alen);
+  return name_query(SHD_OP_GETSOCKNAME, fd, addr, alen);
+}
+
+extern "C" int getpeername(int fd, struct sockaddr *addr, socklen_t *alen) {
+  if (!is_sim_fd(fd)) return REAL(getpeername)(fd, addr, alen);
+  return name_query(SHD_OP_GETPEERNAME, fd, addr, alen);
+}
+
+/* --------------------------------------------------------- read/write/fd -- */
+
+extern "C" ssize_t read(int fd, void *buf, size_t n) {
+  if (!is_sim_fd(fd)) return REAL(read)(fd, buf, n);
+  uint32_t got = 0;
+  int64_t r = transact(SHD_OP_READ, to_handle(fd), (int64_t)n, 0, 0, NULL, 0,
+                       buf, (uint32_t)n, &got);
+  if (r < 0) return -1;
+  return (ssize_t)got;
+}
+
+extern "C" ssize_t write(int fd, const void *buf, size_t n) {
+  if (!is_sim_fd(fd)) return REAL(write)(fd, buf, n);
+  if (n > SHD_MAX_PAYLOAD) n = SHD_MAX_PAYLOAD;
+  return (ssize_t)transact(SHD_OP_WRITE, to_handle(fd), 0, 0, 0, buf,
+                           (uint32_t)n, NULL, 0, NULL);
+}
+
+extern "C" ssize_t readv(int fd, const struct iovec *iov, int iovcnt) {
+  if (!is_sim_fd(fd)) return REAL(readv)(fd, iov, iovcnt);
+  ssize_t total = 0;
+  for (int i = 0; i < iovcnt; i++) {
+    ssize_t r = read(fd, iov[i].iov_base, iov[i].iov_len);
+    if (r < 0) return total ? total : -1;
+    total += r;
+    if ((size_t)r < iov[i].iov_len) break;
+  }
+  return total;
+}
+
+extern "C" ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
+  if (!is_sim_fd(fd)) return REAL(writev)(fd, iov, iovcnt);
+  ssize_t total = 0;
+  for (int i = 0; i < iovcnt; i++) {
+    ssize_t r = write(fd, iov[i].iov_base, iov[i].iov_len);
+    if (r < 0) return total ? total : -1;
+    total += r;
+    if ((size_t)r < iov[i].iov_len) break;
+  }
+  return total;
+}
+
+extern "C" int close(int fd) {
+  if (!is_sim_fd(fd)) return REAL(close)(fd);
+  mark_sim_fd(fd, 0);
+  return transact0(SHD_OP_CLOSE, to_handle(fd), 0, 0, 0) < 0 ? -1 : 0;
+}
+
+extern "C" int fcntl(int fd, int cmd, ...) {
+  va_list ap;
+  va_start(ap, cmd);
+  long arg = va_arg(ap, long);
+  va_end(ap);
+  resolve_reals();
+  if (!is_sim_fd(fd)) return REAL(fcntl)(fd, cmd, arg);
+  switch (cmd) {
+    case F_GETFL:
+    case F_SETFL:
+      return (int)transact0(SHD_OP_FCNTL, to_handle(fd), cmd, arg, 0);
+    case F_GETFD:
+      return 0;
+    case F_SETFD:
+      return 0;
+    default:
+      errno = EINVAL;
+      return -1;
+  }
+}
+
+extern "C" int ioctl(int fd, unsigned long request, ...) {
+  va_list ap;
+  va_start(ap, request);
+  void *argp = va_arg(ap, void *);
+  va_end(ap);
+  resolve_reals();
+  if (!is_sim_fd(fd)) return REAL(ioctl)(fd, request, argp);
+  if (request == FIONBIO) {
+    int on = argp ? *(int *)argp : 0;
+    int64_t fl = transact0(SHD_OP_FCNTL, to_handle(fd), F_GETFL, 0, 0);
+    if (fl < 0) return -1;
+    long nf = on ? (fl | O_NONBLOCK) : (fl & ~O_NONBLOCK);
+    return (int)transact0(SHD_OP_FCNTL, to_handle(fd), F_SETFL, nf, 0);
+  }
+  if (request == FIONREAD) {
+    int64_t r = transact0(SHD_OP_IOCTL, to_handle(fd), (int64_t)request, 0, 0);
+    if (r < 0) return -1;
+    if (argp) *(int *)argp = (int)r;
+    return 0;
+  }
+  errno = ENOTTY;
+  return -1;
+}
+
+extern "C" int dup(int fd) {
+  if (!is_sim_fd(fd)) return REAL(dup)(fd);
+  errno = ENOTSUP; /* descriptor aliasing not modelled (reference: shadow fds
+                      aren't dup-able either outside the OS-handle map) */
+  return -1;
+}
+
+extern "C" int dup2(int oldfd, int newfd) {
+  if (!is_sim_fd(oldfd) && !is_sim_fd(newfd))
+    return REAL(dup2)(oldfd, newfd);
+  errno = ENOTSUP;
+  return -1;
+}
+
+/* ----------------------------------------------------------------- epoll -- */
+
+extern "C" int epoll_create(int size) {
+  resolve_reals();
+  (void)size;
+  if (!g_active) return REAL(epoll_create)(size);
+  int64_t h = transact0(SHD_OP_EPOLL_CREATE, 0, 0, 0, 0);
+  if (h < 0) return -1;
+  int fd = to_appfd(h);
+  mark_sim_fd(fd, 1);
+  return fd;
+}
+
+extern "C" int epoll_create1(int flags) {
+  resolve_reals();
+  if (!g_active) return REAL(epoll_create1)(flags);
+  return epoll_create(1);
+}
+
+extern "C" int epoll_ctl(int epfd, int op, int fd, struct epoll_event *ev) {
+  if (!is_sim_fd(epfd)) return REAL(epoll_ctl)(epfd, op, fd, ev);
+  if (!is_sim_fd(fd)) {
+    /* Watching a real OS fd through a simulated epoll is not modelled (the
+     * reference bridges these via epoll_controlOS; our plugins are separate
+     * processes so their real fds never interact with virtual readiness). */
+    errno = EPERM;
+    return -1;
+  }
+  int64_t events = ev ? ev->events : 0;
+  uint64_t data = ev ? ev->data.u64 : 0;
+  int wire_op = op == EPOLL_CTL_ADD ? 1 : op == EPOLL_CTL_MOD ? 2 : 3;
+  return transact(SHD_OP_EPOLL_CTL, to_handle(epfd), wire_op, to_handle(fd),
+                  events, &data, 8, NULL, 0, NULL) < 0 ? -1 : 0;
+}
+
+extern "C" int epoll_wait(int epfd, struct epoll_event *events, int maxevents,
+                          int timeout) {
+  if (!is_sim_fd(epfd)) return REAL(epoll_wait)(epfd, events, maxevents, timeout);
+  if (maxevents <= 0) { errno = EINVAL; return -1; }
+  if (maxevents > 256) maxevents = 256;
+  unsigned char buf[256 * 12];
+  uint32_t got = 0;
+  int64_t n = transact(SHD_OP_EPOLL_WAIT, to_handle(epfd), maxevents, timeout,
+                       0, NULL, 0, buf, sizeof buf, &got);
+  if (n < 0) return -1;
+  int count = (int)(got / 12);
+  for (int i = 0; i < count; i++) {
+    uint32_t e;
+    uint64_t d;
+    memcpy(&e, buf + i * 12, 4);
+    memcpy(&d, buf + i * 12 + 4, 8);
+    events[i].events = e;
+    events[i].data.u64 = d;
+  }
+  return count;
+}
+
+extern "C" int epoll_pwait(int epfd, struct epoll_event *events, int maxevents,
+                           int timeout, const sigset_t *sigmask) {
+  if (!is_sim_fd(epfd))
+    return REAL(epoll_pwait)(epfd, events, maxevents, timeout, sigmask);
+  return epoll_wait(epfd, events, maxevents, timeout);
+}
+
+/* ------------------------------------------------------------ poll/select -- */
+
+extern "C" int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
+  resolve_reals();
+  int any_sim = 0;
+  for (nfds_t i = 0; i < nfds; i++)
+    if (is_sim_fd(fds[i].fd)) any_sim = 1;
+  if (!any_sim) return REAL(poll)(fds, nfds, timeout);
+  /* payload: n * (i32 handle, i16 events); real fds are sent as handle -1
+   * and always report no readiness (cross-plane poll isn't modelled) */
+  if (nfds > 512) { errno = EINVAL; return -1; }
+  unsigned char req[512 * 6];
+  for (nfds_t i = 0; i < nfds; i++) {
+    int32_t h = is_sim_fd(fds[i].fd) ? to_handle(fds[i].fd) : -1;
+    int16_t e = (int16_t)fds[i].events;
+    memcpy(req + i * 6, &h, 4);
+    memcpy(req + i * 6 + 4, &e, 2);
+  }
+  unsigned char resp[512 * 2];
+  uint32_t got = 0;
+  int64_t n = transact(SHD_OP_POLL, (int64_t)nfds, timeout, 0, 0, req,
+                       (uint32_t)(nfds * 6), resp, sizeof resp, &got);
+  if (n < 0) return -1;
+  for (nfds_t i = 0; i < nfds && i * 2 + 2 <= got; i++) {
+    int16_t rev;
+    memcpy(&rev, resp + i * 2, 2);
+    fds[i].revents = rev;
+  }
+  return (int)n;
+}
+
+extern "C" int select(int nfds, fd_set *readfds, fd_set *writefds,
+                      fd_set *exceptfds, struct timeval *timeout) {
+  resolve_reals();
+  int any_sim = 0;
+  for (int fd = 0; fd < nfds && fd < FD_SETSIZE; fd++) {
+    if ((readfds && FD_ISSET(fd, readfds)) ||
+        (writefds && FD_ISSET(fd, writefds)) ||
+        (exceptfds && FD_ISSET(fd, exceptfds)))
+      if (is_sim_fd(fd)) any_sim = 1;
+  }
+  if (!any_sim)
+    return REAL(select)(nfds, readfds, writefds, exceptfds, timeout);
+  /* translate to poll over the sim fds */
+  struct pollfd pfds[FD_SETSIZE];
+  int n = 0;
+  for (int fd = 0; fd < nfds && fd < FD_SETSIZE; fd++) {
+    short ev = 0;
+    if (readfds && FD_ISSET(fd, readfds)) ev |= POLLIN;
+    if (writefds && FD_ISSET(fd, writefds)) ev |= POLLOUT;
+    if (exceptfds && FD_ISSET(fd, exceptfds)) ev |= POLLERR;
+    if (ev) {
+      pfds[n].fd = fd;
+      pfds[n].events = ev;
+      pfds[n].revents = 0;
+      n++;
+    }
+  }
+  int timeout_ms = -1;
+  if (timeout)
+    timeout_ms = (int)(timeout->tv_sec * 1000 + timeout->tv_usec / 1000);
+  int r = poll(pfds, (nfds_t)n, timeout_ms);
+  if (r < 0) return -1;
+  if (readfds) FD_ZERO(readfds);
+  if (writefds) FD_ZERO(writefds);
+  if (exceptfds) FD_ZERO(exceptfds);
+  int ready = 0;
+  for (int i = 0; i < n; i++) {
+    int fd = pfds[i].fd;
+    int hit = 0;
+    if (readfds && (pfds[i].revents & (POLLIN | POLLHUP))) {
+      FD_SET(fd, readfds);
+      hit = 1;
+    }
+    if (writefds && (pfds[i].revents & POLLOUT)) {
+      FD_SET(fd, writefds);
+      hit = 1;
+    }
+    if (exceptfds && (pfds[i].revents & POLLERR)) {
+      FD_SET(fd, exceptfds);
+      hit = 1;
+    }
+    if (hit) ready++;
+  }
+  return ready;
+}
+
+/* -------------------------------------------------------------- timerfd -- */
+
+extern "C" int timerfd_create(int clockid, int flags) {
+  resolve_reals();
+  if (!g_active) return REAL(timerfd_create)(clockid, flags);
+  (void)clockid;
+  int64_t h = transact0(SHD_OP_TIMERFD_CREATE, 0, 0, 0, 0);
+  if (h < 0) return -1;
+  int fd = to_appfd(h);
+  mark_sim_fd(fd, 1);
+  if (flags & TFD_NONBLOCK)
+    transact0(SHD_OP_FCNTL, h, F_SETFL, O_NONBLOCK, 0);
+  return fd;
+}
+
+extern "C" int timerfd_settime(int fd, int flags, const struct itimerspec *newv,
+                               struct itimerspec *oldv) {
+  if (!is_sim_fd(fd)) return REAL(timerfd_settime)(fd, flags, newv, oldv);
+  if (!newv) { errno = EFAULT; return -1; }
+  int64_t init = (int64_t)newv->it_value.tv_sec * 1000000000LL +
+                 newv->it_value.tv_nsec;
+  int64_t iv = (int64_t)newv->it_interval.tv_sec * 1000000000LL +
+               newv->it_interval.tv_nsec;
+  if (flags & TFD_TIMER_ABSTIME) {
+    int64_t now = g_vtime_ns + g_epoch_ns;
+    init = init > now ? init - now : (init > 0 ? 1 : 0);
+  }
+  if (oldv) memset(oldv, 0, sizeof *oldv);
+  return transact0(SHD_OP_TIMERFD_SETTIME, to_handle(fd), init, iv, 0) < 0
+             ? -1 : 0;
+}
+
+/* ----------------------------------------------------------------- pipes -- */
+
+extern "C" int pipe(int fds[2]) {
+  resolve_reals();
+  if (!g_active) return REAL(pipe)(fds);
+  unsigned char buf[4];
+  uint32_t got = 0;
+  int64_t r = transact(SHD_OP_PIPE, 0, 0, 0, 0, NULL, 0, buf, sizeof buf,
+                       &got);
+  if (r < 0) return -1;
+  uint32_t wh;
+  memcpy(&wh, buf, 4);
+  fds[0] = to_appfd(r);
+  fds[1] = to_appfd((int64_t)wh);
+  mark_sim_fd(fds[0], 1);
+  mark_sim_fd(fds[1], 1);
+  return 0;
+}
+
+extern "C" int pipe2(int fds[2], int flags) {
+  resolve_reals();
+  if (!g_active) return REAL(pipe2)(fds, flags);
+  if (pipe(fds) != 0) return -1;
+  if (flags & O_NONBLOCK) {
+    transact0(SHD_OP_FCNTL, to_handle(fds[0]), F_SETFL, O_NONBLOCK, 0);
+    transact0(SHD_OP_FCNTL, to_handle(fds[1]), F_SETFL, O_NONBLOCK, 0);
+  }
+  return 0;
+}
+
+/* ------------------------------------------------------------- DNS/names -- */
+
+static std::set<struct addrinfo *> *g_our_addrinfo;
+
+extern "C" int getaddrinfo(const char *node, const char *service,
+                           const struct addrinfo *hints,
+                           struct addrinfo **res) {
+  resolve_reals();
+  if (!g_active || !node)
+    return REAL(getaddrinfo)(node, service, hints, res);
+  uint32_t ip_buf = 0;
+  uint32_t got = 0;
+  if (transact(SHD_OP_GETADDRINFO, 0, 0, 0, 0, node,
+               (uint32_t)strlen(node), &ip_buf, sizeof ip_buf, &got) < 0)
+    return EAI_NONAME;
+  uint16_t port = 0;
+  if (service) port = (uint16_t)atoi(service);
+  struct addrinfo *ai = (struct addrinfo *)calloc(1, sizeof *ai);
+  struct sockaddr_in *sin = (struct sockaddr_in *)calloc(1, sizeof *sin);
+  sin->sin_family = AF_INET;
+  sin->sin_addr.s_addr = htonl(ip_buf);
+  sin->sin_port = htons(port);
+  ai->ai_family = AF_INET;
+  ai->ai_socktype = hints ? hints->ai_socktype : SOCK_STREAM;
+  ai->ai_protocol = 0;
+  ai->ai_addrlen = sizeof *sin;
+  ai->ai_addr = (struct sockaddr *)sin;
+  pthread_mutex_lock(&g_lock);
+  if (!g_our_addrinfo) g_our_addrinfo = new std::set<struct addrinfo *>();
+  g_our_addrinfo->insert(ai);
+  pthread_mutex_unlock(&g_lock);
+  *res = ai;
+  return 0;
+}
+
+extern "C" void freeaddrinfo(struct addrinfo *res) {
+  resolve_reals();
+  pthread_mutex_lock(&g_lock);
+  bool ours = g_our_addrinfo && g_our_addrinfo->erase(res) > 0;
+  pthread_mutex_unlock(&g_lock);
+  if (ours) {
+    free(res->ai_addr);
+    free(res);
+    return;
+  }
+  REAL(freeaddrinfo)(res);
+}
+
+extern "C" struct hostent *gethostbyname(const char *name) {
+  resolve_reals();
+  if (!g_active) return REAL(gethostbyname)(name);
+  static __thread struct hostent he;
+  static __thread char hname[256];
+  static __thread uint32_t addr_net;
+  static __thread char *addr_list[2];
+  uint32_t ip_buf = 0;
+  uint32_t got = 0;
+  if (transact(SHD_OP_GETADDRINFO, 0, 0, 0, 0, name,
+               (uint32_t)strlen(name), &ip_buf, sizeof ip_buf, &got) < 0)
+    return NULL;
+  snprintf(hname, sizeof hname, "%s", name);
+  addr_net = htonl(ip_buf);
+  addr_list[0] = (char *)&addr_net;
+  addr_list[1] = NULL;
+  he.h_name = hname;
+  he.h_aliases = NULL;
+  he.h_addrtype = AF_INET;
+  he.h_length = 4;
+  he.h_addr_list = addr_list;
+  return &he;
+}
+
+extern "C" int gethostname(char *name, size_t len) {
+  resolve_reals();
+  if (!g_active) return REAL(gethostname)(name, len);
+  char buf[256];
+  uint32_t got = 0;
+  if (transact(SHD_OP_GETHOSTNAME, 0, 0, 0, 0, NULL, 0, buf, sizeof buf - 1,
+               &got) < 0)
+    return -1;
+  buf[got] = '\0';
+  snprintf(name, len, "%s", buf);
+  return 0;
+}
+
+/* -------------------------------------------------------------- random -- */
+
+extern "C" ssize_t getrandom(void *buf, size_t buflen, unsigned int flags) {
+  resolve_reals();
+  if (!g_active) return REAL(getrandom)(buf, buflen, flags);
+  if (buflen > 4096) buflen = 4096;
+  uint32_t got = 0;
+  if (transact(SHD_OP_RANDOM, (int64_t)buflen, 0, 0, 0, NULL, 0, buf,
+               (uint32_t)buflen, &got) < 0)
+    return -1;
+  return (ssize_t)got;
+}
+
+extern "C" int getentropy(void *buf, size_t buflen) {
+  resolve_reals();
+  if (!g_active) return REAL(getentropy)(buf, buflen);
+  return getrandom(buf, buflen, 0) < 0 ? -1 : 0;
+}
+
+static int is_random_path(const char *path) {
+  return path && (strcmp(path, "/dev/random") == 0 ||
+                  strcmp(path, "/dev/urandom") == 0 ||
+                  strcmp(path, "/dev/srandom") == 0);
+}
+
+extern "C" int open(const char *path, int flags, ...) {
+  va_list ap;
+  va_start(ap, flags);
+  mode_t mode = (mode_t)va_arg(ap, int);
+  va_end(ap);
+  resolve_reals();
+  if (g_active && is_random_path(path)) {
+    int64_t h = transact0(SHD_OP_OPEN_RANDOM, 0, 0, 0, 0);
+    if (h < 0) return -1;
+    int fd = to_appfd(h);
+    mark_sim_fd(fd, 1);
+    return fd;
+  }
+  return REAL(open)(path, flags, mode);
+}
+
+extern "C" int open64(const char *path, int flags, ...) {
+  va_list ap;
+  va_start(ap, flags);
+  mode_t mode = (mode_t)va_arg(ap, int);
+  va_end(ap);
+  resolve_reals();
+  if (g_active && is_random_path(path)) return open(path, flags);
+  return REAL(open64)(path, flags, mode);
+}
+
+extern "C" int openat(int dirfd, const char *path, int flags, ...) {
+  va_list ap;
+  va_start(ap, flags);
+  mode_t mode = (mode_t)va_arg(ap, int);
+  va_end(ap);
+  resolve_reals();
+  if (g_active && is_random_path(path)) return open(path, flags);
+  return REAL(openat)(dirfd, path, flags, mode);
+}
+
+/* ----------------------------------------------------------------- exit -- */
+
+extern "C" void exit(int status) {
+  static void (*real_exit)(int) __attribute__((noreturn)) = NULL;
+  if (!real_exit) *(void **)(&real_exit) = dlsym(RTLD_NEXT, "exit");
+  if (g_active) transact0(SHD_OP_EXIT, status, 0, 0, 0);
+  real_exit(status);
+}

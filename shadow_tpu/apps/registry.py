@@ -16,11 +16,21 @@ def register(name: str):
 
 
 def resolve(path: str) -> Callable:
-    """``python:echo`` / ``echo`` -> registered app; ``pkg.mod:fn`` -> import."""
+    """``python:echo`` / ``echo`` -> registered app; ``pkg.mod:fn`` -> import;
+    ``exec:/path/to/bin`` or a path to a real executable -> native plugin
+    (runs the unmodified binary under the LD_PRELOAD interposer,
+    process/native.py — the reference's `<plugin path=...>` equivalent)."""
+    import os
+    if path.startswith("exec:"):
+        from ..process.native import make_native_app
+        return make_native_app(path[5:])
     name = path[7:] if path.startswith("python:") else path
     _ensure_builtins()
     if name in _APPS:
         return _APPS[name]
+    if os.path.sep in path and os.path.isfile(path) and os.access(path, os.X_OK):
+        from ..process.native import make_native_app
+        return make_native_app(path)
     if ":" in name:
         mod, _, fn = name.partition(":")
         return getattr(importlib.import_module(mod), fn)

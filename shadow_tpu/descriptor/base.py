@@ -119,16 +119,18 @@ class Socket(Transport):
         self.bound_ip = ip
         self.bound_port = port
 
+    def release_bindings(self) -> None:
+        """Drop every interface binding this socket holds (frees its ports/
+        4-tuples for reuse while the descriptor may stay open)."""
+        for iface, key in list(self._associations):
+            iface.disassociate_key(key, self)
+        self._associations.clear()
+
     def close(self) -> None:
         """Release every interface binding this socket holds, then close."""
         if self.closed:
             return
-        for iface, key in list(self._associations):
-            # only drop bindings that still refer to this socket — a stale
-            # pair must not evict another socket's live binding
-            if iface._bindings.get(key) is self:
-                del iface._bindings[key]
-        self._associations.clear()
+        self.release_bindings()
         super().close()
 
     # -- output queue (interface side) ------------------------------------

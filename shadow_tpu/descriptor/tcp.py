@@ -84,6 +84,7 @@ class TCPSocket(Socket):
         super().__init__(host, handle, "tcp", recv_buf_size, send_buf_size)
         self.state = CLOSED
         self.parent = parent
+        self.accepted = False  # delivered to the app via accept()
         self.error: Optional[str] = None
         # --- listener side ---
         self.backlog = 0
@@ -255,6 +256,7 @@ class TCPSocket(Socket):
     def accept_child(self) -> Optional["TCPSocket"]:
         if self.accept_queue:
             child = self.accept_queue.popleft()
+            child.accepted = True
             self.adjust_status(S_READABLE, bool(self.accept_queue))
             return child
         return None
@@ -394,9 +396,12 @@ class TCPSocket(Socket):
         self._rto_scheduled = False
 
     def _on_rto_fire(self, generation: int) -> None:
-        self._rto_scheduled = False
+        # a stale generation must not clear the flag: a live task for the
+        # current generation may still be pending, and clearing here would
+        # let _arm_rto schedule a duplicate
         if generation != self._rto_generation or self.closed:
             return
+        self._rto_scheduled = False
         now = self._now()
         if not self.unacked:
             return
@@ -459,9 +464,17 @@ class TCPSocket(Socket):
 
     def _fail_connection(self, err: str) -> None:
         self.error = err
-        self.state = CLOSED
         self._cancel_rto()
         self.eof_received = True
+        if self.parent is not None and not self.accepted:
+            # embryonic/queued child: no app holds it, so nobody will ever
+            # close() it — release the descriptor, the 4-tuple binding and
+            # the parent link now, else new SYNs from the same client port
+            # route to this dead child forever
+            self._teardown()
+        else:
+            self.state = CLOSED
+            self.release_bindings()
         self.adjust_status(S_READABLE | S_WRITABLE, True)  # wake blockers
 
     # ------------------------------------------------------------------
@@ -545,7 +558,7 @@ class TCPSocket(Socket):
         handle = host.allocate_handle()
         child = TCPSocket(host, handle, host.params.recv_buf_size,
                           host.params.send_buf_size, parent=self)
-        host._descriptors[handle] = child
+        host.register_descriptor(child)
         # reply with the address the SYN actually arrived on (matters for a
         # wildcard-bound listener reachable on loopback and eth)
         child.bind_to(packet.dst_ip, self.bound_port)
@@ -573,6 +586,9 @@ class TCPSocket(Socket):
 
     def _detach_child(self, child: "TCPSocket") -> None:
         self.children.pop((child.peer_ip, child.peer_port), None)
+        if child in self.accept_queue:
+            self.accept_queue.remove(child)
+            self._update_readable()
 
     # -- SYN_SENT ---------------------------------------------------------
     def _syn_sent_process(self, packet: Packet) -> None:
@@ -776,6 +792,18 @@ class TCPSocket(Socket):
         """Final resource release (descriptor close + binding removal)."""
         self.state = CLOSED
         self._cancel_rto()
+        # a closing listener resets every connection the app has not
+        # accepted: they would otherwise complete handshakes into a dead
+        # accept queue and leak (tcp.c resets pending children on server
+        # close)
+        for child in list(self.children.values()):
+            child.parent = None
+            if not child.accepted and not child.closed:
+                if child.state not in (CLOSED, LISTEN):
+                    child._emit(TCP_RST | TCP_ACK, child.snd_nxt)
+                child._teardown()
+        self.children.clear()
+        self.accept_queue.clear()
         if self.parent is not None:
             self.parent._detach_child(self)
         self.tally.close()

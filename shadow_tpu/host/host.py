@@ -167,6 +167,11 @@ class Host:
         self._next_handle += 1
         return h
 
+    def register_descriptor(self, desc) -> None:
+        """Single registration point for descriptors constructed with a
+        pre-allocated handle (allocate_handle + constructor)."""
+        self._descriptors[desc.handle] = desc
+
     # -- port management ---------------------------------------------------
     def allocate_ephemeral_port(self, protocol: str, iface_ip: int,
                                 ifaces=None) -> int:

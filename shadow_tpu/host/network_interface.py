@@ -93,8 +93,17 @@ class NetworkInterface:
     def disassociate(self, protocol: str, port: int, peer_ip: int = 0,
                      peer_port: int = 0) -> None:
         key = self._key(protocol, port, peer_ip, peer_port)
-        sock = self._bindings.pop(key, None)
-        assoc = getattr(sock, "_associations", None) if sock is not None else None
+        sock = self._bindings.get(key)
+        if sock is not None:
+            self.disassociate_key(key, sock)
+
+    def disassociate_key(self, key, sock) -> None:
+        """Single removal point for binding entries: drops ``key`` only if
+        it still refers to ``sock`` (a stale pair must not evict another
+        socket's live binding)."""
+        if self._bindings.get(key) is sock:
+            del self._bindings[key]
+        assoc = getattr(sock, "_associations", None)
         if assoc and (self, key) in assoc:
             assoc.remove((self, key))
 

@@ -1,0 +1,674 @@
+"""Native plugin plane: run real, unmodified binaries under the simulator.
+
+Capability parity with the reference's interposition substrate — preload/
+interposer.c routing libc calls to process.c's process_emu_* family, with
+rpth green threads providing blocking semantics against the virtual clock
+(SURVEY.md §2.7).  Our architecture runs each plugin as a real OS process
+with ``libshadow_preload.so`` (native/preload/shim.cc) LD_PRELOADed; every
+interposed libc call arrives here over a socketpair as a framed request
+(native/preload/protocol.h) and is executed against the same virtual-kernel
+objects the Python plugin plane uses (descriptors, DNS, timers, random).
+
+Scheduling contract (the determinism core): the plugin process only executes
+between our response and its next request.  The green thread that serves a
+plugin blocks in a *real* ``recv`` while the plugin computes — plugin code
+is "instantaneous" in virtual time, exactly like the reference's pth model
+(process.c:1197 process_continue runs green threads until all block).  When
+a request can't complete (blocking recv on an empty socket), the serving
+green thread yields to the simulator and the response is simply delayed
+until the virtual clock makes the operation ready — which is how real
+blocking apps run under a discrete-event clock.
+"""
+
+from __future__ import annotations
+
+import atexit
+import errno as errno_mod
+import os
+import socket as real_socket
+import struct
+import subprocess
+from typing import List, Optional
+
+from ..core import stime
+from ..core.logger import get_logger
+from ..descriptor.base import Descriptor, S_CLOSED, S_READABLE, S_WRITABLE
+from ..descriptor.epoll import Epoll, EPOLLERR, EPOLLHUP, EPOLLIN, EPOLLOUT
+from .process import _Block, _Sleep
+
+# -- protocol constants (mirror native/preload/protocol.h) -------------------
+OP_SOCKET = 1
+OP_BIND = 2
+OP_LISTEN = 3
+OP_ACCEPT = 4
+OP_CONNECT = 5
+OP_SEND = 6
+OP_SENDTO = 7
+OP_RECV = 8
+OP_RECVFROM = 9
+OP_CLOSE = 10
+OP_EPOLL_CREATE = 11
+OP_EPOLL_CTL = 12
+OP_EPOLL_WAIT = 13
+OP_POLL = 14
+OP_GETTIME = 15
+OP_SLEEP = 16
+OP_GETADDRINFO = 17
+OP_GETHOSTNAME = 18
+OP_RANDOM = 19
+OP_SETSOCKOPT = 20
+OP_GETSOCKOPT = 21
+OP_GETSOCKNAME = 22
+OP_GETPEERNAME = 23
+OP_SHUTDOWN = 24
+OP_FCNTL = 25
+OP_IOCTL = 26
+OP_OPEN_RANDOM = 27
+OP_READ = 28
+OP_WRITE = 29
+OP_EXIT = 30
+OP_LOG = 31
+OP_TIMERFD_CREATE = 32
+OP_TIMERFD_SETTIME = 33
+OP_PIPE = 34
+
+REQ_HDR = struct.Struct("<IIqqqq")
+RESP_HDR = struct.Struct("<IIqq")
+
+O_NONBLOCK = 0o4000
+F_GETFL = 3
+F_SETFL = 4
+FIONREAD = 0x541B
+SOCK_STREAM = 1
+SOCK_DGRAM = 2
+SOL_SOCKET = 1
+SO_ERROR = 4
+SO_SNDBUF = 7
+SO_RCVBUF = 8
+POLLIN = 0x001
+POLLOUT = 0x004
+POLLERR = 0x008
+POLLHUP = 0x010
+
+_PRELOAD_LIB = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                            "native", "libshadow_preload.so")
+
+_live_children: List[subprocess.Popen] = []
+
+
+def _kill_stragglers() -> None:
+    for p in _live_children:
+        if p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
+
+
+atexit.register(_kill_stragglers)
+
+
+def preload_lib_path() -> str:
+    return _PRELOAD_LIB
+
+
+def _errno_of(exc: OSError) -> int:
+    """Map our virtual-kernel OSError style ('EADDRINUSE: detail') to a
+    numeric errno."""
+    if exc.errno:
+        return exc.errno
+    text = (exc.args[0] if exc.args else "") or ""
+    name = str(text).split(":")[0].strip().split()[0] if text else ""
+    return getattr(errno_mod, name, errno_mod.EINVAL)
+
+
+class RandomDescriptor(Descriptor):
+    """Deterministic /dev/random-style source (the reference keeps per-host
+    /dev/random handles, host.c:47-105; reads come from the host PRNG)."""
+
+    def __init__(self, host, handle: int):
+        super().__init__(host, handle, "random")
+        self.adjust_status(S_READABLE, True)
+
+    def read_bytes(self, n: int) -> bytes:
+        return self.host.random.next_bytes(n)
+
+
+class NativeKernel:
+    """Dispatches one plugin's protocol requests against the virtual kernel.
+
+    Runs inside the plugin's green thread: handlers that must wait for
+    virtual readiness ``yield`` simulator blocks, so one kernel instance
+    serves exactly one plugin process, serially.
+    """
+
+    def __init__(self, api, conn: real_socket.socket):
+        self.api = api
+        self.host = api.host
+        self.conn = conn
+        self.exit_code: Optional[int] = None
+
+    # -- descriptor helpers ------------------------------------------------
+    def _desc(self, handle: int):
+        d = self.host.descriptor_table_get(int(handle))
+        if d is None:
+            raise OSError("EBADF")
+        return d
+
+    def _nonblock(self, desc) -> bool:
+        return bool(getattr(desc, "_nonblock", False))
+
+    def _recv_payload(self, desc, nbytes: int):
+        """One receive attempt -> payload tuple or None."""
+        return desc.receive_user_data(int(nbytes))
+
+    def _is_eof(self, desc) -> bool:
+        return desc.closed or desc.has_status(S_CLOSED)
+
+    # -- dispatch ----------------------------------------------------------
+    def dispatch(self, op: int, a: int, b: int, c: int, d: int,
+                 payload: bytes):
+        """Generator: returns (ret, resp_payload)."""
+        try:
+            handler = self._HANDLERS[op]
+        except KeyError:
+            return -errno_mod.ENOSYS, b""
+        try:
+            result = yield from handler(self, a, b, c, d, payload)
+        except OSError as e:
+            return -_errno_of(e), b""
+        except (FileExistsError, FileNotFoundError) as e:
+            return -_errno_of(e), b""
+        return result
+
+    # -- socket ops --------------------------------------------------------
+    def op_socket(self, a, b, c, d, payload):
+        kind = "tcp" if b == SOCK_STREAM else "udp"
+        fd = self.api.socket(kind)
+        return fd, b""
+        yield  # pragma: no cover — make this a generator
+
+    def op_bind(self, a, b, c, d, payload):
+        self.api.bind(int(a), (int(b), int(c)))
+        return 0, b""
+        yield  # pragma: no cover
+
+    def op_listen(self, a, b, c, d, payload):
+        self.api.listen(int(a), int(b))
+        return 0, b""
+        yield  # pragma: no cover
+
+    def op_accept(self, a, b, c, d, payload):
+        sock = self._desc(a)
+        while True:
+            child = sock.accept_child()
+            if child is not None:
+                break
+            if self._nonblock(sock):
+                return -errno_mod.EAGAIN, b""
+            if self._is_eof(sock):
+                return -errno_mod.EINVAL, b""
+            yield _Block(sock, S_READABLE)
+        resp = struct.pack("<IH", child.peer_ip & 0xFFFFFFFF, child.peer_port)
+        return child.handle, resp
+
+    def op_connect(self, a, b, c, d, payload):
+        sock = self._desc(a)
+        done = sock.connect_to(int(b), int(c))
+        if done:
+            return 0, b""
+        if self._nonblock(sock):
+            return -errno_mod.EINPROGRESS, b""
+        yield _Block(sock, S_WRITABLE)
+        err = sock.take_socket_error()
+        if err:
+            return -getattr(errno_mod, str(err).split(":")[0], errno_mod.ECONNREFUSED), b""
+        return 0, b""
+
+    def op_send(self, a, b, c, d, payload):
+        sock = self._desc(a)
+        nonblock = self._nonblock(sock) or bool(b)
+        total = 0
+        view = memoryview(payload)
+        while total < len(view):
+            n = sock.send_user_data(bytes(view[total:]))
+            total += n
+            if total >= len(view) or nonblock:
+                break
+            if n == 0:
+                if self._is_eof(sock):
+                    return (total if total else -errno_mod.EPIPE), b""
+                yield _Block(sock, S_WRITABLE)
+        if total == 0 and nonblock and len(view) > 0:
+            return -errno_mod.EAGAIN, b""
+        return total, b""
+
+    def op_sendto(self, a, b, c, d, payload):
+        sock = self._desc(a)
+        nonblock = self._nonblock(sock) or bool(b)
+        while True:
+            n = sock.send_user_data(payload, int(c), int(d))
+            if n > 0 or len(payload) == 0:
+                return n, b""
+            if nonblock:
+                return -errno_mod.EAGAIN, b""
+            yield _Block(sock, S_WRITABLE)
+
+    def op_recv(self, a, b, c, d, payload):
+        sock = self._desc(a)
+        nonblock = self._nonblock(sock) or bool(c)
+        while True:
+            r = self._recv_payload(sock, b)
+            if r is not None:
+                data = r[0] if isinstance(r, tuple) else r
+                return len(data), bytes(data)
+            if self._is_eof(sock):
+                return 0, b""
+            if nonblock:
+                return -errno_mod.EAGAIN, b""
+            yield _Block(sock, S_READABLE)
+
+    def op_recvfrom(self, a, b, c, d, payload):
+        sock = self._desc(a)
+        nonblock = self._nonblock(sock) or bool(c)
+        while True:
+            r = self._recv_payload(sock, b)
+            if r is not None:
+                data, ip, port = r[0], r[1], r[2]
+                hdr = struct.pack("<IH", ip & 0xFFFFFFFF, port & 0xFFFF)
+                return len(data), hdr + bytes(data)
+            if self._is_eof(sock):
+                return 0, struct.pack("<IH", 0, 0)
+            if nonblock:
+                return -errno_mod.EAGAIN, b""
+            yield _Block(sock, S_READABLE)
+
+    def op_close(self, a, b, c, d, payload):
+        self.api.close(int(a))
+        return 0, b""
+        yield  # pragma: no cover
+
+    def op_shutdown(self, a, b, c, d, payload):
+        sock = self._desc(a)
+        if hasattr(sock, "shutdown"):
+            sock.shutdown(int(b))
+        else:
+            sock.close()
+        return 0, b""
+        yield  # pragma: no cover
+
+    def op_getsockopt(self, a, b, c, d, payload):
+        sock = self._desc(a)
+        val = 0
+        if b == SOL_SOCKET and c == SO_ERROR:
+            err = sock.take_socket_error() if hasattr(sock, "take_socket_error") else None
+            val = getattr(errno_mod, str(err).split(":")[0], 0) if err else 0
+        elif b == SOL_SOCKET and c == SO_SNDBUF:
+            val = getattr(sock, "send_buf_size", 0)
+        elif b == SOL_SOCKET and c == SO_RCVBUF:
+            val = getattr(sock, "recv_buf_size", 0)
+        return 0, struct.pack("<i", int(val))
+        yield  # pragma: no cover
+
+    def op_setsockopt(self, a, b, c, d, payload):
+        sock = self._desc(a)
+        if b == SOL_SOCKET and c in (SO_SNDBUF, SO_RCVBUF) and len(payload) >= 4:
+            (val,) = struct.unpack("<i", payload[:4])
+            # the kernel doubles setsockopt buffer sizes (reference honors
+            # this in options --socket-recv-buffer semantics)
+            if c == SO_SNDBUF and hasattr(sock, "send_buf_size"):
+                sock.send_buf_size = max(4096, val)
+            if c == SO_RCVBUF and hasattr(sock, "recv_buf_size"):
+                sock.recv_buf_size = max(4096, val)
+        return 0, b""
+        yield  # pragma: no cover
+
+    def _name_payload(self, ip, port):
+        return struct.pack("<IH", (ip or 0) & 0xFFFFFFFF, (port or 0) & 0xFFFF)
+
+    def op_getsockname(self, a, b, c, d, payload):
+        sock = self._desc(a)
+        return 0, self._name_payload(getattr(sock, "bound_ip", 0),
+                                     getattr(sock, "bound_port", 0))
+        yield  # pragma: no cover
+
+    def op_getpeername(self, a, b, c, d, payload):
+        sock = self._desc(a)
+        ip = getattr(sock, "peer_ip", 0)
+        if not ip:
+            return -errno_mod.ENOTCONN, b""
+        return 0, self._name_payload(ip, getattr(sock, "peer_port", 0))
+        yield  # pragma: no cover
+
+    # -- generic fd ops ----------------------------------------------------
+    def op_read(self, a, b, c, d, payload):
+        desc = self._desc(a)
+        if isinstance(desc, RandomDescriptor):
+            return 0, desc.read_bytes(int(b))
+        if desc.kind == "timer":
+            while desc.expire_count == 0:
+                if self._nonblock(desc):
+                    return -errno_mod.EAGAIN, b""
+                yield _Block(desc, S_READABLE)
+            n = desc.read_expirations()
+            return 8, struct.pack("<Q", n)
+        r = yield from self.op_recv(a, b, c, d, payload)
+        return r
+
+    def op_write(self, a, b, c, d, payload):
+        r = yield from self.op_send(a, b, c, d, payload)
+        return r
+
+    def op_fcntl(self, a, b, c, d, payload):
+        desc = self._desc(a)
+        if b == F_GETFL:
+            return (O_NONBLOCK if self._nonblock(desc) else 0), b""
+        if b == F_SETFL:
+            desc._nonblock = bool(int(c) & O_NONBLOCK)
+            return 0, b""
+        return -errno_mod.EINVAL, b""
+        yield  # pragma: no cover
+
+    def op_ioctl(self, a, b, c, d, payload):
+        desc = self._desc(a)
+        if b == FIONREAD:
+            return int(getattr(desc, "in_bytes", 0)), b""
+        return -errno_mod.ENOTTY, b""
+        yield  # pragma: no cover
+
+    # -- epoll/poll --------------------------------------------------------
+    def op_epoll_create(self, a, b, c, d, payload):
+        return self.api.epoll_create(), b""
+        yield  # pragma: no cover
+
+    def op_epoll_ctl(self, a, b, c, d, payload):
+        ep = self._desc(a)
+        desc = self._desc(c)
+        data = struct.unpack("<Q", payload[:8])[0] if len(payload) >= 8 else int(c)
+        if b == 1:
+            ep.ctl_add(desc, int(d), data)
+        elif b == 2:
+            ep.ctl_mod(desc, int(d), data)
+        else:
+            ep.ctl_del(desc)
+        return 0, b""
+        yield  # pragma: no cover
+
+    def op_epoll_wait(self, a, b, c, d, payload):
+        ep = self._desc(a)
+        timeout_ms = int(c)
+        if not ep.has_ready():
+            if timeout_ms == 0:
+                return 0, b""
+            if timeout_ms > 0:
+                deadline = self.api.now_ns() + timeout_ms * stime.SIM_TIME_MS
+                while not ep.has_ready():
+                    remaining = deadline - self.api.now_ns()
+                    if remaining <= 0:
+                        break
+                    fired = yield _Block(ep, S_READABLE, timeout_ns=remaining)
+                    if not fired:
+                        break
+            else:
+                while not ep.has_ready():
+                    yield _Block(ep, S_READABLE)
+        events = ep.wait(int(b))
+        out = b"".join(struct.pack("<IQ", rev & 0xFFFFFFFF, int(data))
+                       for data, rev in events)
+        return len(events), out
+
+    def op_poll(self, a, b, c, d, payload):
+        nfds = int(a)
+        timeout_ms = int(b)
+        entries = []
+        for i in range(nfds):
+            h, ev = struct.unpack_from("<ih", payload, i * 6)
+            entries.append((h, ev))
+
+        def scan():
+            revents = []
+            ready = 0
+            for h, ev in entries:
+                desc = self.host.descriptor_table_get(h) if h >= 0 else None
+                r = 0
+                if desc is not None:
+                    if (ev & POLLIN) and desc.has_status(S_READABLE):
+                        r |= POLLIN
+                    if (ev & POLLOUT) and desc.has_status(S_WRITABLE):
+                        r |= POLLOUT
+                    if desc.has_status(S_CLOSED):
+                        r |= POLLHUP
+                elif h >= 0:
+                    r |= POLLERR  # stale sim fd
+                if r:
+                    ready += 1
+                revents.append(r)
+            return ready, revents
+
+        ready, revents = scan()
+        if ready == 0 and timeout_ms != 0:
+            # block on all polled descriptors via a scratch epoll (the
+            # reference implements poll on top of its epoll too)
+            ep = Epoll(self.host, self.host.allocate_handle())
+            added = []
+            for h, ev in entries:
+                desc = self.host.descriptor_table_get(h) if h >= 0 else None
+                if desc is None or desc is ep:
+                    continue
+                want = 0
+                if ev & POLLIN:
+                    want |= EPOLLIN
+                if ev & POLLOUT:
+                    want |= EPOLLOUT
+                try:
+                    ep.ctl_add(desc, want, h)
+                    added.append(desc)
+                except (OSError, FileExistsError):
+                    pass
+            try:
+                if added:
+                    if timeout_ms > 0:
+                        yield _Block(ep, S_READABLE,
+                                     timeout_ns=timeout_ms * stime.SIM_TIME_MS)
+                    else:
+                        yield _Block(ep, S_READABLE)
+                elif timeout_ms > 0:
+                    yield _Sleep(timeout_ms * stime.SIM_TIME_MS)
+            finally:
+                for desc in added:
+                    try:
+                        ep.ctl_del(desc)
+                    except (OSError, FileNotFoundError):
+                        pass
+                ep.close()
+            ready, revents = scan()
+        out = b"".join(struct.pack("<h", r) for r in revents)
+        return ready, out
+
+    # -- time/sleep --------------------------------------------------------
+    def op_gettime(self, a, b, c, d, payload):
+        return 0, b""
+        yield  # pragma: no cover
+
+    def op_sleep(self, a, b, c, d, payload):
+        if a > 0:
+            yield _Sleep(int(a))
+        return 0, b""
+
+    # -- identity / DNS / random ------------------------------------------
+    def op_getaddrinfo(self, a, b, c, d, payload):
+        name = payload.decode("utf-8", "replace")
+        try:
+            ip = self.api.gethostbyname(name)
+        except OSError:
+            return -errno_mod.ENOENT, b""
+        return 0, struct.pack("<I", ip & 0xFFFFFFFF)
+        yield  # pragma: no cover
+
+    def op_gethostname(self, a, b, c, d, payload):
+        return 0, self.api.gethostname().encode()
+        yield  # pragma: no cover
+
+    def op_random(self, a, b, c, d, payload):
+        n = max(0, min(int(a), 4096))
+        return n, self.api.random_bytes(n)
+        yield  # pragma: no cover
+
+    def op_open_random(self, a, b, c, d, payload):
+        handle = self.host.allocate_handle()
+        self.host.register_descriptor(RandomDescriptor(self.host, handle))
+        return handle, b""
+        yield  # pragma: no cover
+
+    # -- timers / pipes ----------------------------------------------------
+    def op_timerfd_create(self, a, b, c, d, payload):
+        return self.api.timerfd_create(), b""
+        yield  # pragma: no cover
+
+    def op_timerfd_settime(self, a, b, c, d, payload):
+        self._desc(a).arm(int(b), int(c))
+        return 0, b""
+        yield  # pragma: no cover
+
+    def op_pipe(self, a, b, c, d, payload):
+        rh, wh = self.api.pipe()
+        return rh, struct.pack("<I", wh)
+        yield  # pragma: no cover
+
+    # -- misc --------------------------------------------------------------
+    def op_exit(self, a, b, c, d, payload):
+        self.exit_code = int(a)
+        return 0, b""
+        yield  # pragma: no cover
+
+    def op_log(self, a, b, c, d, payload):
+        self.api.log(payload.decode("utf-8", "replace"))
+        return 0, b""
+        yield  # pragma: no cover
+
+    _HANDLERS = {
+        OP_SOCKET: op_socket, OP_BIND: op_bind, OP_LISTEN: op_listen,
+        OP_ACCEPT: op_accept, OP_CONNECT: op_connect, OP_SEND: op_send,
+        OP_SENDTO: op_sendto, OP_RECV: op_recv, OP_RECVFROM: op_recvfrom,
+        OP_CLOSE: op_close, OP_EPOLL_CREATE: op_epoll_create,
+        OP_EPOLL_CTL: op_epoll_ctl, OP_EPOLL_WAIT: op_epoll_wait,
+        OP_POLL: op_poll, OP_GETTIME: op_gettime, OP_SLEEP: op_sleep,
+        OP_GETADDRINFO: op_getaddrinfo, OP_GETHOSTNAME: op_gethostname,
+        OP_RANDOM: op_random, OP_SETSOCKOPT: op_setsockopt,
+        OP_GETSOCKOPT: op_getsockopt, OP_GETSOCKNAME: op_getsockname,
+        OP_GETPEERNAME: op_getpeername, OP_SHUTDOWN: op_shutdown,
+        OP_FCNTL: op_fcntl, OP_IOCTL: op_ioctl,
+        OP_OPEN_RANDOM: op_open_random, OP_READ: op_read,
+        OP_WRITE: op_write, OP_EXIT: op_exit, OP_LOG: op_log,
+        OP_TIMERFD_CREATE: op_timerfd_create,
+        OP_TIMERFD_SETTIME: op_timerfd_settime, OP_PIPE: op_pipe,
+    }
+
+
+def _read_exact(conn: real_socket.socket, n: int) -> Optional[bytes]:
+    """Blocking read of exactly n bytes; None on EOF.
+
+    This *real* blocking read is the determinism seam: while we're here, the
+    plugin is executing (instantaneous in virtual time); it will either send
+    another request or exit."""
+    chunks = []
+    got = 0
+    while got < n:
+        try:
+            chunk = conn.recv(n - got)
+        except OSError:
+            return None
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def run_native_plugin(api, args: List[str], binary: str,
+                      extra_env: Optional[dict] = None):
+    """App-main generator serving one native plugin process.
+
+    The reference's equivalent flow: _process_start loads the plugin into a
+    namespace and pth-schedules its main (process.c:1055-1195); here we exec
+    the real binary with the shim preloaded and serve its syscall stream.
+    """
+    log = get_logger()
+    name = api.process.name
+    sim_side, child_side = real_socket.socketpair()
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = (_PRELOAD_LIB + (" " + env["LD_PRELOAD"]
+                                         if env.get("LD_PRELOAD") else ""))
+    env["SHADOW_TPU_FD"] = str(child_side.fileno())
+    env["SHADOW_TPU_EPOCH_NS"] = str(stime.EMULATED_TIME_OFFSET)
+    if extra_env:
+        env.update(extra_env)
+    # stdout/stderr go to per-process files (the reference writes each
+    # plugin's output under its host data dir, slave.c data-dir layout);
+    # a pipe could deadlock a chatty plugin against our blocking read loop
+    import tempfile
+    out_file = tempfile.NamedTemporaryFile(
+        mode="w+b", prefix=f"shadow-{name.replace('/', '_')}-", suffix=".out",
+        delete=False)
+    try:
+        proc = subprocess.Popen([binary] + list(args), env=env,
+                                pass_fds=(child_side.fileno(),),
+                                stdout=out_file, stderr=subprocess.STDOUT,
+                                close_fds=True)
+    except OSError as e:
+        log.warning("native", f"{name}: failed to exec {binary}: {e}")
+        child_side.close()
+        sim_side.close()
+        out_file.close()
+        os.unlink(out_file.name)
+        return 127
+    _live_children.append(proc)
+    child_side.close()
+    kernel = NativeKernel(api, sim_side)
+    try:
+        while True:
+            hdr = _read_exact(sim_side, REQ_HDR.size)
+            if hdr is None:
+                break
+            length, op, a, b, c, d = REQ_HDR.unpack(hdr)
+            plen = length - REQ_HDR.size
+            payload = b""
+            if plen > 0:
+                payload = _read_exact(sim_side, plen)
+                if payload is None:
+                    break
+            ret, resp_payload = yield from kernel.dispatch(op, a, b, c, d,
+                                                           payload)
+            resp = RESP_HDR.pack(RESP_HDR.size + len(resp_payload), 0,
+                                 int(ret), api.now_ns()) + resp_payload
+            try:
+                sim_side.sendall(resp)
+            except OSError:
+                break
+    finally:
+        sim_side.close()
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+        if proc in _live_children:
+            _live_children.remove(proc)
+        out_file.flush()
+        out_file.seek(0)
+        captured = out_file.read()
+        out_file.close()
+        os.unlink(out_file.name)
+        api.process.app_state = {"stdout": captured,
+                                 "returncode": proc.returncode}
+        if captured:
+            log.debug("native", f"{name} output: {captured[:2000]!r}")
+    rc = kernel.exit_code if kernel.exit_code is not None else proc.returncode
+    return rc if rc is not None else 0
+
+
+def make_native_app(binary: str):
+    """Registry adapter: a plugin path that is a real executable becomes an
+    app whose main serves the interposition protocol."""
+    def app_main(api, args):
+        rc = yield from run_native_plugin(api, args, binary)
+        return rc
+    return app_main

@@ -336,7 +336,7 @@ class SyscallAPI:
                              host.params.send_buf_size)
         else:
             raise ValueError(f"unsupported socket kind {kind!r}")
-        host._descriptors[handle] = sock
+        host.register_descriptor(sock)
         return handle
 
     def _sock(self, fd: int):
@@ -458,7 +458,7 @@ class SyscallAPI:
         host = self.host
         handle = host.allocate_handle()
         ep = Epoll(host, handle)
-        host._descriptors[handle] = ep
+        host.register_descriptor(ep)
         return handle
 
     def epoll_ctl(self, epfd: int, op: str, fd: int, events: int = 0, data=None) -> None:
@@ -500,7 +500,7 @@ class SyscallAPI:
         host = self.host
         handle = host.allocate_handle()
         tm = Timer(host, handle)
-        host._descriptors[handle] = tm
+        host.register_descriptor(tm)
         return handle
 
     def timerfd_settime(self, fd: int, initial_sec: float, interval_sec: float = 0.0) -> None:
@@ -516,8 +516,8 @@ class SyscallAPI:
         host = self.host
         rh, wh = host.allocate_handle(), host.allocate_handle()
         r, w = Channel.new_pipe(host, rh, wh)
-        host._descriptors[rh] = r
-        host._descriptors[wh] = w
+        host.register_descriptor(r)
+        host.register_descriptor(w)
         return rh, wh
 
     def socketpair(self) -> Tuple[int, int]:
@@ -525,8 +525,8 @@ class SyscallAPI:
         host = self.host
         ha, hb = host.allocate_handle(), host.allocate_handle()
         a, b = Channel.new_socketpair(host, ha, hb)
-        host._descriptors[ha] = a
-        host._descriptors[hb] = b
+        host.register_descriptor(a)
+        host.register_descriptor(b)
         return ha, hb
 
     def write(self, fd: int, data: bytes) -> int:

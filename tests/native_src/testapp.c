@@ -1,0 +1,422 @@
+/* Dual-execution test program for the native plugin plane.
+ *
+ * The reference's test strategy (SURVEY.md §4, src/test/tcp etc.) builds
+ * each test as a real program runnable both natively and under the
+ * simulator; exit code 0 is the oracle.  This single binary exposes the
+ * scenarios as subcommands:
+ *
+ *   vtime                          virtual clock: sleep advances exactly
+ *   udpserver <port> <count>       echo <count> datagrams
+ *   udpclient <host> <port> <count> <size>
+ *   tcpserver <port> <expect>      accept one, read till EOF, check bytes
+ *   tcpclient <host> <port> <bytes>
+ *   epollserver <port> <nclients>  nonblocking epoll echo server
+ *   pollclient <host> <port>       nonblocking connect + poll + echo check
+ *   selectclient <host> <port>     same via select()
+ *   randcheck                      getrandom + /dev/urandom read
+ *   hostname <expected>            gethostname/getaddrinfo self-check
+ *
+ * Under the simulator the clock checks are exact (discrete virtual time);
+ * natively they are loose.  SHADOW_TPU_FD in the environment tells us which
+ * mode we're in (the shim passes through when it's absent).
+ */
+#define _GNU_SOURCE
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <poll.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/random.h>
+#include <sys/select.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+static int under_sim(void) { return getenv("SHADOW_TPU_FD") != NULL; }
+
+static int64_t now_ns(void) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (int64_t)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+static int resolve(const char *host, uint16_t port, struct sockaddr_in *out) {
+  struct addrinfo hints, *res = NULL;
+  memset(&hints, 0, sizeof hints);
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portstr[16];
+  snprintf(portstr, sizeof portstr, "%u", port);
+  if (getaddrinfo(host, portstr, &hints, &res) != 0 || !res) return -1;
+  memcpy(out, res->ai_addr, sizeof *out);
+  out->sin_port = htons(port);
+  freeaddrinfo(res);
+  return 0;
+}
+
+/* ------------------------------------------------------------------ vtime */
+static int cmd_vtime(void) {
+  int64_t t0 = now_ns();
+  struct timespec req = {2, 500000000}; /* 2.5 s */
+  if (nanosleep(&req, NULL) != 0) return 1;
+  int64_t t1 = now_ns();
+  int64_t elapsed = t1 - t0;
+  struct timeval tv;
+  gettimeofday(&tv, NULL);
+  /* emulated epoch is 2000-01-01 (definitions.h:78) => seconds > 9e8 */
+  if (tv.tv_sec < 900000000L) return 2;
+  if (under_sim()) {
+    if (elapsed != 2500000000LL) {
+      fprintf(stderr, "vtime: elapsed %lld != 2.5e9\n", (long long)elapsed);
+      return 3;
+    }
+  } else if (elapsed < 2400000000LL || elapsed > 60000000000LL) {
+    return 3;
+  }
+  usleep(1000);
+  int64_t t2 = now_ns();
+  if (under_sim() && t2 - t1 != 1000000LL) return 4;
+  printf("vtime OK elapsed=%lld\n", (long long)elapsed);
+  return 0;
+}
+
+/* -------------------------------------------------------------------- udp */
+static int cmd_udpserver(uint16_t port, int count) {
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) return 1;
+  struct sockaddr_in sin;
+  memset(&sin, 0, sizeof sin);
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = htonl(INADDR_ANY);
+  sin.sin_port = htons(port);
+  if (bind(fd, (struct sockaddr *)&sin, sizeof sin) != 0) return 2;
+  char buf[65536];
+  for (int i = 0; i < count; i++) {
+    struct sockaddr_in peer;
+    socklen_t plen = sizeof peer;
+    ssize_t n = recvfrom(fd, buf, sizeof buf, 0, (struct sockaddr *)&peer,
+                         &plen);
+    if (n < 0) return 3;
+    if (sendto(fd, buf, (size_t)n, 0, (struct sockaddr *)&peer, plen) != n)
+      return 4;
+  }
+  close(fd);
+  printf("udpserver OK count=%d\n", count);
+  return 0;
+}
+
+static int cmd_udpclient(const char *host, uint16_t port, int count,
+                         int size) {
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) return 1;
+  struct sockaddr_in dst;
+  if (resolve(host, port, &dst) != 0) return 2;
+  char *buf = malloc((size_t)size);
+  char *rbuf = malloc((size_t)size);
+  int64_t first_rtt = -1;
+  for (int i = 0; i < count; i++) {
+    memset(buf, 'a' + (i % 26), (size_t)size);
+    int64_t t0 = now_ns();
+    if (sendto(fd, buf, (size_t)size, 0, (struct sockaddr *)&dst,
+               sizeof dst) != size)
+      return 3;
+    struct sockaddr_in peer;
+    socklen_t plen = sizeof peer;
+    ssize_t n = recvfrom(fd, rbuf, (size_t)size, 0, (struct sockaddr *)&peer,
+                         &plen);
+    if (n != size) return 4;
+    if (memcmp(buf, rbuf, (size_t)size) != 0) return 5;
+    if (first_rtt < 0) first_rtt = now_ns() - t0;
+  }
+  /* under the simulator the echo crosses 2 links with >= 1 ms total latency;
+   * virtual RTT must be nonzero and sane */
+  if (under_sim() && (first_rtt <= 0 || first_rtt > 10000000000LL)) return 6;
+  printf("udpclient OK count=%d rtt_ns=%lld\n", count, (long long)first_rtt);
+  close(fd);
+  free(buf);
+  free(rbuf);
+  return 0;
+}
+
+/* -------------------------------------------------------------------- tcp */
+static uint32_t pattern_sum(int64_t nbytes) {
+  uint32_t sum = 0;
+  for (int64_t i = 0; i < nbytes; i++) sum = sum * 31 + (uint32_t)(i & 0xFF);
+  return sum;
+}
+
+static int cmd_tcpserver(uint16_t port, int64_t expect) {
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return 1;
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in sin;
+  memset(&sin, 0, sizeof sin);
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = htonl(INADDR_ANY);
+  sin.sin_port = htons(port);
+  if (bind(lfd, (struct sockaddr *)&sin, sizeof sin) != 0) return 2;
+  if (listen(lfd, 8) != 0) return 3;
+  struct sockaddr_in peer;
+  socklen_t plen = sizeof peer;
+  int fd = accept(lfd, (struct sockaddr *)&peer, &plen);
+  if (fd < 0) return 4;
+  char buf[65536];
+  int64_t total = 0;
+  uint32_t sum = 0;
+  for (;;) {
+    ssize_t n = recv(fd, buf, sizeof buf, 0);
+    if (n < 0) return 5;
+    if (n == 0) break;
+    for (ssize_t i = 0; i < n; i++)
+      sum = sum * 31 + (uint32_t)(unsigned char)buf[i];
+    total += n;
+  }
+  if (total != expect) {
+    fprintf(stderr, "tcpserver: got %lld want %lld\n", (long long)total,
+            (long long)expect);
+    return 6;
+  }
+  if (sum != pattern_sum(expect)) return 7;
+  close(fd);
+  close(lfd);
+  printf("tcpserver OK bytes=%lld\n", (long long)total);
+  return 0;
+}
+
+static int cmd_tcpclient(const char *host, uint16_t port, int64_t nbytes) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 1;
+  struct sockaddr_in dst;
+  if (resolve(host, port, &dst) != 0) return 2;
+  if (connect(fd, (struct sockaddr *)&dst, sizeof dst) != 0) {
+    fprintf(stderr, "tcpclient: connect: %s\n", strerror(errno));
+    return 3;
+  }
+  char buf[65536];
+  int64_t sent = 0;
+  while (sent < nbytes) {
+    size_t chunk = sizeof buf;
+    if ((int64_t)chunk > nbytes - sent) chunk = (size_t)(nbytes - sent);
+    for (size_t i = 0; i < chunk; i++)
+      buf[i] = (char)((sent + (int64_t)i) & 0xFF);
+    ssize_t n = send(fd, buf, chunk, 0);
+    if (n <= 0) {
+      fprintf(stderr, "tcpclient: send: %s\n", strerror(errno));
+      return 4;
+    }
+    sent += n;
+  }
+  close(fd);
+  printf("tcpclient OK bytes=%lld\n", (long long)sent);
+  return 0;
+}
+
+/* ------------------------------------------------------------------ epoll */
+static int cmd_epollserver(uint16_t port, int nclients) {
+  int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (lfd < 0) return 1;
+  struct sockaddr_in sin;
+  memset(&sin, 0, sizeof sin);
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = htonl(INADDR_ANY);
+  sin.sin_port = htons(port);
+  if (bind(lfd, (struct sockaddr *)&sin, sizeof sin) != 0) return 2;
+  if (listen(lfd, 16) != 0) return 3;
+  int ep = epoll_create1(0);
+  if (ep < 0) return 4;
+  struct epoll_event ev;
+  ev.events = EPOLLIN;
+  ev.data.fd = lfd;
+  if (epoll_ctl(ep, EPOLL_CTL_ADD, lfd, &ev) != 0) return 5;
+  int done = 0, active = 0;
+  char buf[65536];
+  while (done < nclients) {
+    struct epoll_event evs[32];
+    int n = epoll_wait(ep, evs, 32, 30000);
+    if (n < 0) return 6;
+    if (n == 0) {
+      fprintf(stderr, "epollserver: timeout with %d/%d done\n", done,
+              nclients);
+      return 7;
+    }
+    for (int i = 0; i < n; i++) {
+      int fd = evs[i].data.fd;
+      if (fd == lfd) {
+        for (;;) {
+          int cfd = accept4(lfd, NULL, NULL, SOCK_NONBLOCK);
+          if (cfd < 0) break;
+          struct epoll_event cev;
+          cev.events = EPOLLIN;
+          cev.data.fd = cfd;
+          if (epoll_ctl(ep, EPOLL_CTL_ADD, cfd, &cev) != 0) return 8;
+          active++;
+        }
+      } else {
+        for (;;) {
+          ssize_t r = recv(fd, buf, sizeof buf, 0);
+          if (r > 0) {
+            ssize_t off = 0;
+            while (off < r) {
+              ssize_t w = send(fd, buf + off, (size_t)(r - off), 0);
+              if (w <= 0) break;
+              off += w;
+            }
+          } else if (r == 0) {
+            epoll_ctl(ep, EPOLL_CTL_DEL, fd, NULL);
+            close(fd);
+            done++;
+            break;
+          } else {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+            return 9;
+          }
+        }
+      }
+    }
+  }
+  close(ep);
+  close(lfd);
+  printf("epollserver OK clients=%d\n", done);
+  return 0;
+}
+
+static int echo_once_connected(int fd, const char *tag) {
+  const char msg[] = "hello through the virtual network";
+  if (send(fd, msg, sizeof msg, 0) != (ssize_t)sizeof msg) return 4;
+  char rbuf[sizeof msg];
+  size_t got = 0;
+  while (got < sizeof msg) {
+    ssize_t n = recv(fd, rbuf + got, sizeof msg - got, 0);
+    if (n <= 0) {
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) continue;
+      return 5;
+    }
+    got += (size_t)n;
+  }
+  if (memcmp(msg, rbuf, sizeof msg) != 0) return 6;
+  printf("%s OK\n", tag);
+  return 0;
+}
+
+static int cmd_pollclient(const char *host, uint16_t port) {
+  int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) return 1;
+  struct sockaddr_in dst;
+  if (resolve(host, port, &dst) != 0) return 2;
+  int r = connect(fd, (struct sockaddr *)&dst, sizeof dst);
+  if (r != 0 && errno != EINPROGRESS) return 3;
+  struct pollfd pfd = {fd, POLLOUT, 0};
+  if (poll(&pfd, 1, 10000) != 1 || !(pfd.revents & POLLOUT)) return 7;
+  int err = -1;
+  socklen_t elen = sizeof err;
+  if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen) != 0 || err != 0)
+    return 8;
+  /* wait readable via poll between send and recv */
+  const char msg[] = "hello through the virtual network";
+  if (send(fd, msg, sizeof msg, 0) != (ssize_t)sizeof msg) return 4;
+  pfd.events = POLLIN;
+  if (poll(&pfd, 1, 10000) != 1) return 9;
+  char rbuf[sizeof msg];
+  size_t got = 0;
+  while (got < sizeof msg) {
+    ssize_t n = recv(fd, rbuf + got, sizeof msg - got, 0);
+    if (n <= 0) {
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        if (poll(&pfd, 1, 10000) != 1) return 10;
+        continue;
+      }
+      return 5;
+    }
+    got += (size_t)n;
+  }
+  if (memcmp(msg, rbuf, sizeof msg) != 0) return 6;
+  close(fd);
+  printf("pollclient OK\n");
+  return 0;
+}
+
+static int cmd_selectclient(const char *host, uint16_t port) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 1;
+  struct sockaddr_in dst;
+  if (resolve(host, port, &dst) != 0) return 2;
+  if (connect(fd, (struct sockaddr *)&dst, sizeof dst) != 0) return 3;
+  const char msg[] = "hello through the virtual network";
+  if (send(fd, msg, sizeof msg, 0) != (ssize_t)sizeof msg) return 4;
+  fd_set rfds;
+  FD_ZERO(&rfds);
+  FD_SET(fd, &rfds);
+  struct timeval tv = {10, 0};
+  int r = select(fd + 1, &rfds, NULL, NULL, &tv);
+  if (r != 1 || !FD_ISSET(fd, &rfds)) return 7;
+  char rbuf[sizeof msg];
+  size_t got = 0;
+  while (got < sizeof msg) {
+    ssize_t n = recv(fd, rbuf + got, sizeof msg - got, 0);
+    if (n <= 0) return 5;
+    got += (size_t)n;
+  }
+  if (memcmp(msg, rbuf, sizeof msg) != 0) return 6;
+  close(fd);
+  printf("selectclient OK\n");
+  return 0;
+}
+
+/* ----------------------------------------------------------------- random */
+static int cmd_randcheck(void) {
+  unsigned char a[16], b[16];
+  if (getrandom(a, sizeof a, 0) != (ssize_t)sizeof a) return 1;
+  int fd = open("/dev/urandom", O_RDONLY);
+  if (fd < 0) return 2;
+  if (read(fd, b, sizeof b) != (ssize_t)sizeof b) return 3;
+  close(fd);
+  printf("randcheck ");
+  for (size_t i = 0; i < sizeof a; i++) printf("%02x", a[i]);
+  printf(" ");
+  for (size_t i = 0; i < sizeof b; i++) printf("%02x", b[i]);
+  printf("\n");
+  return 0;
+}
+
+static int cmd_hostname(const char *expected) {
+  char name[256];
+  if (gethostname(name, sizeof name) != 0) return 1;
+  if (strcmp(name, expected) != 0) {
+    fprintf(stderr, "hostname: got %s want %s\n", name, expected);
+    return 2;
+  }
+  struct sockaddr_in self;
+  if (resolve(name, 80, &self) != 0) return 3;
+  printf("hostname OK %s\n", name);
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  if (argc < 2) return 64;
+  const char *cmd = argv[1];
+  if (!strcmp(cmd, "vtime")) return cmd_vtime();
+  if (!strcmp(cmd, "udpserver") && argc >= 4)
+    return cmd_udpserver((uint16_t)atoi(argv[2]), atoi(argv[3]));
+  if (!strcmp(cmd, "udpclient") && argc >= 6)
+    return cmd_udpclient(argv[2], (uint16_t)atoi(argv[3]), atoi(argv[4]),
+                         atoi(argv[5]));
+  if (!strcmp(cmd, "tcpserver") && argc >= 4)
+    return cmd_tcpserver((uint16_t)atoi(argv[2]), atoll(argv[3]));
+  if (!strcmp(cmd, "tcpclient") && argc >= 5)
+    return cmd_tcpclient(argv[2], (uint16_t)atoi(argv[3]), atoll(argv[4]));
+  if (!strcmp(cmd, "epollserver") && argc >= 4)
+    return cmd_epollserver((uint16_t)atoi(argv[2]), atoi(argv[3]));
+  if (!strcmp(cmd, "pollclient") && argc >= 4)
+    return cmd_pollclient(argv[2], (uint16_t)atoi(argv[3]));
+  if (!strcmp(cmd, "selectclient") && argc >= 4)
+    return cmd_selectclient(argv[2], (uint16_t)atoi(argv[3]));
+  if (!strcmp(cmd, "randcheck")) return cmd_randcheck();
+  if (!strcmp(cmd, "hostname") && argc >= 3) return cmd_hostname(argv[2]);
+  (void)echo_once_connected;
+  return 64;
+}

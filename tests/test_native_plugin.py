@@ -1,0 +1,219 @@
+"""Native plugin plane: real, unmodified C binaries under the simulator.
+
+The reference's core test pattern (SURVEY.md §4): every test is a real
+program run both natively and under the simulator; the simulator run must
+virtualize time, sockets, DNS, epoll/poll/select, and randomness well enough
+that the program itself (exit code 0) is the oracle.  tests/native_src/
+testapp.c implements the scenarios; the LD_PRELOAD shim
+(native/preload/shim.cc) routes its libc calls into the virtual kernel.
+"""
+
+import os
+import subprocess
+import textwrap
+import time
+
+import pytest
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="session")
+def native_bin(tmp_path_factory):
+    """Build the shim and the dual-execution test binary."""
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")], check=True,
+                   capture_output=True)
+    out = tmp_path_factory.mktemp("nativebin") / "testapp"
+    subprocess.run(["gcc", "-O1", "-o", str(out),
+                    os.path.join(REPO, "tests", "native_src", "testapp.c")],
+                   check=True, capture_output=True)
+    return str(out)
+
+
+def run_sim(xml, stop=120, policy="global", workers=0):
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = stop
+    opts = Options(scheduler_policy=policy, workers=workers,
+                   stop_time_sec=stop)
+    ctrl = Controller(opts, cfg)
+    rc = ctrl.run()
+    return rc, ctrl
+
+
+def exit_codes(ctrl, *hosts):
+    out = {}
+    for name in hosts:
+        h = ctrl.engine.host_by_name(name)
+        out[name] = [p.exit_code for p in h.processes]
+    return out
+
+
+def test_programs_run_natively(native_bin):
+    """Dual-execution oracle, native half: the test programs work against
+    the real OS (loopback), proving the oracle itself is sound."""
+    srv = subprocess.Popen([native_bin, "udpserver", "39481", "3"])
+    time.sleep(0.2)
+    cli = subprocess.run([native_bin, "udpclient", "127.0.0.1", "39481",
+                          "3", "256"], timeout=20)
+    assert cli.returncode == 0
+    assert srv.wait(timeout=20) == 0
+    assert subprocess.run([native_bin, "vtime"], timeout=30).returncode == 0
+
+
+def test_native_vtime(native_bin):
+    """Virtual clock: nanosleep/usleep advance virtual time *exactly*, and
+    gettimeofday reports the emulated epoch (the binary checks both)."""
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_bin}" />
+          <host id="node">
+            <process plugin="app" starttime="1" arguments="vtime" />
+          </host>
+        </shadow>
+    """)
+    t0 = time.monotonic()
+    rc, ctrl = run_sim(xml)
+    wall = time.monotonic() - t0
+    assert rc == 0
+    assert exit_codes(ctrl, "node") == {"node": [0]}
+    # 2.5 virtual seconds of sleeping must not take 2.5 wall seconds
+    assert wall < 2.0, f"virtual sleep leaked into wall clock: {wall:.2f}s"
+
+
+def test_native_udp_echo(native_bin):
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="60">
+          <plugin id="app" path="{native_bin}" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="1" arguments="udpserver 8000 5" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="2"
+                     arguments="udpclient server 8000 5 512" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "server", "client") == \
+        {"server": [0], "client": [0]}
+    client = ctrl.engine.host_by_name("client")
+    assert client.tracker.out_remote.packets_data == 5
+    assert client.tracker.in_remote.packets_data == 5
+
+
+def test_native_tcp_transfer(native_bin):
+    nbytes = 200_000
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="120">
+          <plugin id="app" path="{native_bin}" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="1"
+                     arguments="tcpserver 8001 {nbytes}" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="2"
+                     arguments="tcpclient server 8001 {nbytes}" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "server", "client") == \
+        {"server": [0], "client": [0]}
+
+
+def test_native_epoll_poll_select(native_bin):
+    """Nonblocking epoll server fed by poll- and select-based clients on
+    separate hosts (the reference's nonblocking-{epoll,poll,select} test
+    matrix, src/test/tcp)."""
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="90">
+          <plugin id="app" path="{native_bin}" />
+          <host id="server">
+            <process plugin="app" starttime="1"
+                     arguments="epollserver 8002 3" />
+          </host>
+          <host id="c1">
+            <process plugin="app" starttime="2"
+                     arguments="pollclient server 8002" />
+          </host>
+          <host id="c2">
+            <process plugin="app" starttime="3"
+                     arguments="pollclient server 8002" />
+          </host>
+          <host id="c3">
+            <process plugin="app" starttime="4"
+                     arguments="selectclient server 8002" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "server", "c1", "c2", "c3") == \
+        {"server": [0], "c1": [0], "c2": [0], "c3": [0]}
+
+
+def test_native_hostname_dns(native_bin):
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_bin}" />
+          <host id="mynode">
+            <process plugin="app" starttime="1" arguments="hostname mynode" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "mynode") == {"mynode": [0]}
+
+
+def test_native_randcheck_deterministic(native_bin):
+    """getrandom + /dev/urandom under the simulator come from the seeded
+    per-host PRNG: two identically-seeded runs produce identical bytes
+    (the reference's determinism test reads /dev/random the same way,
+    src/test/determinism/test_determinism.c)."""
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_bin}" />
+          <host id="node">
+            <process plugin="app" starttime="1" arguments="randcheck" />
+          </host>
+        </shadow>
+    """)
+
+    def one_run():
+        rc, ctrl = run_sim(xml)
+        assert rc == 0
+        proc = ctrl.engine.host_by_name("node").processes[0]
+        assert proc.exit_code == 0
+        out = (proc.app_state or {}).get("stdout", b"")
+        assert out.startswith(b"randcheck ")
+        return out
+
+    assert one_run() == one_run()
+
+
+def test_native_mixed_with_python_plugin(native_bin):
+    """A native client against a Python-plane echo server: both planes share
+    one virtual kernel."""
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="60">
+          <plugin id="app" path="{native_bin}" />
+          <plugin id="echo" path="python:echo" />
+          <host id="server">
+            <process plugin="echo" starttime="1" arguments="udp server 8000" />
+          </host>
+          <host id="client">
+            <process plugin="app" starttime="2"
+                     arguments="udpclient server 8000 4 256" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "client") == {"client": [0]}
