@@ -1,0 +1,191 @@
+"""Bitcoin-like P2P block-gossip workload (capability analog of
+shadow-plugin-bitcoin, BASELINE.md config #5: 5k-node gossip).
+
+Models the network behavior of Bitcoin's block relay: every node keeps
+long-lived TCP connections to a set of peers, miners periodically announce
+new blocks via ``inv`` messages, peers that haven't seen a block request it
+with ``getdata``, receive the full ``block`` bytes, and re-announce to their
+own peers — the classic epidemic broadcast whose propagation latency is the
+headline metric for this workload family.
+
+Role:
+    node <peer1,peer2,...|-> [mine <interval_sec> <block_bytes> <count>]
+        Connects out to the listed peers (``-`` = none; inbound only) on
+        port 8333 and serves inbound connections.  With ``mine``, creates
+        <count> blocks every <interval_sec> seconds and announces them.
+
+Wire format: length-prefixed messages ``u32 len | u8 type | payload``.
+Types: INV (u64 block id), GETDATA (u64 block id), BLOCK (u64 id + bytes).
+
+``process.app_state`` exposes per-node stats (blocks known, bytes relayed,
+per-block first-seen virtual time) for tests and benchmark reporting.
+"""
+
+from __future__ import annotations
+
+import struct
+
+from .registry import register
+
+PORT = 8333
+MSG_HDR = struct.Struct(">IB")
+INV = 1
+GETDATA = 2
+BLOCK = 3
+
+
+class NodeState:
+    def __init__(self):
+        self.blocks = {}            # block_id -> size
+        self.requested = set()      # getdata in flight (bitcoind tracks
+                                    # in-flight blocks per peer the same way)
+        self.first_seen_ns = {}     # block_id -> virtual ns
+        self.peers = []             # connected peer fds
+        self.bytes_relayed = 0
+        self.mined = 0
+
+
+def _pack(msg_type: int, payload: bytes) -> bytes:
+    return MSG_HDR.pack(len(payload) + 1, msg_type) + payload
+
+
+def recv_exact(api, fd, n):
+    """Framing helper: delegates to the shared SyscallAPI.recv_exact."""
+    r = yield from api.recv_exact(fd, n)
+    return r
+
+
+def recv_msg(api, fd):
+    hdr = yield from recv_exact(api, fd, MSG_HDR.size)
+    if hdr is None:
+        return None
+    length, msg_type = MSG_HDR.unpack(hdr)
+    payload = b""
+    if length > 1:
+        payload = yield from recv_exact(api, fd, length - 1)
+        if payload is None:
+            return None
+    return msg_type, payload
+
+
+@register("bitcoin")
+def main(api, args):
+    st = NodeState()
+    api.process.app_state = st
+    peers = [] if not args or args[0] in ("-", "") else args[0].split(",")
+    mine_every = mine_size = mine_count = 0
+    if len(args) >= 4 and args[1] == "mine":
+        mine_every = float(args[2])
+        mine_size = int(args[3])
+        mine_count = int(args[4]) if len(args) > 4 else 1
+
+    lfd = api.socket("tcp")
+    api.bind(lfd, ("0.0.0.0", PORT))
+    api.listen(lfd, 125)  # bitcoind's default max connections
+    api.spawn(_accept_loop, api, st, lfd)
+
+    for peer in peers:
+        api.spawn(_dial, api, st, peer)
+
+    if mine_every > 0:
+        api.spawn(_miner, api, st, mine_every, mine_size, mine_count)
+
+    # the node runs until the simulation stops it
+    while True:
+        yield from api.sleep(3600)
+
+
+def _accept_loop(api, st, lfd):
+    while True:
+        cfd, _ = yield from api.accept(lfd)
+        st.peers.append(cfd)
+        api.spawn(_peer_loop, api, st, cfd)
+
+
+def _dial(api, st, peer):
+    """Dial with retry: peers boot in staggered waves, so the first attempts
+    can hit a not-yet-listening node (bitcoind retries its addrman the same
+    way); give up only after the overlay has clearly had time to form."""
+    fd = None
+    for attempt in range(12):
+        fd = api.socket("tcp")
+        try:
+            yield from api.connect(fd, (peer, PORT))
+            break
+        except OSError:
+            api.close(fd)
+            fd = None
+            yield from api.sleep(5 * (attempt + 1))
+    if fd is None:
+        api.log(f"bitcoin: dial {peer} failed permanently")
+        return
+    st.peers.append(fd)
+    # announce everything we already know (block exchange on connect)
+    for block_id in list(st.blocks):
+        yield from api.send(fd, _pack(INV, struct.pack(">Q", block_id)))
+    yield from _peer_loop(api, st, fd)
+
+
+def _peer_loop(api, st, fd):
+    inflight = set()  # getdata sent on THIS connection, block not yet seen
+    while True:
+        msg = yield from recv_msg(api, fd)
+        if msg is None:
+            break
+        msg_type, payload = msg
+        if msg_type == INV:
+            (block_id,) = struct.unpack(">Q", payload)
+            if block_id not in st.blocks and block_id not in st.requested:
+                st.requested.add(block_id)
+                inflight.add(block_id)
+                yield from api.send(fd, _pack(GETDATA, payload))
+        elif msg_type == GETDATA:
+            (block_id,) = struct.unpack(">Q", payload)
+            size = st.blocks.get(block_id)
+            if size is not None:
+                body = struct.pack(">Q", block_id) + b"\0" * size
+                st.bytes_relayed += len(body)
+                yield from api.send(fd, _pack(BLOCK, body))
+        elif msg_type == BLOCK:
+            (block_id,) = struct.unpack(">Q", payload[:8])
+            st.requested.discard(block_id)
+            inflight.discard(block_id)
+            if block_id not in st.blocks:
+                _learn_block(api, st, block_id, len(payload) - 8)
+                yield from _announce(api, st, block_id, exclude=fd)
+    # a dead peer's undelivered getdata must not black-hole those blocks:
+    # clear them so another peer's inv re-triggers the request
+    for block_id in inflight:
+        if block_id not in st.blocks:
+            st.requested.discard(block_id)
+    if fd in st.peers:
+        st.peers.remove(fd)
+    api.close(fd)
+
+
+def _learn_block(api, st, block_id, size):
+    st.blocks[block_id] = size
+    st.first_seen_ns[block_id] = api.now_ns()
+
+
+def _announce(api, st, block_id, exclude=None):
+    inv = _pack(INV, struct.pack(">Q", block_id))
+    for peer_fd in list(st.peers):
+        if peer_fd == exclude:
+            continue
+        try:
+            yield from api.send(peer_fd, inv)
+        except OSError:
+            pass
+
+
+def _miner(api, st, every_sec, block_size, count):
+    """Creates blocks with globally-unique ids: (host_id << 20) | seq."""
+    host_id = api.host.id
+    for seq in range(count):
+        yield from api.sleep(every_sec)
+        block_id = (host_id << 20) | seq
+        _learn_block(api, st, block_id, block_size)
+        st.mined += 1
+        api.log(f"bitcoin: mined block {block_id:#x} ({block_size}B)")
+        yield from _announce(api, st, block_id)

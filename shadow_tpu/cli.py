@@ -18,17 +18,26 @@ from .core.controller import run_simulation
 from .core.logger import SimLogger, set_logger
 from .core.options import parse_args
 
-# TCP filetransfer once descriptor/tcp.py lands (SURVEY.md §7 stage 6);
-# UDP echo keeps --test honest until then.
+# The reference's --test serves /bin/ls (~100KB era-adjusted: we use 16KB)
+# to 1000 clients x 10 downloads via a filetransfer plugin (examples.c:10);
+# same workload shape here over the full TCP stack.
 BUILTIN_TEST_CONFIG = textwrap.dedent("""\
-    <shadow stoptime="180">
+    <shadow stoptime="600">
+      <plugin id="filetransfer" path="python:filetransfer" />
       <plugin id="echo" path="python:echo" />
-      <host id="server" bandwidthdown="102400" bandwidthup="102400">
-        <process plugin="echo" starttime="1" arguments="udp server 8000" />
+      <host id="server" bandwidthdown="1048576" bandwidthup="1048576">
+        <process plugin="filetransfer" starttime="1" arguments="server 80 16384" />
       </host>
-      <host id="client" quantity="10" bandwidthdown="10240" bandwidthup="5120">
+      <host id="client" quantity="100" bandwidthdown="10240" bandwidthup="5120">
+        <process plugin="filetransfer" starttime="2"
+                 arguments="client server 80 10" />
+      </host>
+      <host id="udpclient" bandwidthdown="10240" bandwidthup="5120">
         <process plugin="echo" starttime="2"
-                 arguments="udp client server 8000 10 1024" />
+                 arguments="udp client server2 8000 5 512" />
+      </host>
+      <host id="server2">
+        <process plugin="echo" starttime="1" arguments="udp server 8000" />
       </host>
     </shadow>
 """)

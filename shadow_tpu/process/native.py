@@ -231,7 +231,10 @@ class NativeKernel:
         total = 0
         view = memoryview(payload)
         while total < len(view):
-            n = sock.send_user_data(bytes(view[total:]))
+            # bounded slice: re-materializing the whole tail each retry
+            # would make a large blocking write O(n^2) in copied bytes
+            chunk = bytes(view[total:total + 262144])
+            n = sock.send_user_data(chunk)
             total += n
             if total >= len(view) or nonblock:
                 break
@@ -626,8 +629,25 @@ def run_native_plugin(api, args: List[str], binary: str,
     child_side.close()
     kernel = NativeKernel(api, sim_side)
     try:
+        # the shim's constructor sends a GETTIME before the plugin's main()
+        # runs, so the first request arrives within exec latency.  A binary
+        # the shim cannot interpose (statically linked, exec'd helper)
+        # would otherwise block the whole simulator in the first read —
+        # bound that wait and fail loudly instead.
+        sim_side.settimeout(10.0)
+        hdr = _read_exact(sim_side, REQ_HDR.size)  # timeout -> None
+        if hdr is None and proc.poll() is None:
+            log.warning("native",
+                        f"{name}: {binary} never spoke the interposition "
+                        "protocol (statically linked? exec'd a helper?); "
+                        "killing it")
+            raise OSError("plugin not interposable")
+        sim_side.settimeout(None)
+        first = True
         while True:
-            hdr = _read_exact(sim_side, REQ_HDR.size)
+            if not first:
+                hdr = _read_exact(sim_side, REQ_HDR.size)
+            first = False
             if hdr is None:
                 break
             length, op, a, b, c, d = REQ_HDR.unpack(hdr)

@@ -415,6 +415,17 @@ class SyscallAPI:
         data, _ = yield from self.recvfrom(fd, nbytes)
         return data
 
+    def recv_exact(self, fd: int, nbytes: int):
+        """Blocking read of exactly ``nbytes``; None on EOF mid-read.  The
+        shared framing helper for stream-protocol apps."""
+        buf = b""
+        while len(buf) < nbytes:
+            chunk = yield from self.recv(fd, nbytes - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
     def try_recvfrom(self, fd: int, nbytes: int = 65536):
         """Non-blocking: None if nothing available."""
         r = self._sock(fd).receive_user_data(nbytes)

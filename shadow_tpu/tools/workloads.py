@@ -1,0 +1,152 @@
+"""Benchmark workload-config generator: the five BASELINE.md configs.
+
+The reference's benchmark plan (BASELINE.json "configs") names five
+workloads; this module generates runnable shadow_tpu XML configs for each,
+parameterized so tests use small instances and benchmarks use full scale:
+
+  1. two_host_echo()          — 2-host tgen echo (resource/examples analog)
+  2. star_bulk(100)           — 100-host bulk transfer, single-AS star
+  3. tor_network(1000, ...)   — 1k-relay Tor overlay, python:tor app
+  4. tor_network(10000, topology=...) — 10k-host Tor on the reference's
+     Internet GraphML (pass /root/reference/resource/topology.graphml.xml.xz)
+  5. bitcoin_network(5000)    — 5k-node Bitcoin gossip
+
+Usage: ``python -m shadow_tpu.tools.workloads <name> [> config.xml]`` with
+name in {echo2, star100, tor1k, tor10k, btc5k} or programmatically.
+
+Determinism: all random structure (peer graphs, circuit paths) comes from a
+seeded numpy Generator, so a config built with the same arguments is
+byte-identical.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+
+def two_host_echo(stoptime: int = 60) -> str:
+    return f"""<shadow stoptime="{stoptime}">
+  <plugin id="tgen" path="python:tgen" />
+  <host id="server" bandwidthdown="102400" bandwidthup="102400">
+    <process plugin="tgen" starttime="1" arguments="server 80" />
+  </host>
+  <host id="client" bandwidthdown="10240" bandwidthup="5120">
+    <process plugin="tgen" starttime="2" arguments="client server 80 1024:1048576" />
+  </host>
+</shadow>
+"""
+
+
+def star_bulk(n_clients: int = 100, stoptime: int = 600,
+              bulk_bytes: int = 10 * 1024 * 1024) -> str:
+    """Single-AS star: one big server, n clients each pulling bulk_bytes."""
+    lines = [f'<shadow stoptime="{stoptime}">',
+             '  <plugin id="tgen" path="python:tgen" />',
+             '  <host id="server" bandwidthdown="1048576" bandwidthup="1048576">',
+             '    <process plugin="tgen" starttime="1" arguments="server 80" />',
+             '  </host>']
+    for i in range(n_clients):
+        lines.append(
+            f'  <host id="client{i}" bandwidthdown="102400" bandwidthup="51200">\n'
+            f'    <process plugin="tgen" starttime="2" '
+            f'arguments="client server 80 256:{bulk_bytes}" />\n'
+            '  </host>')
+    lines.append('</shadow>')
+    return "\n".join(lines) + "\n"
+
+
+def tor_network(n_relays: int = 1000, n_clients: Optional[int] = None,
+                n_servers: Optional[int] = None, stoptime: int = 600,
+                streams_per_client: int = 3, stream_spec: str = "512:51200",
+                topology_path: Optional[str] = None, seed: int = 42) -> str:
+    """Tor overlay: relays + clients with random 3-hop paths + destinations.
+
+    Mirrors the shape of the reference's Tor experiments (shadow-plugin-tor
+    topologies: ~10% exits/guards, ~1 client per relay, few fat servers)."""
+    rng = np.random.default_rng(seed)
+    n_clients = n_clients if n_clients is not None else max(1, n_relays)
+    n_servers = n_servers if n_servers is not None else max(1, n_relays // 20)
+    lines = [f'<shadow stoptime="{stoptime}">']
+    if topology_path:
+        lines.append(f'  <topology path="{topology_path}" />')
+    lines.append('  <plugin id="tor" path="python:tor" />')
+    for i in range(n_relays):
+        lines.append(
+            f'  <host id="relay{i}" bandwidthdown="102400" bandwidthup="102400">\n'
+            f'    <process plugin="tor" starttime="1" arguments="relay 9001" />\n'
+            '  </host>')
+    for i in range(n_servers):
+        lines.append(
+            f'  <host id="dest{i}" bandwidthdown="1048576" bandwidthup="1048576">\n'
+            f'    <process plugin="tor" starttime="1" arguments="server 80" />\n'
+            '  </host>')
+    for i in range(n_clients):
+        path = rng.choice(n_relays, size=min(3, n_relays), replace=False)
+        path_s = ",".join(f"relay{int(r)}" for r in path)
+        dest = int(rng.integers(0, n_servers))
+        start = 5 + int(rng.integers(0, 30))
+        lines.append(
+            f'  <host id="torclient{i}" bandwidthdown="51200" bandwidthup="10240">\n'
+            f'    <process plugin="tor" starttime="{start}" '
+            f'arguments="client 9050 {path_s} dest{dest} 80 '
+            f'{streams_per_client} {stream_spec}" />\n'
+            '  </host>')
+    lines.append('</shadow>')
+    return "\n".join(lines) + "\n"
+
+
+def bitcoin_network(n_nodes: int = 5000, n_peers: int = 8,
+                    n_miners: int = 10, stoptime: int = 600,
+                    block_interval: int = 60, block_bytes: int = 1_000_000,
+                    blocks_per_miner: int = 3, seed: int = 42) -> str:
+    """Bitcoin gossip: each node dials n_peers random earlier nodes (the
+    standard random-graph construction; guarantees a connected overlay)."""
+    rng = np.random.default_rng(seed)
+    lines = [f'<shadow stoptime="{stoptime}">',
+             '  <plugin id="btc" path="python:bitcoin" />']
+    miners = set(int(x) for x in
+                 rng.choice(n_nodes, size=min(n_miners, n_nodes),
+                            replace=False))
+    for i in range(n_nodes):
+        if i == 0:
+            peers = "-"
+        else:
+            k = min(n_peers, i)
+            chosen = rng.choice(i, size=k, replace=False)
+            peers = ",".join(f"node{int(p)}" for p in chosen)
+        mine = (f" mine {block_interval} {block_bytes} {blocks_per_miner}"
+                if i in miners else "")
+        start = 1 + (i % 20)  # staggered boot, 20 waves
+        lines.append(
+            f'  <host id="node{i}" bandwidthdown="102400" bandwidthup="102400">\n'
+            f'    <process plugin="btc" starttime="{start}" '
+            f'arguments="{peers}{mine}" />\n'
+            '  </host>')
+    lines.append('</shadow>')
+    return "\n".join(lines) + "\n"
+
+
+NAMED = {
+    "echo2": lambda: two_host_echo(),
+    "star100": lambda: star_bulk(100),
+    "tor1k": lambda: tor_network(1000),
+    "tor10k": lambda: tor_network(
+        10000, topology_path="/root/reference/resource/topology.graphml.xml.xz"),
+    "btc5k": lambda: bitcoin_network(5000),
+}
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) < 1 or argv[0] not in NAMED:
+        print(f"usage: python -m shadow_tpu.tools.workloads "
+              f"{{{','.join(NAMED)}}}", file=sys.stderr)
+        return 2
+    sys.stdout.write(NAMED[argv[0]]())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,106 @@
+"""Workload-model apps: tor (onion circuits) and bitcoin (block gossip) —
+the reference's flagship workload families (BASELINE.md configs #3/#4/#5,
+shadow-plugin-tor / shadow-plugin-bitcoin)."""
+
+import textwrap
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+
+
+def run_sim(xml, stop=300, policy="global", workers=0, seed=1):
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = stop
+    opts = Options(scheduler_policy=policy, workers=workers,
+                   stop_time_sec=stop, seed=seed)
+    ctrl = Controller(opts, cfg)
+    rc = ctrl.run()
+    return rc, ctrl
+
+
+TOR_XML = textwrap.dedent("""\
+    <shadow stoptime="300">
+      <plugin id="tor" path="python:tor" />
+      <host id="guard"><process plugin="tor" starttime="1" arguments="relay 9001" /></host>
+      <host id="middle"><process plugin="tor" starttime="1" arguments="relay 9001" /></host>
+      <host id="exit"><process plugin="tor" starttime="1" arguments="relay 9001" /></host>
+      <host id="dest"><process plugin="tor" starttime="1" arguments="server 80" /></host>
+      <host id="client">
+        <process plugin="tor" starttime="5"
+                 arguments="client 9050 guard,middle,exit dest 80 3 512:20000" />
+      </host>
+    </shadow>
+""")
+
+
+def test_tor_circuit_streams():
+    """Client builds a 3-hop circuit and runs 3 sequential streams through
+    it; every relay forwards cells; the downloaded byte counts check out."""
+    rc, ctrl = run_sim(TOR_XML)
+    assert rc == 0
+    client = ctrl.engine.host_by_name("client").processes[0]
+    assert client.exit_code == 0
+    stats = client.app_state
+    assert stats.streams_ok == 3
+    assert stats.bytes_down == 3 * 20000
+    # each relay moved cells (store-and-forward at every hop)
+    for relay in ("guard", "middle", "exit"):
+        st = ctrl.engine.host_by_name(relay).processes[0].app_state
+        assert st.cells_relayed > 0, relay
+    # the middle relay never talks to the destination directly: its traffic
+    # is pure cell relay (3 * 20000B of DATA cells each way at minimum)
+    middle = ctrl.engine.host_by_name("middle")
+    assert middle.tracker.out_remote.bytes_data > 3 * 20000
+
+
+def test_tor_deterministic():
+    rc1, c1 = run_sim(TOR_XML)
+    rc2, c2 = run_sim(TOR_XML)
+    assert (rc1, c1.engine.events_executed, c1.engine.rounds_executed) == \
+           (rc2, c2.engine.events_executed, c2.engine.rounds_executed)
+
+
+BITCOIN_XML = textwrap.dedent("""\
+    <shadow stoptime="600">
+      <plugin id="btc" path="python:bitcoin" />
+      <host id="miner">
+        <process plugin="btc" starttime="1" arguments="- mine 10 20000 3" />
+      </host>
+      <host id="n1"><process plugin="btc" starttime="2" arguments="miner" /></host>
+      <host id="n2"><process plugin="btc" starttime="2" arguments="miner" /></host>
+      <host id="n3"><process plugin="btc" starttime="3" arguments="n1" /></host>
+      <host id="n4"><process plugin="btc" starttime="3" arguments="n2" /></host>
+      <host id="n5"><process plugin="btc" starttime="4" arguments="n3,n4" /></host>
+    </shadow>
+""")
+
+
+def test_bitcoin_gossip_propagation():
+    """3 mined blocks reach every node through inv/getdata/block gossip,
+    including nodes multiple hops from the miner."""
+    rc, ctrl = run_sim(BITCOIN_XML, stop=600)
+    assert rc == 0
+    miner_state = ctrl.engine.host_by_name("miner").processes[0].app_state
+    assert miner_state.mined == 3
+    for name in ("n1", "n2", "n3", "n4", "n5"):
+        st = ctrl.engine.host_by_name(name).processes[0].app_state
+        assert len(st.blocks) == 3, f"{name} has {len(st.blocks)}/3 blocks"
+    # propagation is ordered: n5 (2 hops out) sees blocks after n1 (1 hop)
+    n1 = ctrl.engine.host_by_name("n1").processes[0].app_state
+    n5 = ctrl.engine.host_by_name("n5").processes[0].app_state
+    for block_id in n1.first_seen_ns:
+        assert n5.first_seen_ns[block_id] > n1.first_seen_ns[block_id]
+
+
+def test_bitcoin_no_duplicate_block_downloads():
+    """A node with two peers must fetch each block body once (getdata only
+    for unseen ids), even though it hears two invs."""
+    rc, ctrl = run_sim(BITCOIN_XML, stop=600)
+    assert rc == 0
+    total_mined_bytes = 3 * 20000
+    n5 = ctrl.engine.host_by_name("n5")
+    # n5's inbound data: 3 block bodies + small control messages; duplicate
+    # bodies would roughly double this
+    received = n5.tracker.in_remote.bytes_data
+    assert total_mined_bytes < received < total_mined_bytes * 1.5
