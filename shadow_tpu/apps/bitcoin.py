@@ -49,20 +49,14 @@ def _pack(msg_type: int, payload: bytes) -> bytes:
     return MSG_HDR.pack(len(payload) + 1, msg_type) + payload
 
 
-def recv_exact(api, fd, n):
-    """Framing helper: delegates to the shared SyscallAPI.recv_exact."""
-    r = yield from api.recv_exact(fd, n)
-    return r
-
-
 def recv_msg(api, fd):
-    hdr = yield from recv_exact(api, fd, MSG_HDR.size)
+    hdr = yield from api.recv_exact(fd, MSG_HDR.size)
     if hdr is None:
         return None
     length, msg_type = MSG_HDR.unpack(hdr)
     payload = b""
     if length > 1:
-        payload = yield from recv_exact(api, fd, length - 1)
+        payload = yield from api.recv_exact(fd, length - 1)
         if payload is None:
             return None
     return msg_type, payload
@@ -99,7 +93,15 @@ def _accept_loop(api, st, lfd):
     while True:
         cfd, _ = yield from api.accept(lfd)
         st.peers.append(cfd)
-        api.spawn(_peer_loop, api, st, cfd)
+        api.spawn(_inbound_peer, api, st, cfd)
+
+
+def _inbound_peer(api, st, fd):
+    # block exchange must be two-way: a late joiner's inbound link is its
+    # only path to blocks mined before the link formed
+    for block_id in list(st.blocks):
+        yield from api.send(fd, _pack(INV, struct.pack(">Q", block_id)))
+    yield from _peer_loop(api, st, fd)
 
 
 def _dial(api, st, peer):

@@ -56,21 +56,11 @@ def parse_cell(cell: bytes):
     return circ_id, cmd, cell[HDR.size:HDR.size + plen]
 
 
-def recv_exact(api, fd, n):
-    """Framing helper: delegates to the shared SyscallAPI.recv_exact."""
-    r = yield from api.recv_exact(fd, n)
-    return r
-
-
 def recv_cell(api, fd):
-    cell = yield from recv_exact(api, fd, CELL_SIZE)
+    cell = yield from api.recv_exact(fd, CELL_SIZE)
     if cell is None:
         return None
     return parse_cell(cell)
-
-
-def send_all(api, fd, data):
-    yield from api.send(fd, data)
 
 
 @register("tor")
@@ -128,14 +118,14 @@ def _relay_conn(api, st, fd):
         key = (fd, circ_id)
         if cmd == CREATE:
             st.circuits[key] = None  # endpoint of the circuit so far
-            yield from send_all(api, fd, make_cell(circ_id, CREATED))
+            yield from api.send(fd, make_cell(circ_id, CREATED))
         elif cmd == EXTEND:
             route = st.circuits.get(key)
             if route is not None and route[0] == "fwd":
                 # already spliced: the EXTEND is for a later hop — relay it
                 # down the circuit (real Tor extends end-to-end the same way)
                 _, out, out_circ = route
-                yield from send_all(api, out,
+                yield from api.send(out,
                                     make_cell(out_circ, EXTEND, payload))
                 continue
             # we are the current endpoint: connect onward, splice
@@ -145,18 +135,18 @@ def _relay_conn(api, st, fd):
             try:
                 yield from api.connect(out, (host, int(port)))
             except OSError:
-                yield from send_all(api, fd, make_cell(circ_id, END))
+                yield from api.send(fd, make_cell(circ_id, END))
                 continue
             out_circ = st.next_circ_id
             st.next_circ_id += 1
-            yield from send_all(api, out, make_cell(out_circ, CREATE))
+            yield from api.send(out, make_cell(out_circ, CREATE))
             reply = yield from recv_cell(api, out)
             if reply is None or reply[1] != CREATED:
-                yield from send_all(api, fd, make_cell(circ_id, END))
+                yield from api.send(fd, make_cell(circ_id, END))
                 continue
             st.circuits[key] = ("fwd", out, out_circ)
             api.spawn(_relay_backward, api, st, out, out_circ, fd, circ_id)
-            yield from send_all(api, fd, make_cell(circ_id, EXTENDED))
+            yield from api.send(fd, make_cell(circ_id, EXTENDED))
         elif cmd in (BEGIN, DATA, END):
             route = st.circuits.get(key)
             if cmd == BEGIN and (route is None or route[0] == "exit"):
@@ -168,20 +158,20 @@ def _relay_conn(api, st, fd):
                 try:
                     yield from api.connect(sfd, (host, int(port)))
                 except OSError:
-                    yield from send_all(api, fd, make_cell(circ_id, END))
+                    yield from api.send(fd, make_cell(circ_id, END))
                     continue
                 st.circuits[key] = ("exit", sfd)
                 api.spawn(_exit_backward, api, st, key, sfd, fd, circ_id)
-                yield from send_all(api, fd, make_cell(circ_id, CONNECTED))
+                yield from api.send(fd, make_cell(circ_id, CONNECTED))
             elif route is not None and route[0] == "fwd":
                 _, out, out_circ = route
                 st.cells_relayed += 1
-                yield from send_all(api, out, make_cell(out_circ, cmd, payload))
+                yield from api.send(out, make_cell(out_circ, cmd, payload))
             elif route is not None and route[0] == "exit":
                 _, sfd = route
                 if cmd == DATA:
                     st.cells_relayed += 1
-                    yield from send_all(api, sfd, payload)
+                    yield from api.send(sfd, payload)
                 elif cmd == END:
                     api.close(sfd)
                     st.circuits.pop(key, None)
@@ -198,7 +188,7 @@ def _relay_backward(api, st, out, out_circ, fd, circ_id):
         if in_circ != out_circ:
             continue
         st.cells_relayed += 1
-        yield from send_all(api, fd, make_cell(circ_id, cmd, payload))
+        yield from api.send(fd, make_cell(circ_id, cmd, payload))
 
 
 def _exit_backward(api, st, key, sfd, fd, circ_id):
@@ -208,12 +198,12 @@ def _exit_backward(api, st, key, sfd, fd, circ_id):
         if not data:
             break
         st.cells_relayed += 1
-        yield from send_all(api, fd, make_cell(circ_id, DATA, data))
+        yield from api.send(fd, make_cell(circ_id, DATA, data))
     # destination closed: clear the route so the next BEGIN can reopen
     if st.circuits.get(key) == ("exit", sfd):
         st.circuits[key] = None
     api.close(sfd)
-    yield from send_all(api, fd, make_cell(circ_id, END))
+    yield from api.send(fd, make_cell(circ_id, END))
 
 
 # ---------------------------------------------------------------------------
@@ -231,7 +221,7 @@ def server_main(api, port):
 
 
 def _serve_one(api, fd):
-    hdr = yield from recv_exact(api, fd, 16)
+    hdr = yield from api.recv_exact(fd, 16)
     if hdr is None:
         api.close(fd)
         return
@@ -266,7 +256,10 @@ class _ClientStats:
 
 def client_main(api, args):
     # args: <socksport> <path> <dest> <destport> <nstreams> <spec...>
-    path = args[1].split(",")
+    # path entries are "relayhost" or "relayhost:orport" (default 9001,
+    # matching the relay role's default)
+    path = [(h.partition(":")[0], int(h.partition(":")[2] or 9001))
+            for h in args[1].split(",")]
     dest, destport = args[2], int(args[3])
     nstreams = int(args[4]) if len(args) > 4 else 1
     specs = args[5:] if len(args) > 5 else ["100:10000"]
@@ -274,23 +267,23 @@ def client_main(api, args):
     api.process.app_state = stats
 
     # build the circuit: connect to the guard, CREATE, then EXTEND per hop
-    guard = path[0]
+    guard, guard_port = path[0]
     fd = api.socket("tcp")
-    yield from api.connect(fd, (guard, 9001))
+    yield from api.connect(fd, (guard, guard_port))
     circ = 1
-    yield from send_all(api, fd, make_cell(circ, CREATE))
+    yield from api.send(fd, make_cell(circ, CREATE))
     reply = yield from recv_cell(api, fd)
     if reply is None or reply[1] != CREATED:
         api.log("tor client: CREATE failed")
         return False
-    for hop in path[1:]:
-        yield from send_all(api, fd,
-                            make_cell(circ, EXTEND, f"{hop}:9001".encode()))
+    for hop, hop_port in path[1:]:
+        yield from api.send(fd,
+                            make_cell(circ, EXTEND, f"{hop}:{hop_port}".encode()))
         reply = yield from recv_cell(api, fd)
         if reply is None or reply[1] != EXTENDED:
             api.log(f"tor client: EXTEND to {hop} failed")
             return False
-    api.log(f"tor client: circuit built through {'->'.join(path)}")
+    api.log(f"tor client: circuit built through {'->'.join(h for h, _ in path)}")
 
     for i in range(nstreams):
         spec = specs[i % len(specs)]
@@ -301,7 +294,7 @@ def client_main(api, args):
         stats.streams_ok += 1
         stats.bytes_up += up
         stats.bytes_down += down
-    yield from send_all(api, fd, make_cell(circ, END))
+    yield from api.send(fd, make_cell(circ, END))
     api.close(fd)
     api.log(f"tor client: {stats.streams_ok} streams OK "
             f"({stats.bytes_up}B up, {stats.bytes_down}B down)")
@@ -309,7 +302,7 @@ def client_main(api, args):
 
 
 def _run_stream(api, fd, circ, dest, destport, up, down):
-    yield from send_all(api, fd,
+    yield from api.send(fd,
                         make_cell(circ, BEGIN, f"{dest}:{destport}".encode()))
     reply = yield from recv_cell(api, fd)
     if reply is None or reply[1] != CONNECTED:
@@ -318,7 +311,7 @@ def _run_stream(api, fd, circ, dest, destport, up, down):
     hdr = up.to_bytes(8, "big") + down.to_bytes(8, "big")
     body = hdr + b"u" * up
     for off in range(0, len(body), PAYLOAD_MAX):
-        yield from send_all(api, fd,
+        yield from api.send(fd,
                             make_cell(circ, DATA, body[off:off + PAYLOAD_MAX]))
     got = 0
     ended = False

@@ -1,0 +1,137 @@
+"""Round-boundary state snapshots, state digests, and replay-based resume.
+
+The reference has no checkpointing (SURVEY.md §5: "Checkpoint/resume:
+absent").  This module adds the capability the TPU rebuild can offer
+cheaply, in three pieces:
+
+* :func:`state_digest` — a deterministic hash over the complete observable
+  simulation state (clock, per-host protocol/interface/tracker state,
+  pending event queue shape, RNG draw counts).  Two runs are in the same
+  state iff their digests match; this is the machine-checkable form of the
+  event-order parity metric (BASELINE.json) and is what the cross-policy
+  parity tests assert.
+
+* :func:`save_snapshot` / :func:`load_snapshot` — pickle the digestible
+  state to disk at round boundaries (``--checkpoint-interval N`` writes
+  ``checkpoint_<simsec>.ckpt`` into ``--checkpoint-dir``).  Snapshots are
+  for failure diagnosis and cross-run comparison; they deliberately exclude
+  live app coroutines and native plugin processes (OS state that cannot be
+  serialized — the same reason the reference never checkpointed).
+
+* :func:`resume_digest` — recovery leans on the determinism kernel: re-run
+  the same config+seed to the snapshot's time and verify the digest
+  matches, then continue.  Deterministic replay makes restart-after-crash
+  exact rather than approximate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Dict, Optional
+
+from . import stime
+
+
+def _socket_state(sock) -> tuple:
+    state = (sock.kind, getattr(sock, "state", None),
+             sock.bound_ip, sock.bound_port,
+             getattr(sock, "peer_ip", None), getattr(sock, "peer_port", None),
+             sock.in_bytes, sock.out_bytes)
+    if sock.kind == "tcp":
+        state += (sock.snd_una, sock.snd_nxt, sock.rcv_nxt, sock.snd_wnd,
+                  len(sock.unacked), len(sock.reorder),
+                  sock.send_pending_bytes, sock.read_bytes,
+                  sock.cong.cwnd if sock.cong is not None else 0)
+    return state
+
+
+def _host_state(host) -> Dict:
+    descriptors = {}
+    for handle, desc in sorted(host._descriptors.items()):
+        if hasattr(desc, "in_bytes"):  # sockets (tcp/udp/pipe ends)
+            descriptors[handle] = _socket_state(desc)
+        else:
+            descriptors[handle] = (desc.kind, desc.status, desc.closed)
+    t = host.tracker
+    return {
+        "name": host.name,
+        "descriptors": descriptors,
+        "tracker": (t.in_remote.bytes_total, t.out_remote.bytes_total,
+                    t.in_remote.packets_total, t.out_remote.packets_total,
+                    t.out_remote.packets_retrans, t.drops),
+        "processes": [(p.name, p.running, p.exited, p.exit_code)
+                      for p in host.processes],
+        "ifaces": {ip: (i.send_bucket.bytes_remaining, i.receive_bucket.bytes_remaining)
+                   for ip, i in sorted(host.interfaces.items())},
+    }
+
+
+def collect_state(engine) -> Dict:
+    """The digestible snapshot of everything the simulation has computed."""
+    return {
+        "sim_time_ns": engine.scheduler.window_start,
+        "rounds": engine.rounds_executed,
+        "hosts": {hid: _host_state(h) for hid, h in sorted(engine.hosts.items())},
+        "pending_events": engine.scheduler.policy.pending_count()
+        if hasattr(engine.scheduler.policy, "pending_count") else None,
+    }
+
+
+def state_digest(engine) -> str:
+    """Deterministic hex digest of the current simulation state."""
+    blob = pickle.dumps(collect_state(engine), protocol=4)
+    return hashlib.sha256(blob).hexdigest()
+
+
+def save_snapshot(engine, path: str) -> str:
+    state = collect_state(engine)
+    state["digest"] = hashlib.sha256(
+        pickle.dumps(state, protocol=4)).hexdigest()
+    state["options"] = {
+        "seed": engine.options.seed,
+        "scheduler_policy": engine.options.scheduler_policy,
+        "workers": engine.options.workers,
+        "stop_time_sec": engine.options.stop_time_sec,
+    }
+    with open(path, "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    return state["digest"]
+
+
+def load_snapshot(path: str) -> Dict:
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+def resume_digest(snapshot: Dict, engine) -> bool:
+    """True iff a replayed engine has reached exactly the snapshot's state
+    (call after running the same config+seed to snapshot['sim_time_ns'])."""
+    current = collect_state(engine)
+    blob = pickle.dumps(current, protocol=4)
+    return hashlib.sha256(blob).hexdigest() == snapshot["digest"]
+
+
+class CheckpointWriter:
+    """Engine-side round-boundary hook: writes a snapshot every
+    ``interval_sec`` of virtual time into ``out_dir``."""
+
+    def __init__(self, interval_sec: int, out_dir: str):
+        self.interval_ns = interval_sec * stime.SIM_TIME_SEC
+        self.out_dir = out_dir
+        self.next_at = self.interval_ns
+        self.written = []
+
+    def maybe_write(self, engine) -> Optional[str]:
+        now = engine.scheduler.window_start
+        if now < self.next_at:
+            return None
+        os.makedirs(self.out_dir, exist_ok=True)
+        sim_sec = now // stime.SIM_TIME_SEC
+        path = os.path.join(self.out_dir, f"checkpoint_{sim_sec}.ckpt")
+        save_snapshot(engine, path)
+        self.written.append(path)
+        while self.next_at <= now:
+            self.next_at += self.interval_ns
+        return path

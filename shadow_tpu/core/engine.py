@@ -57,6 +57,11 @@ class Engine:
         self.sim_start_wall: float = 0.0
         self.rounds_executed = 0
         self.events_executed = 0
+        self._checkpointer = None
+        if getattr(options, "checkpoint_interval_sec", 0) > 0:
+            from .checkpoint import CheckpointWriter
+            self._checkpointer = CheckpointWriter(
+                options.checkpoint_interval_sec, options.checkpoint_dir)
 
     # -- registry ----------------------------------------------------------
     def add_host(self, host, requested_ip: Optional[int] = None) -> None:
@@ -186,6 +191,10 @@ class Engine:
         flush = getattr(self.scheduler.policy, "flush_round", None)
         if flush is not None:
             flush(self)
+        if self._checkpointer is not None:
+            path = self._checkpointer.maybe_write(self)
+            if path:
+                get_logger().message("engine", f"checkpoint written: {path}")
 
     def _advance_window(self, lookahead: int) -> bool:
         nxt = self.scheduler.next_event_time()

@@ -54,6 +54,9 @@ class Options:
     # TPU backend
     tpu_max_inflight: int = 1 << 16      # padded packet-batch capacity
     tpu_devices: int = 0                 # 0 = all local devices
+    # Checkpointing (new capability; absent in the reference — SURVEY.md §5)
+    checkpoint_interval_sec: int = 0     # --checkpoint-interval (0 = off)
+    checkpoint_dir: str = "shadow-checkpoints"  # --checkpoint-dir
     # Misc
     config_path: Optional[str] = None
     test_mode: bool = False              # --test builtin example
@@ -80,6 +83,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--interface-qdisc", choices=QDISC_KINDS, default="fifo",
                    dest="interface_qdisc")
     p.add_argument("--interface-buffer", type=int, default=1024000, dest="interface_buffer")
+    p.add_argument("--checkpoint-interval", type=int, default=0,
+                   dest="checkpoint_interval_sec",
+                   help="write a state snapshot every N virtual seconds")
+    p.add_argument("--checkpoint-dir", default="shadow-checkpoints",
+                   dest="checkpoint_dir")
     p.add_argument("--interface-batch", type=int, default=1, dest="interface_batch_ms")
     p.add_argument("--router-queue", choices=ROUTER_QUEUE_KINDS, default="codel",
                    dest="router_queue")

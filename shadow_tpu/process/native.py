@@ -634,15 +634,24 @@ def run_native_plugin(api, args: List[str], binary: str,
         # the shim cannot interpose (statically linked, exec'd helper)
         # would otherwise block the whole simulator in the first read —
         # bound that wait and fail loudly instead.
-        sim_side.settimeout(10.0)
-        hdr = _read_exact(sim_side, REQ_HDR.size)  # timeout -> None
-        if hdr is None and proc.poll() is None:
+        # Wall-clock pressure must not change simulation outcomes, so a
+        # slow-but-alive child gets generous retries; only a child that is
+        # alive yet silent for the full budget (the shim speaks before
+        # main() runs, so silence means it isn't interposed) is killed.
+        import select as _select
+        spoke = False
+        for _ in range(18):  # 18 x 10s = 3 min budget
+            readable, _, _ = _select.select([sim_side], [], [], 10.0)
+            if readable or proc.poll() is not None:
+                spoke = True
+                break
+        if not spoke:
             log.warning("native",
                         f"{name}: {binary} never spoke the interposition "
                         "protocol (statically linked? exec'd a helper?); "
                         "killing it")
             raise OSError("plugin not interposable")
-        sim_side.settimeout(None)
+        hdr = _read_exact(sim_side, REQ_HDR.size)
         first = True
         while True:
             if not first:

@@ -129,12 +129,24 @@ def bitcoin_network(n_nodes: int = 5000, n_peers: int = 8,
     return "\n".join(lines) + "\n"
 
 
+def _reference_topology() -> str:
+    """The Internet-scale GraphML for the tor10k workload: from
+    $SHADOW_TPU_TOPOLOGY, or the conventional reference checkout path."""
+    import os
+    path = os.environ.get("SHADOW_TPU_TOPOLOGY",
+                          "/root/reference/resource/topology.graphml.xml.xz")
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"tor10k needs an Internet-scale GraphML topology; {path} does "
+            "not exist — set $SHADOW_TPU_TOPOLOGY to one")
+    return path
+
+
 NAMED = {
     "echo2": lambda: two_host_echo(),
     "star100": lambda: star_bulk(100),
     "tor1k": lambda: tor_network(1000),
-    "tor10k": lambda: tor_network(
-        10000, topology_path="/root/reference/resource/topology.graphml.xml.xz"),
+    "tor10k": lambda: tor_network(10000, topology_path=_reference_topology()),
     "btc5k": lambda: bitcoin_network(5000),
 }
 

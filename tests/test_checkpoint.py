@@ -1,0 +1,122 @@
+"""Checkpoint/state-digest subsystem (new capability — the reference has
+none, SURVEY.md §5): round-boundary snapshots, deterministic state digests,
+and digest-verified replay-based resume."""
+
+import glob
+import textwrap
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.checkpoint import (load_snapshot, resume_digest,
+                                        save_snapshot, state_digest)
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+
+XML = textwrap.dedent("""\
+    <shadow stoptime="90">
+      <plugin id="tgen" path="python:tgen" />
+      <plugin id="echo" path="python:echo" />
+      <host id="server"><process plugin="tgen" starttime="1" arguments="server 80" /></host>
+      <host id="c1"><process plugin="tgen" starttime="2" arguments="client server 80 1024:409600" /></host>
+      <host id="c2"><process plugin="tgen" starttime="3" arguments="client server 80 2048:204800" /></host>
+      <host id="u1"><process plugin="echo" starttime="1" arguments="udp server 9000" /></host>
+      <host id="u2"><process plugin="echo" starttime="2" arguments="udp client u1 9000 10 700" /></host>
+    </shadow>
+""")
+
+
+def run(policy="global", workers=0, seed=5, stop=90, **opt_kw):
+    cfg = configuration.parse_xml(XML)
+    cfg.stop_time_sec = stop
+    opts = Options(scheduler_policy=policy, workers=workers, seed=seed,
+                   stop_time_sec=stop, **opt_kw)
+    ctrl = Controller(opts, cfg)
+    rc = ctrl.run()
+    assert rc == 0
+    return ctrl
+
+
+def test_state_digest_deterministic():
+    d1 = state_digest(run().engine)
+    d2 = state_digest(run().engine)
+    assert d1 == d2
+
+
+def test_state_digest_cross_policy_parity():
+    """The event-order parity metric (BASELINE.json) as one hash: serial,
+    host-steal(4 workers), and tpu policies end in the identical state."""
+    d_global = state_digest(run(policy="global", workers=0).engine)
+    d_steal = state_digest(run(policy="steal", workers=4).engine)
+    d_tpu = state_digest(run(policy="tpu", workers=0).engine)
+    assert d_global == d_steal == d_tpu
+
+
+LOSSY_TOPO = """<topology><![CDATA[<?xml version="1.0" encoding="UTF-8"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+<key id="d0" for="edge" attr.name="latency" attr.type="double"/>
+<key id="d1" for="edge" attr.name="packetloss" attr.type="double"/>
+<key id="d2" for="node" attr.name="bandwidthdown" attr.type="int"/>
+<key id="d3" for="node" attr.name="bandwidthup" attr.type="int"/>
+<graph edgedefault="undirected">
+  <node id="n0"><data key="d2">10240</data><data key="d3">10240</data></node>
+  <edge source="n0" target="n0"><data key="d0">25.0</data><data key="d1">0.03</data></edge>
+</graph></graphml>]]></topology>"""
+
+
+def test_state_digest_sensitive():
+    """On a lossy topology the seed changes which packets drop, so final
+    states (retransmit counters, cwnd) must differ.  (On a loss-free
+    topology different seeds legitimately converge to the same state.)"""
+    lossy_xml = XML.replace("<plugin", LOSSY_TOPO + "\n  <plugin", 1)
+    cfg_runs = []
+    for seed in (5, 6):
+        cfg = configuration.parse_xml(lossy_xml)
+        cfg.stop_time_sec = 90
+        opts = Options(scheduler_policy="global", workers=0, seed=seed,
+                       stop_time_sec=90)
+        ctrl = Controller(opts, cfg)
+        assert ctrl.run() == 0
+        cfg_runs.append(state_digest(ctrl.engine))
+    assert cfg_runs[0] != cfg_runs[1]
+
+
+def test_checkpoint_interval_writes(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    # checkpoints land on round boundaries, and rounds only exist where
+    # events do (the engine fast-forwards quiet stretches); a 10s heartbeat
+    # guarantees boundaries all along the run
+    ctrl = run(checkpoint_interval_sec=20, checkpoint_dir=ckdir,
+               heartbeat_interval_sec=10)
+    written = sorted(glob.glob(ckdir + "/checkpoint_*.ckpt"))
+    assert len(written) >= 3  # ~90s of sim, one per 20s
+    snap = load_snapshot(written[0])
+    assert snap["sim_time_ns"] >= 20e9
+    assert snap["options"]["seed"] == 5
+    assert len(snap["hosts"]) == 5
+    del ctrl
+
+
+def test_replay_reaches_snapshot_state(tmp_path):
+    """Resume-by-replay: a fresh run of the same config+seed, stopped at the
+    snapshot's virtual time, reproduces the snapshot state exactly."""
+    ckdir = str(tmp_path / "ck")
+    run(checkpoint_interval_sec=30, checkpoint_dir=ckdir)
+    snaps = sorted(glob.glob(ckdir + "/checkpoint_*.ckpt"))
+    assert snaps
+    snap = load_snapshot(snaps[0])
+    # replay with an identical config but a second checkpointer: collect the
+    # same boundary snapshot and compare digests
+    ckdir2 = str(tmp_path / "ck2")
+    run(checkpoint_interval_sec=30, checkpoint_dir=ckdir2)
+    snap2 = load_snapshot(sorted(glob.glob(ckdir2 + "/checkpoint_*.ckpt"))[0])
+    assert snap["digest"] == snap2["digest"]
+
+
+def test_save_and_resume_digest_roundtrip(tmp_path):
+    ctrl = run()
+    path = str(tmp_path / "final.ckpt")
+    save_snapshot(ctrl.engine, path)
+    snap = load_snapshot(path)
+    assert resume_digest(snap, ctrl.engine)
+    # a run in a genuinely different state (stopped earlier) must not match
+    ctrl2 = run(stop=45)
+    assert not resume_digest(snap, ctrl2.engine)
