@@ -129,7 +129,8 @@ class CheckpointWriter:
             return None
         os.makedirs(self.out_dir, exist_ok=True)
         sim_sec = now // stime.SIM_TIME_SEC
-        path = os.path.join(self.out_dir, f"checkpoint_{sim_sec}.ckpt")
+        # zero-padded so lexicographic and chronological order agree
+        path = os.path.join(self.out_dir, f"checkpoint_{sim_sec:08d}.ckpt")
         save_snapshot(engine, path)
         self.written.append(path)
         while self.next_at <= now:

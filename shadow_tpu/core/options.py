@@ -54,6 +54,7 @@ class Options:
     # TPU backend
     tpu_max_inflight: int = 1 << 16      # padded packet-batch capacity
     tpu_devices: int = 0                 # 0 = all local devices
+    tpu_shard_matrix: bool = False       # row-shard path matrices over the mesh
     # Checkpointing (new capability; absent in the reference — SURVEY.md §5)
     checkpoint_interval_sec: int = 0     # --checkpoint-interval (0 = off)
     checkpoint_dir: str = "shadow-checkpoints"  # --checkpoint-dir
@@ -104,6 +105,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-template", default=None, dest="data_template")
     p.add_argument("--tpu-max-inflight", type=int, default=1 << 16, dest="tpu_max_inflight")
     p.add_argument("--tpu-devices", type=int, default=0, dest="tpu_devices")
+    p.add_argument("--tpu-shard-matrix", action="store_true",
+                   dest="tpu_shard_matrix",
+                   help="row-shard the path matrices across the device mesh "
+                        "(for graphs whose tensors exceed one chip's HBM)")
     p.add_argument("--test", action="store_true", dest="test_mode",
                    help="run the built-in example simulation")
     return p

@@ -58,6 +58,11 @@ class SchedulerPolicy:
         """Min event time across all queues (for the next round window)."""
         raise NotImplementedError
 
+    def pending_count(self) -> int:
+        """Total queued events (round-boundary state digests; called only
+        at quiescent points, so unlocked sums are safe)."""
+        raise NotImplementedError
+
 
 class GlobalSinglePolicy(SchedulerPolicy):
     """One global pqueue drained by worker 0 only — the serial total-order
@@ -96,6 +101,9 @@ class GlobalSinglePolicy(SchedulerPolicy):
             key = self.queue.peek_key()
         return key[0] if key is not None else stime.SIM_TIME_MAX
 
+    def pending_count(self) -> int:
+        return len(self.queue)
+
 
 class HostQueuesPolicy(SchedulerPolicy):
     """Per-host locked queues with fixed host->worker assignment — the
@@ -114,6 +122,9 @@ class HostQueuesPolicy(SchedulerPolicy):
         # unprocessed/processed host lists + ordered dual-locking,
         # scheduler_policy_host_steal.c:366-416).
         self._exec_locks: Dict[int, threading.Lock] = {}
+
+    def pending_count(self) -> int:
+        return sum(len(q) for q in self._host_queues.values())
 
     def _queue_for_host(self, hid: int) -> PriorityQueue:
         q = self._host_queues.get(hid)
@@ -311,6 +322,9 @@ class ThreadSinglePolicy(SchedulerPolicy):
                 t = min(t, key[0])
         return t
 
+    def pending_count(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
 
 class ThreadPerThreadPolicy(ThreadSinglePolicy):
     """N×N mailboxes (scheduler_policy_thread_perthread.c): queue (i,j)
@@ -321,6 +335,10 @@ class ThreadPerThreadPolicy(ThreadSinglePolicy):
         super().__init__()
         self._mailboxes: Dict[tuple, PriorityQueue] = {}
         self._mlocks: Dict[tuple, threading.Lock] = {}
+
+    def pending_count(self) -> int:
+        return (sum(len(q) for q in self._queues.values())
+                + sum(len(q) for q in self._mailboxes.values()))
 
     def push(self, event: Event, worker_id: int, barrier: int) -> None:
         if event.dst_host is not event.src_host and event.time < barrier:

@@ -100,13 +100,10 @@ class PacketHopKernel:
         self.bootstrap_end = jnp.int64(bootstrap_end_ns)
         self.device_calls = 0
 
-    def step(self, src_rows: np.ndarray, dst_rows: np.ndarray,
-             uids: np.ndarray, send_times: np.ndarray,
-             barrier_ns: int) -> Tuple[np.ndarray, np.ndarray]:
+    def _padded_batch(self, src_rows, dst_rows, uids, send_times, b: int):
+        """Pad the round's arrays to bucket size b and split 64-bit uids
+        into the (lo, hi) u32 pair the threefry kernel consumes."""
         n = len(src_rows)
-        if n == 0:
-            return (np.empty(0, dtype=np.int64), np.empty(0, dtype=bool))
-        b = bucket_size(n)
 
         def pad(a, fill=0):
             out = np.full(b, fill, dtype=a.dtype)
@@ -116,14 +113,24 @@ class PacketHopKernel:
         uids = np.asarray(uids, dtype=np.uint64)
         valid = np.zeros(b, dtype=bool)
         valid[:n] = True
+        return (pad(np.asarray(src_rows, dtype=np.int32)),
+                pad(np.asarray(dst_rows, dtype=np.int32)),
+                pad((uids & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+                pad((uids >> np.uint64(32)).astype(np.uint32)),
+                pad(np.asarray(send_times, dtype=np.int64)),
+                valid)
+
+    def step(self, src_rows: np.ndarray, dst_rows: np.ndarray,
+             uids: np.ndarray, send_times: np.ndarray,
+             barrier_ns: int) -> Tuple[np.ndarray, np.ndarray]:
+        n = len(src_rows)
+        if n == 0:
+            return (np.empty(0, dtype=np.int64), np.empty(0, dtype=bool))
+        batch = self._padded_batch(src_rows, dst_rows, uids, send_times,
+                                   bucket_size(n))
         deliver, keep = packet_hop_step(
             self.latency, self.reliability,
-            jnp.asarray(pad(np.asarray(src_rows, dtype=np.int32))),
-            jnp.asarray(pad(np.asarray(dst_rows, dtype=np.int32))),
-            jnp.asarray(pad((uids & np.uint64(0xFFFFFFFF)).astype(np.uint32))),
-            jnp.asarray(pad((uids >> np.uint64(32)).astype(np.uint32))),
-            jnp.asarray(pad(np.asarray(send_times, dtype=np.int64))),
-            jnp.asarray(valid),
+            *(jnp.asarray(a) for a in batch),
             self.key_lo, self.key_hi, self.bootstrap_end,
             jnp.int64(barrier_ns))
         self.device_calls += 1
@@ -167,3 +174,141 @@ def make_sharded_hop_step(mesh, batch_axis: str = "pkt"):
         return deliver, keep, next_time
 
     return sharded_step
+
+
+def make_matrix_sharded_hop_step(mesh, axis: str = "pkt"):
+    """Row-sharded variant for graphs whose [A, A] path matrices exceed one
+    chip's HBM (SURVEY.md §7 stage 10): each device holds A/D rows of the
+    latency/reliability matrices; the packet batch is replicated; every
+    device gathers the entries whose src row it owns and a psum over the
+    mesh assembles the full result (one ICI collective per round, the
+    device-side analog of the scheduler's cross-thread barrier merge).
+
+    The mesh size must divide the row count; callers pad the matrices up to
+    a multiple first (ShardedPacketHopKernel does this when constructed
+    with shard_matrix=True — padded rows are never indexed because src rows
+    always reference real attached vertices).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def step(latency_ns, reliability, src_rows, dst_rows,
+             uid_lo, uid_hi, send_times, valid,
+             key_lo, key_hi, bootstrap_end, barrier):
+
+        def shard_body(lat_shard, rel_shard, src, dst):
+            rows_per = lat_shard.shape[0]
+            shard = jax.lax.axis_index(axis)
+            local = src - shard * rows_per
+            mine = (local >= 0) & (local < rows_per)
+            idx = jnp.clip(local, 0, rows_per - 1)
+            lat = jnp.where(mine, lat_shard[idx, dst], jnp.int64(0))
+            rel = jnp.where(mine, rel_shard[idx, dst], jnp.float32(0.0))
+            # each packet's row lives on exactly one shard -> psum assembles
+            return (jax.lax.psum(lat, axis), jax.lax.psum(rel, axis))
+
+        lat, rel = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None), P(), P()),
+            out_specs=(P(), P()))(latency_ns, reliability,
+                                  src_rows, dst_rows)
+        u = _uniform_from_uid(key_lo, key_hi, uid_lo, uid_hi)
+        bootstrapping = send_times < bootstrap_end
+        keep = (bootstrapping | (rel >= jnp.float32(1.0)) | (u <= rel)) & valid
+        deliver = jnp.maximum(send_times + lat, barrier)
+        return deliver, keep
+
+    return jax.jit(step)
+
+
+class ShardedPacketHopKernel(PacketHopKernel):
+    """Multi-device kernel: same .step API as PacketHopKernel, over a 1-D
+    device mesh (``--tpu-devices N``).
+
+    Two layouts:
+    * default — the padded batch is sharded over the mesh, path matrices
+      replicated on every chip (cheapest when the matrices fit in HBM);
+    * ``shard_matrix=True`` (``--tpu-shard-matrix``) — the matrices are
+      row-sharded across the mesh (each chip holds A/D rows, padded up to a
+      multiple of D) and the batch is replicated; per-packet entries are
+      assembled with a psum.  This is the HBM scale-out path for graphs
+      whose [A, A] tensors exceed one chip.
+    """
+
+    def __init__(self, topology, drop_key: int, bootstrap_end_ns: int,
+                 n_devices: int, shard_matrix: bool = False):
+        super().__init__(topology, drop_key, bootstrap_end_ns)
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        pool = jax.devices()
+        if len(pool) < n_devices:
+            # a TPU plugin may own the default slot with fewer chips than
+            # the virtual CPU mesh offers (tests; dryrun) — fall back
+            try:
+                cpu_pool = jax.devices("cpu")
+            except RuntimeError:
+                cpu_pool = []
+            if len(cpu_pool) >= n_devices:
+                pool = cpu_pool
+        devices = pool[:n_devices]
+        if len(devices) < n_devices:
+            raise RuntimeError(
+                f"--tpu-devices={n_devices} but only {len(devices)} present")
+        self.mesh = Mesh(np.array(devices), axis_names=("pkt",))
+        self.n_devices = n_devices
+        self.shard_matrix = shard_matrix
+        self._batch_sharding = NamedSharding(self.mesh, P("pkt"))
+        self._replicated = NamedSharding(self.mesh, P())
+        if shard_matrix:
+            lat = np.asarray(self.latency)
+            rel = np.asarray(self.reliability)
+            rows = lat.shape[0]
+            padded = -(-rows // n_devices) * n_devices
+            if padded != rows:
+                # padded rows are never indexed: src rows always reference
+                # real attached vertices
+                lat = np.pad(lat, ((0, padded - rows), (0, 0)))
+                rel = np.pad(rel, ((0, padded - rows), (0, 0)))
+            row_sharding = NamedSharding(self.mesh, P("pkt", None))
+            self.latency = jax.device_put(lat, row_sharding)
+            self.reliability = jax.device_put(rel, row_sharding)
+            self._step = make_matrix_sharded_hop_step(self.mesh, axis="pkt")
+            self._batch_placement = self._replicated
+        else:
+            self.latency = jax.device_put(self.latency, self._replicated)
+            self.reliability = jax.device_put(self.reliability,
+                                              self._replicated)
+            self._step = _make_batch_sharded_2out(self.mesh, "pkt")
+            self._batch_placement = self._batch_sharding
+
+    def step(self, src_rows, dst_rows, uids, send_times, barrier_ns):
+        n = len(src_rows)
+        if n == 0:
+            return (np.empty(0, dtype=np.int64), np.empty(0, dtype=bool))
+        # bucket must also be divisible by the mesh axis
+        b = max(bucket_size(n), self.n_devices * MIN_BUCKET)
+        if b % self.n_devices:
+            b = -(-b // self.n_devices) * self.n_devices
+        batch = self._padded_batch(src_rows, dst_rows, uids, send_times, b)
+        put = partial(jax.device_put, device=self._batch_placement)
+        deliver, keep = self._step(
+            self.latency, self.reliability,
+            *(put(a) for a in batch),
+            self.key_lo, self.key_hi, self.bootstrap_end,
+            jnp.int64(barrier_ns))
+        self.device_calls += 1
+        return (np.asarray(deliver)[:n], np.asarray(keep)[:n])
+
+
+def _make_batch_sharded_2out(mesh, axis: str):
+    """Batch-sharded step WITHOUT the global-min collective: the engine's
+    next-window time comes from the host-side event queues, so paying an
+    ICI reduction per round for an unused value would be waste.  (The
+    3-output variant with the reduction is make_sharded_hop_step, used
+    where the caller consumes next_time.)"""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    batch = NamedSharding(mesh, P(axis))
+    repl = NamedSharding(mesh, P())
+    return jax.jit(packet_hop_step,
+                   in_shardings=(repl, repl, batch, batch, batch, batch,
+                                 batch, batch, repl, repl, repl, repl),
+                   out_shardings=(batch, batch))

@@ -65,10 +65,20 @@ class TPUPolicy(HostQueuesPolicy):
     # -- round-boundary flush ---------------------------------------------
     def _ensure_kernel(self, engine):
         if self._kernel is None:
-            from ..ops.round_step import PacketHopKernel
+            from ..ops.round_step import (PacketHopKernel,
+                                          ShardedPacketHopKernel)
             topo = engine.topology
-            self._kernel = PacketHopKernel(
-                topo, engine._drop_key, engine.bootstrap_end)
+            n_dev = getattr(engine.options, "tpu_devices", 0)
+            if n_dev > 1:
+                # scale-out: the round batch is sharded across a 1-D mesh
+                # (ICI collectives combine the min-next-time reduction)
+                self._kernel = ShardedPacketHopKernel(
+                    topo, engine._drop_key, engine.bootstrap_end, n_dev,
+                    shard_matrix=getattr(engine.options,
+                                         "tpu_shard_matrix", False))
+            else:
+                self._kernel = PacketHopKernel(
+                    topo, engine._drop_key, engine.bootstrap_end)
             self._rows = topo  # row lookups go through topology
         return self._kernel
 
@@ -117,6 +127,9 @@ class TPUPolicy(HostQueuesPolicy):
             super().push(ev, 0, barrier)
             delivered += 1
         return delivered
+
+    def pending_count(self) -> int:
+        return super().pending_count() + len(self._pending)
 
     def next_time(self) -> int:
         # A non-empty batch means there are future deliveries not yet pushed;

@@ -651,7 +651,12 @@ def run_native_plugin(api, args: List[str], binary: str,
                         "protocol (statically linked? exec'd a helper?); "
                         "killing it")
             raise OSError("plugin not interposable")
+        # select only guarantees one readable byte: bound the header read
+        # too, so a child that writes a partial/garbage header then hangs
+        # fails cleanly instead of freezing the simulator
+        sim_side.settimeout(30.0)
         hdr = _read_exact(sim_side, REQ_HDR.size)
+        sim_side.settimeout(None)
         first = True
         while True:
             if not first:
