@@ -135,6 +135,35 @@ def bench_device_compute(topo, batch: int, rounds: int) -> float:
     return batch * rounds / dt
 
 
+def bench_full_sim_tor() -> dict:
+    """End-to-end simulation throughput on the Tor workload shape (the
+    headline BASELINE metric family): 200 relays + 100 clients, 120 virtual
+    seconds, serial CPU schedule.  Reports events/sec and sim-sec/wall-sec."""
+    from shadow_tpu.core import configuration
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.core.logger import SimLogger, set_logger
+    from shadow_tpu.core.options import Options
+    from shadow_tpu.tools import workloads
+
+    set_logger(SimLogger(level="warning"))
+    xml = workloads.tor_network(200, n_clients=100, n_servers=5,
+                                stoptime=120, stream_spec="512:51200")
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = 120
+    ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                              stop_time_sec=120), cfg)
+    t0 = time.perf_counter()
+    rc = ctrl.run()
+    wall = time.perf_counter() - t0
+    assert rc == 0
+    set_logger(SimLogger())
+    return {
+        "tor200_events_per_sec": round(ctrl.engine.events_executed / wall),
+        "tor200_sim_sec_per_wall_sec": round(120.0 / wall, 2),
+        "tor200_events": ctrl.engine.events_executed,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -142,6 +171,7 @@ def main() -> None:
     cpu_rate = bench_cpu_scalar(topo, 200_000)
     dev_rate = bench_device(topo, batch=1 << 20, iters=8)
     dev_compute = bench_device_compute(topo, batch=1 << 20, rounds=64)
+    full_sim = bench_full_sim_tor()
     out = {
         "metric": "packet_hop_throughput",
         "value": round(dev_rate / 1e6, 3),
@@ -152,6 +182,7 @@ def main() -> None:
         "device_compute_vs_baseline": round(dev_compute / cpu_rate, 1),
         "device": jax.devices()[0].platform,
         "attached_vertices": len(topo.attached_vertices),
+        **full_sim,
     }
     print(json.dumps(out))
 

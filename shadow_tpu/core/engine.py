@@ -156,6 +156,11 @@ class Engine:
     def run(self) -> int:
         """The slave_run equivalent.  Returns process-style exit code."""
         log = get_logger()
+        # per-packet delivery-status audit trails only when debugging
+        # (packet.c PDS_* flags are logged at debug level there too);
+        # sampled at run start so set_level() before run() is honored
+        from ..routing import packet as packet_mod
+        packet_mod.AUDIT_STATUSES = log.would_log("debug")
         self.sim_start_wall = _walltime.monotonic()
         self.schedule_boot()
         lookahead = self.lookahead_ns
@@ -172,6 +177,9 @@ class Engine:
         self._running = False
         # teardown: hosts (and their descriptors) are reclaimed here
         for host in self.hosts.values():
+            for iface in set(host.interfaces.values()):
+                if iface.pcap is not None:
+                    iface.pcap.close()
             self.counters.count_free("host")
         log.flush()
         leaks = self.counters.leaks()

@@ -40,6 +40,7 @@ from ..routing.packet import (TCP_ACK, TCP_FIN, TCP_RST, TCP_SYN, Packet,
 from .base import S_ACTIVE, S_CLOSED, S_READABLE, S_WRITABLE, Socket
 from .retransmit_tally import make_tally
 from .tcp_cong import make_congestion_control
+from ..core.worker import current_worker
 
 # states (tcp.c enum TCPState :42-47)
 CLOSED = "closed"
@@ -123,6 +124,7 @@ class TCPSocket(Socket):
         self.eof_received = False      # peer FIN consumed by reader
         self.fin_acked = False
         self.app_closed = False
+        self.write_shutdown = False    # shutdown(SHUT_WR) called
         self._persist_scheduled = False
         # --- autotuning (tcp.c:441-600) ---
         self.autotune_recv = host.params.autotune_recv
@@ -136,7 +138,6 @@ class TCPSocket(Socket):
     # helpers
     # ------------------------------------------------------------------
     def _now(self) -> int:
-        from ..core.worker import current_worker
         w = current_worker()
         return w.now if w is not None else 0
 
@@ -265,6 +266,9 @@ class TCPSocket(Socket):
     # user API: send / receive
     # ------------------------------------------------------------------
     def send_user_data(self, data: bytes, dst_ip: int = 0, dst_port: int = 0) -> int:
+        if self.write_shutdown:
+            # POSIX: writing after SHUT_WR is EPIPE, not ENOTCONN
+            raise OSError("EPIPE")
         if self.state not in (ESTABLISHED, CLOSE_WAIT):
             raise OSError("ENOTCONN" if self.error is None else self.error)
         space = self.send_buf_size - self.send_pending_bytes \
@@ -382,7 +386,6 @@ class TCPSocket(Socket):
         self.rto_expiry = now + self.rto_ns
         if self._rto_scheduled:
             return
-        from ..core.worker import current_worker
         w = current_worker()
         if w is None:
             return
@@ -407,7 +410,6 @@ class TCPSocket(Socket):
             return
         if now < self.rto_expiry:
             # a newer ACK pushed the deadline; re-sleep the difference
-            from ..core.worker import current_worker
             w = current_worker()
             if w is not None:
                 self._rto_scheduled = True
@@ -434,7 +436,6 @@ class TCPSocket(Socket):
     def _schedule_persist(self) -> None:
         if self._persist_scheduled:
             return
-        from ..core.worker import current_worker
         w = current_worker()
         if w is None:
             return
@@ -751,6 +752,35 @@ class TCPSocket(Socket):
     # ------------------------------------------------------------------
     # teardown
     # ------------------------------------------------------------------
+    def shutdown(self, how: int) -> None:
+        """shutdown(2): 0=SHUT_RD, 1=SHUT_WR, 2=SHUT_RDWR.
+
+        SHUT_WR sends FIN after pending data but the app keeps receiving
+        (the classic half-close: 'I'm done sending, finish your reply');
+        SHUT_RD discards buffered input and makes further reads return EOF.
+        The descriptor stays open either way — close() still owns teardown.
+        """
+        if how not in (0, 1, 2):
+            raise OSError("EINVAL")
+        if self.state in (CLOSED, LISTEN, SYN_SENT):
+            raise OSError("ENOTCONN")
+        if how in (1, 2) and not self.fin_pending and self.fin_seq is None:
+            if self.state in (ESTABLISHED, SYN_RECEIVED):
+                self.state = FIN_WAIT_1
+                self.fin_pending = True
+                self._flush()
+            elif self.state == CLOSE_WAIT:
+                self.state = LAST_ACK
+                self.fin_pending = True
+                self._flush()
+            self.write_shutdown = True
+            self.adjust_status(S_WRITABLE, False)
+        if how in (0, 2):
+            self.read_queue.clear()
+            self.read_bytes = 0
+            self.eof_received = True
+            self._update_readable()
+
     def close(self) -> None:
         """Application close: send FIN after pending data (half-close of
         our direction), keep the machinery alive until teardown."""
@@ -779,7 +809,6 @@ class TCPSocket(Socket):
     def _enter_time_wait(self) -> None:
         self.state = TIME_WAIT
         self._cancel_rto()
-        from ..core.worker import current_worker
         w = current_worker()
         if w is not None:
             w.schedule_task(Task(_time_wait_task, self, None,

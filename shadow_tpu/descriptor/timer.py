@@ -7,6 +7,7 @@ from __future__ import annotations
 
 from ..core.task import Task
 from .base import Descriptor, S_READABLE
+from ..core.worker import current_worker
 
 
 class Timer(Descriptor):
@@ -19,7 +20,6 @@ class Timer(Descriptor):
 
     def arm(self, initial_ns: int, interval_ns: int = 0) -> None:
         """timerfd_settime: initial_ns relative; 0 disarms."""
-        from ..core.worker import current_worker
         self._generation += 1
         self.interval_ns = interval_ns
         if initial_ns <= 0:
@@ -42,7 +42,6 @@ class Timer(Descriptor):
         self.expire_count += 1
         self.adjust_status(S_READABLE, True)
         if self.interval_ns > 0:
-            from ..core.worker import current_worker
             w = current_worker()
             if w is not None:
                 self.next_expire_time = w.now + self.interval_ns

@@ -21,6 +21,7 @@ from .cpu import CPU
 from .network_interface import NetworkInterface
 from .router import Router, make_queue
 from .tracker import Tracker
+from ..core.worker import current_worker
 
 MIN_EPHEMERAL_PORT = 10000
 MAX_PORT = 65535
@@ -111,7 +112,6 @@ class Host:
             self._schedule_heartbeat()
 
     def _schedule_heartbeat(self) -> None:
-        from ..core.worker import current_worker
         w = current_worker()
         if w is None:
             return
@@ -208,7 +208,6 @@ class Host:
 
 
 def _heartbeat_task(host: Host, _arg) -> None:
-    from ..core.worker import current_worker
     w = current_worker()
     host.tracker.heartbeat(w.now if w else 0)
     host._schedule_heartbeat()

@@ -31,6 +31,7 @@ from ..core import defs, stime
 from ..core.logger import get_logger
 from ..core.task import Task
 from ..routing.address import LOCALHOST_IP
+from ..core.worker import current_worker
 
 
 class TokenBucket:
@@ -133,7 +134,6 @@ class NetworkInterface:
     def _ensure_refill_scheduled(self) -> None:
         if self._refill_scheduled or self.is_loopback:
             return
-        from ..core.worker import current_worker
         w = current_worker()
         if w is None:
             return
@@ -167,7 +167,6 @@ class NetworkInterface:
     def receive_packets(self) -> None:
         """Drain arrivals while bandwidth tokens allow
         (network_interface.c:421-455).  Loopback is unthrottled."""
-        from ..core.worker import current_worker
         w = current_worker()
         now = w.now if w is not None else 0
         bootstrapping = w.is_bootstrapping() if w is not None else False
@@ -240,7 +239,6 @@ class NetworkInterface:
         return None
 
     def send_packets(self) -> None:
-        from ..core.worker import current_worker
         w = current_worker()
         if w is None:
             return

@@ -17,6 +17,7 @@ from collections import deque
 from typing import Optional
 
 from ..core import stime
+from ..core.worker import current_worker
 
 
 class QueueManager:
@@ -203,7 +204,6 @@ class Router:
         """Arrival from the internet core (router.c:104-122): AQM admit or
         drop, then nudge the interface to start receiving if this is the
         first buffered packet."""
-        from ..core.worker import current_worker
         w = current_worker()
         now = w.now if w is not None else 0
         was_empty = len(self.queue) == 0

@@ -70,12 +70,9 @@ class Tracker:
 
     def add_output_bytes(self, packet, iface_ip: int, retransmit: bool = False) -> None:
         c = self.out_local if iface_ip == LOCALHOST_IP else self.out_remote
-        # TCP marks retransmissions in the packet audit trail (the reference's
-        # split comes from packet delivery-status flags too, tracker.c:25-49)
-        if not retransmit and packet.statuses and \
-                "SND_TCP_ENQUEUE_RETRANSMIT" in packet.statuses:
-            retransmit = True
-        c.add(packet, retransmit)
+        # TCP marks retransmissions on the packet (the reference's split
+        # comes from packet delivery-status flags too, tracker.c:25-49)
+        c.add(packet, retransmit or packet.retransmit)
 
     def add_drop(self, packet) -> None:
         self.drops += 1

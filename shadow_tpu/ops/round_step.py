@@ -78,6 +78,15 @@ def packet_hop_step(latency_ns: jnp.ndarray,     # int64 [A, A]
     """
     lat = latency_ns[src_rows, dst_rows]
     rel = reliability[src_rows, dst_rows]
+    return _finish_hop(lat, rel, uid_lo, uid_hi, send_times, valid,
+                       key_lo, key_hi, bootstrap_end, barrier)
+
+
+def _finish_hop(lat, rel, uid_lo, uid_hi, send_times, valid,
+                key_lo, key_hi, bootstrap_end, barrier):
+    """Post-gather hop math — ONE definition so every kernel layout
+    (single-device, batch-sharded, matrix-sharded) encodes the identical
+    CPU/TPU determinism contract."""
     u = _uniform_from_uid(key_lo, key_hi, uid_lo, uid_hi)
     bootstrapping = send_times < bootstrap_end
     keep = (bootstrapping | (rel >= jnp.float32(1.0)) | (u <= rel)) & valid
@@ -212,11 +221,8 @@ def make_matrix_sharded_hop_step(mesh, axis: str = "pkt"):
             in_specs=(P(axis, None), P(axis, None), P(), P()),
             out_specs=(P(), P()))(latency_ns, reliability,
                                   src_rows, dst_rows)
-        u = _uniform_from_uid(key_lo, key_hi, uid_lo, uid_hi)
-        bootstrapping = send_times < bootstrap_end
-        keep = (bootstrapping | (rel >= jnp.float32(1.0)) | (u <= rel)) & valid
-        deliver = jnp.maximum(send_times + lat, barrier)
-        return deliver, keep
+        return _finish_hop(lat, rel, uid_lo, uid_hi, send_times, valid,
+                           key_lo, key_hi, bootstrap_end, barrier)
 
     return jax.jit(step)
 

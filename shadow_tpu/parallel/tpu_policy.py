@@ -69,6 +69,11 @@ class TPUPolicy(HostQueuesPolicy):
                                           ShardedPacketHopKernel)
             topo = engine.topology
             n_dev = getattr(engine.options, "tpu_devices", 0)
+            if n_dev == 0:
+                # 0 = all local devices (options.py); sharding only engages
+                # when that is actually more than one chip
+                import jax
+                n_dev = len(jax.devices())
             if n_dev > 1:
                 # scale-out: the round batch is sharded across a 1-D mesh
                 # (ICI collectives combine the min-next-time reduction)

@@ -568,6 +568,20 @@ class NativeKernel:
     }
 
 
+def _read_exact_raising(conn: real_socket.socket, n: int) -> Optional[bytes]:
+    """Like _read_exact but lets socket timeouts propagate (TimeoutError),
+    so a bounded read can distinguish 'child stalled' from 'child exited'."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = conn.recv(n - got)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
 def _read_exact(conn: real_socket.socket, n: int) -> Optional[bytes]:
     """Blocking read of exactly n bytes; None on EOF.
 
@@ -653,9 +667,15 @@ def run_native_plugin(api, args: List[str], binary: str,
             raise OSError("plugin not interposable")
         # select only guarantees one readable byte: bound the header read
         # too, so a child that writes a partial/garbage header then hangs
-        # fails cleanly instead of freezing the simulator
+        # fails loudly instead of freezing the simulator
         sim_side.settimeout(30.0)
-        hdr = _read_exact(sim_side, REQ_HDR.size)
+        try:
+            hdr = _read_exact_raising(sim_side, REQ_HDR.size)
+        except TimeoutError:
+            log.warning("native",
+                        f"{name}: {binary} sent a partial first header and "
+                        "stalled; killing it")
+            raise OSError("plugin handshake timeout")
         sim_side.settimeout(None)
         first = True
         while True:

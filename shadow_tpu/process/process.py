@@ -28,6 +28,7 @@ from ..core import stime
 from ..core.logger import get_logger
 from ..core.task import Task
 from ..descriptor.base import S_CLOSED, S_READABLE, S_WRITABLE
+from ..core.worker import current_worker
 
 RUNNABLE = "runnable"
 BLOCKED = "blocked"
@@ -189,7 +190,6 @@ class Process:
             self._dispatch(t, req)
 
     def _dispatch(self, t: GreenThread, req) -> None:
-        from ..core.worker import current_worker
         w = current_worker()
         if isinstance(req, _Sleep):
             t.state = BLOCKED
@@ -243,7 +243,6 @@ class Process:
         """Coalesced process_continue wakeup event."""
         if self._continue_scheduled or self.exited:
             return
-        from ..core.worker import current_worker
         w = current_worker()
         if w is None:
             self.continue_()
@@ -288,7 +287,6 @@ class SyscallAPI:
 
     # -- time (process.c time family -> worker_getEmulatedTime) -----------
     def now_ns(self) -> int:
-        from ..core.worker import current_worker
         w = current_worker()
         return w.now if w is not None else 0
 
@@ -438,6 +436,14 @@ class SyscallAPI:
         d = self.host.descriptor_table_get(fd)
         if d is not None:
             d.close()
+
+    def shutdown(self, fd: int, how: int = 1) -> None:
+        """shutdown(2) on a connected TCP socket (0=RD, 1=WR, 2=RDWR)."""
+        sock = self._sock(fd)
+        if hasattr(sock, "shutdown"):
+            sock.shutdown(how)
+        else:
+            raise OSError("ENOTSOCK")
 
     # -- TCP-specific (listen/accept/connect implemented with the TCP stack;
     # available once descriptor/tcp.py lands) ------------------------------

@@ -72,11 +72,18 @@ TCP_ACK = 1 << 3
 TCP_FIN = 1 << 4
 
 
+# Full per-packet delivery-status audit trails (the reference's PDS_* flags,
+# packet.c:59-60) cost real time at millions of packets; they are recorded
+# only when the log level includes debug.  The retransmit marker the Tracker
+# needs survives as a dedicated flag either way.
+AUDIT_STATUSES = False
+
+
 class Packet:
     """A simulated network packet."""
 
     __slots__ = ("uid", "header", "payload", "priority", "statuses",
-                 "header_size", "arrival_time")
+                 "header_size", "arrival_time", "total_size", "retransmit")
 
     _uid_counter = 0
 
@@ -87,8 +94,11 @@ class Packet:
         self.payload = payload or b""
         self.priority = priority        # FIFO qdisc tiebreak
         self.header_size = header_size
-        self.statuses: List[str] = ["CREATED"]
+        self.statuses: List[str] = ["CREATED"] if AUDIT_STATUSES else []
         self.arrival_time = -1
+        # bytes charged to token buckets; header and payload are immutable
+        self.total_size = header_size + len(self.payload)
+        self.retransmit = False
 
     # -- constructors ------------------------------------------------------
     @classmethod
@@ -111,6 +121,7 @@ class Packet:
         p = Packet(new_uid, _copy.copy(self.header), self.payload,
                    self.priority, self.header_size)
         p.statuses = list(self.statuses)
+        p.retransmit = self.retransmit
         return p
 
     # -- accessors ---------------------------------------------------------
@@ -134,16 +145,14 @@ class Packet:
     def payload_size(self) -> int:
         return len(self.payload)
 
-    @property
-    def total_size(self) -> int:
-        """Bytes charged to token buckets: header + payload."""
-        return self.header_size + len(self.payload)
-
     def is_tcp(self) -> bool:
         return isinstance(self.header, TCPHeader)
 
     def add_status(self, status: str) -> None:
-        self.statuses.append(status)
+        if status == "SND_TCP_ENQUEUE_RETRANSMIT":
+            self.retransmit = True
+        if AUDIT_STATUSES:
+            self.statuses.append(status)
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid
         kind = "tcp" if self.is_tcp() else "udp"

@@ -33,6 +33,7 @@
 #include <sys/random.h>
 #include <sys/select.h>
 #include <sys/socket.h>
+#include <sys/time.h>
 #include <time.h>
 #include <unistd.h>
 
@@ -396,6 +397,72 @@ static int cmd_hostname(const char *expected) {
   return 0;
 }
 
+
+/* -------------------------------------------------------- half-close ----- */
+/* sumserver: read until EOF, reply with the total byte count (u64), close.
+ * Pairs with halfclient to exercise shutdown(SHUT_WR). */
+static int cmd_sumserver(uint16_t port) {
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  if (lfd < 0) return 1;
+  struct sockaddr_in sin;
+  memset(&sin, 0, sizeof sin);
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = htonl(INADDR_ANY);
+  sin.sin_port = htons(port);
+  if (bind(lfd, (struct sockaddr *)&sin, sizeof sin) != 0) return 2;
+  if (listen(lfd, 4) != 0) return 3;
+  int fd = accept(lfd, NULL, NULL);
+  if (fd < 0) return 4;
+  char buf[65536];
+  uint64_t total = 0;
+  for (;;) {
+    ssize_t n = recv(fd, buf, sizeof buf, 0);
+    if (n < 0) return 5;
+    if (n == 0) break; /* client half-closed */
+    total += (uint64_t)n;
+  }
+  /* our direction is still open: send the tally back */
+  if (send(fd, &total, sizeof total, 0) != (ssize_t)sizeof total) return 6;
+  close(fd);
+  close(lfd);
+  printf("sumserver OK total=%llu\n", (unsigned long long)total);
+  return 0;
+}
+
+static int cmd_halfclient(const char *host, uint16_t port, int64_t nbytes) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return 1;
+  struct sockaddr_in dst;
+  if (resolve(host, port, &dst) != 0) return 2;
+  if (connect(fd, (struct sockaddr *)&dst, sizeof dst) != 0) return 3;
+  char buf[4096];
+  memset(buf, 'z', sizeof buf);
+  int64_t sent = 0;
+  while (sent < nbytes) {
+    size_t chunk = sizeof buf;
+    if ((int64_t)chunk > nbytes - sent) chunk = (size_t)(nbytes - sent);
+    ssize_t n = send(fd, buf, chunk, 0);
+    if (n <= 0) return 4;
+    sent += n;
+  }
+  if (shutdown(fd, SHUT_WR) != 0) return 5; /* half-close: FIN, keep reading */
+  uint64_t total = 0;
+  size_t got = 0;
+  while (got < sizeof total) {
+    ssize_t n = recv(fd, (char *)&total + got, sizeof total - got, 0);
+    if (n <= 0) return 6;
+    got += (size_t)n;
+  }
+  if ((int64_t)total != nbytes) {
+    fprintf(stderr, "halfclient: server counted %llu, sent %lld\n",
+            (unsigned long long)total, (long long)nbytes);
+    return 7;
+  }
+  close(fd);
+  printf("halfclient OK bytes=%lld\n", (long long)nbytes);
+  return 0;
+}
+
 int main(int argc, char **argv) {
   if (argc < 2) return 64;
   const char *cmd = argv[1];
@@ -415,6 +482,10 @@ int main(int argc, char **argv) {
     return cmd_pollclient(argv[2], (uint16_t)atoi(argv[3]));
   if (!strcmp(cmd, "selectclient") && argc >= 4)
     return cmd_selectclient(argv[2], (uint16_t)atoi(argv[3]));
+  if (!strcmp(cmd, "sumserver") && argc >= 3)
+    return cmd_sumserver((uint16_t)atoi(argv[2]));
+  if (!strcmp(cmd, "halfclient") && argc >= 5)
+    return cmd_halfclient(argv[2], (uint16_t)atoi(argv[3]), atoll(argv[4]));
   if (!strcmp(cmd, "randcheck")) return cmd_randcheck();
   if (!strcmp(cmd, "hostname") && argc >= 3) return cmd_hostname(argv[2]);
   (void)echo_once_connected;

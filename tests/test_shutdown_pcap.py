@@ -1,0 +1,191 @@
+"""TCP half-close (shutdown(2)) in both plugin planes, and pcap capture.
+
+Reference parity: shutdown is part of the process_emu_* surface
+(process.c), pcap via utility/pcap_writer.c + the network_interface
+capture hook (:337-373)."""
+
+import glob
+import os
+import struct
+import subprocess
+import textwrap
+
+import pytest
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+from shadow_tpu.apps.registry import register
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_sim(xml, stop=120, **opt_kw):
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = stop
+    opts = Options(scheduler_policy="global", workers=0, stop_time_sec=stop,
+                   **opt_kw)
+    ctrl = Controller(opts, cfg)
+    rc = ctrl.run()
+    return rc, ctrl
+
+
+# -- python-plane half-close apps -------------------------------------------
+
+@register("sum_server")
+def _sum_server(api, args):
+    port = int(args[0])
+    lfd = api.socket("tcp")
+    api.bind(lfd, ("0.0.0.0", port))
+    api.listen(lfd)
+    cfd, _ = yield from api.accept(lfd)
+    total = 0
+    while True:
+        data = yield from api.recv(cfd, 65536)
+        if not data:
+            break  # peer half-closed
+    # our direction is still open after their FIN
+        total += len(data)
+    yield from api.send(cfd, struct.pack(">Q", total))
+    api.close(cfd)
+    api.close(lfd)
+    api.process.app_state = total
+    return 0
+
+
+@register("half_client")
+def _half_client(api, args):
+    server, port, nbytes = args[0], int(args[1]), int(args[2])
+    fd = api.socket("tcp")
+    yield from api.connect(fd, (server, port))
+    sent = 0
+    while sent < nbytes:
+        n = min(8192, nbytes - sent)
+        yield from api.send(fd, b"z" * n)
+        sent += n
+    api.shutdown(fd, 1)  # SHUT_WR: FIN now, keep reading
+    reply = yield from api.recv_exact(fd, 8)
+    assert reply is not None, "no reply after half-close"
+    (total,) = struct.unpack(">Q", reply)
+    assert total == nbytes, f"server counted {total} != {nbytes}"
+    api.close(fd)
+    return 0
+
+
+HALF_XML = textwrap.dedent("""\
+    <shadow stoptime="120">
+      <plugin id="srv" path="python:sum_server" />
+      <plugin id="cli" path="python:half_client" />
+      <host id="server"><process plugin="srv" starttime="1" arguments="8000" /></host>
+      <host id="client"><process plugin="cli" starttime="2"
+            arguments="server 8000 50000" /></host>
+    </shadow>
+""")
+
+
+@register("epipe_client")
+def _epipe_client(api, args):
+    server, port = args[0], int(args[1])
+    fd = api.socket("tcp")
+    yield from api.connect(fd, (server, port))
+    api.shutdown(fd, 1)
+    try:
+        api.sendto(fd, b"after shutdown")
+        return 1  # write after SHUT_WR must fail
+    except OSError as e:
+        assert "EPIPE" in str(e), e
+    try:
+        api.shutdown(fd, 5)
+        return 2  # invalid how must fail
+    except OSError as e:
+        assert "EINVAL" in str(e), e
+    # reading direction still works after SHUT_WR: the server sees our
+    # instant EOF and replies with its 8-byte zero tally before closing
+    data = yield from api.recv(fd, 100)
+    assert data == struct.pack(">Q", 0), data
+    api.close(fd)
+    return 0
+
+
+def test_write_after_shutdown_is_epipe():
+    xml = textwrap.dedent("""\
+        <shadow stoptime="60">
+          <plugin id="srv" path="python:sum_server" />
+          <plugin id="cli" path="python:epipe_client" />
+          <host id="server"><process plugin="srv" starttime="1" arguments="8000" /></host>
+          <host id="client"><process plugin="cli" starttime="2"
+                arguments="server 8000" /></host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert ctrl.engine.host_by_name("client").processes[0].exit_code == 0
+
+
+def test_half_close_python_plane():
+    rc, ctrl = run_sim(HALF_XML)
+    assert rc == 0
+    client = ctrl.engine.host_by_name("client").processes[0]
+    server = ctrl.engine.host_by_name("server").processes[0]
+    assert client.exit_code == 0
+    assert server.exit_code == 0
+    assert server.app_state == 50000
+
+
+def test_half_close_native_plane(tmp_path):
+    subprocess.run(["make", "-C", os.path.join(REPO, "native")], check=True,
+                   capture_output=True)
+    binary = str(tmp_path / "testapp")
+    subprocess.run(["gcc", "-O1", "-o", binary,
+                    os.path.join(REPO, "tests", "native_src", "testapp.c")],
+                   check=True, capture_output=True)
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="120">
+          <plugin id="app" path="{binary}" />
+          <host id="server"><process plugin="app" starttime="1"
+                arguments="sumserver 8003" /></host>
+          <host id="client"><process plugin="app" starttime="2"
+                arguments="halfclient server 8003 60000" /></host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    for h in ("server", "client"):
+        assert ctrl.engine.host_by_name(h).processes[0].exit_code == 0
+
+
+# -- pcap --------------------------------------------------------------------
+
+PCAP_XML = textwrap.dedent("""\
+    <shadow stoptime="60">
+      <plugin id="echo" path="python:echo" />
+      <host id="server" logpcap="true" pcapdir="{d}">
+        <process plugin="echo" starttime="1" arguments="udp server 8000" />
+      </host>
+      <host id="client">
+        <process plugin="echo" starttime="2"
+                 arguments="udp client server 8000 4 256" />
+      </host>
+    </shadow>
+""")
+
+
+def test_pcap_capture(tmp_path):
+    d = str(tmp_path / "pcaps")
+    rc, ctrl = run_sim(PCAP_XML.format(d=d))
+    assert rc == 0
+    files = glob.glob(d + "/*.pcap")
+    assert files, "no pcap written"
+    blob = open(files[0], "rb").read()
+    magic, vmaj, vmin = struct.unpack("<IHH", blob[:8])
+    assert magic == 0xA1B2C3D4 and (vmaj, vmin) == (2, 4)
+    # walk the record chain: every record header must be self-consistent
+    off, records = 24, 0
+    while off < len(blob):
+        _, _, incl, orig = struct.unpack("<IIII", blob[off:off + 16])
+        assert incl <= orig and incl < 65536
+        off += 16 + incl
+        records += 1
+    assert off == len(blob)
+    # 4 datagrams each way through the server's eth interface
+    assert records >= 8
