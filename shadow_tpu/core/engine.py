@@ -57,6 +57,13 @@ class Engine:
         self.sim_start_wall: float = 0.0
         self.rounds_executed = 0
         self.events_executed = 0
+        # per-round perf introspection (reference logs per-thread barrier
+        # waits + Dijkstra timings, scheduler.c:266-268 / topology.c:1785-88;
+        # ours splits each round into host-execute vs flush/device wall time)
+        self.host_exec_ns = 0
+        self.flush_ns = 0
+        self._last_heartbeat_wall = 0.0
+        self.heartbeat_wall_interval = 5.0
         self._checkpointer = None
         if getattr(options, "checkpoint_interval_sec", 0) > 0:
             from .checkpoint import CheckpointWriter
@@ -85,6 +92,9 @@ class Engine:
             from ..host.network_interface import TokenBucket
             eth.send_bucket = TokenBucket(host.params.bw_up_kibps)
             eth.receive_bucket = TokenBucket(host.params.bw_down_kibps)
+        # cache the topology matrix row so the hot path never does the
+        # ip->row dict lookup per packet (rows are fixed at attach time)
+        host.topo_row = self.topology.row_for_ip(addr.ip)
         self.hosts[host.id] = host
         self.hosts_by_ip[addr.ip] = host
         self.hosts_by_name[host.name] = host
@@ -163,6 +173,16 @@ class Engine:
         packet_mod.AUDIT_STATUSES = log.would_log("debug")
         self.sim_start_wall = _walltime.monotonic()
         self.schedule_boot()
+        # The hot loop allocates millions of short-lived Events/Packets that
+        # die by refcount; cyclic GC passes over them are pure overhead (the
+        # few true cycles — e.g. TCP parent/child links — are reclaimed by
+        # the final collect).  Mirrors the reference's G_SLICE tuning intent.
+        import gc
+        gc_was_enabled = gc.isenabled()
+        if gc_was_enabled:
+            gc.collect()
+            gc.freeze()
+            gc.disable()
         lookahead = self.lookahead_ns
         log.message("engine",
                     f"starting simulation: {len(self.hosts)} hosts, "
@@ -170,10 +190,16 @@ class Engine:
                     f"workers={self.options.workers}, "
                     f"lookahead={lookahead / 1e6:.3f} ms, "
                     f"end={self.end_time / 1e9:.1f} s")
-        if self.options.workers == 0:
-            self._run_serial(lookahead)
-        else:
-            self._run_threaded(lookahead)
+        try:
+            if self.options.workers == 0:
+                self._run_serial(lookahead)
+            else:
+                self._run_threaded(lookahead)
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+                gc.unfreeze()
+                gc.collect()
         self._running = False
         # teardown: hosts (and their descriptors) are reclaimed here
         for host in self.hosts.values():
@@ -186,7 +212,9 @@ class Engine:
         log.message("engine",
                     f"simulation finished: {self.rounds_executed} rounds, "
                     f"{self.events_executed} events, "
-                    f"{_walltime.monotonic() - self.sim_start_wall:.3f}s wall")
+                    f"{_walltime.monotonic() - self.sim_start_wall:.3f}s wall "
+                    f"(host_exec {self.host_exec_ns / 1e9:.3f}s, "
+                    f"flush {self.flush_ns / 1e9:.3f}s)")
         if leaks:
             log.message("engine", self.counters.report())
         log.flush()
@@ -212,15 +240,46 @@ class Engine:
         self.scheduler.window_end = min(nxt + lookahead, self.end_time)
         return True
 
+    def _heartbeat(self) -> None:
+        """Periodic (wall-clock-gated) engine heartbeat with the per-round
+        host-vs-device split the perf hunt steers by."""
+        now_wall = _walltime.monotonic()
+        if now_wall - self._last_heartbeat_wall < self.heartbeat_wall_interval:
+            return
+        self._last_heartbeat_wall = now_wall
+        policy = self.scheduler.policy
+        extra = ""
+        kern = getattr(policy, "_kernel", None)
+        if kern is not None:
+            extra = (f" device_ms={policy.device_ns / 1e6:.1f}"
+                     f" flush_host_ms={policy.host_flush_ns / 1e6:.1f}"
+                     f" last_batch={policy.last_batch}"
+                     f" device_calls={kern.device_calls}"
+                     f" recompiles={len(kern.buckets_seen)}")
+        get_logger().message(
+            "engine",
+            f"[engine-heartbeat] rounds={self.rounds_executed}"
+            f" simtime={self.scheduler.window_start / 1e9:.3f}s"
+            f" wall={now_wall - self.sim_start_wall:.1f}s"
+            f" host_exec_ms={self.host_exec_ns / 1e6:.1f}"
+            f" flush_ms={self.flush_ns / 1e6:.1f}{extra}",
+            sim_time=self.scheduler.window_start)
+
     def _run_serial(self, lookahead: int) -> None:
         worker = Worker(0, self)
         set_current_worker(worker)
+        perf = _walltime.perf_counter_ns
         try:
             while self._advance_window(lookahead):
                 worker.round_end = self.scheduler.window_end
+                t0 = perf()
                 worker.run_round()
+                t1 = perf()
                 self._flush_round()
+                self.flush_ns += perf() - t1
+                self.host_exec_ns += t1 - t0
                 self.rounds_executed += 1
+                self._heartbeat()
                 get_logger().flush()
             self.events_executed = worker.counters._free.get("event", 0)
         finally:
@@ -256,16 +315,22 @@ class Engine:
                                     name=f"worker-{w.id}") for w in workers]
         for t in threads:
             t.start()
+        perf = _walltime.perf_counter_ns
         try:
             while self._advance_window(lookahead):
+                t0 = perf()
                 start_latch.count_down_await()
                 start_latch.reset()
                 done_latch.count_down_await()
                 done_latch.reset()
+                t1 = perf()
                 if errors:
                     raise errors[0]
                 self._flush_round()
+                self.flush_ns += perf() - t1
+                self.host_exec_ns += t1 - t0
                 self.rounds_executed += 1
+                self._heartbeat()
                 get_logger().flush()
         finally:
             stop_flag["stop"] = True

@@ -23,10 +23,10 @@ class Event:
 
     def __init__(self, task, time: int, dst_host, src_host, sequence: int):
         self.task = task
-        self.time = int(time)
+        self.time = time
         self.dst_host = dst_host      # Host object (owns execution context)
         self.src_host = src_host      # Host that scheduled it
-        self.sequence = int(sequence)  # per-src-host monotonic event id
+        self.sequence = sequence      # per-src-host monotonic event id
 
     def order_key(self) -> Tuple[int, int, int, int]:
         return (self.time,
@@ -54,11 +54,12 @@ class Event:
                     # re-inserting with the same (src,seq) identity.
                     worker.reschedule_event(self, self.time + delay)
                     return False
-            worker.set_active_host(host)
+            host.now = self.time
+            worker.active_host = host
             try:
                 self.task.execute()
             finally:
-                worker.set_active_host(None)
+                worker.active_host = None
         else:
             self.task.execute()
         return True

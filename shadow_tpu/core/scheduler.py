@@ -25,6 +25,7 @@ clamp never actually fires for packet events, it is a safety net.
 
 from __future__ import annotations
 
+import heapq
 import threading
 from typing import Dict, List, Optional
 
@@ -74,6 +75,9 @@ class GlobalSinglePolicy(SchedulerPolicy):
         self.queue: PriorityQueue = PriorityQueue()
         self.hosts: List = []
         self._lock = threading.Lock()
+        # set by Scheduler when n_workers == 0: the whole simulation runs on
+        # one thread, so the queue lock is pure overhead on the hottest path
+        self.serial = False
 
     def add_host(self, host, worker_id: int) -> None:
         self.hosts.append(host)
@@ -84,12 +88,21 @@ class GlobalSinglePolicy(SchedulerPolicy):
     def push(self, event: Event, worker_id: int, barrier: int) -> None:
         if event.dst_host is not event.src_host and event.time < barrier:
             event.time = barrier
+        if self.serial:
+            self.queue.push(event)
+            return
         with self._lock:
             self.queue.push(event)
 
     def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
         if worker_id != 0:
             return None
+        if self.serial:
+            q = self.queue
+            key = q.peek_key()
+            if key is None or key[0] >= window_end:
+                return None
+            return q.pop()
         with self._lock:
             key = self.queue.peek_key()
             if key is None or key[0] >= window_end:
@@ -108,7 +121,19 @@ class GlobalSinglePolicy(SchedulerPolicy):
 class HostQueuesPolicy(SchedulerPolicy):
     """Per-host locked queues with fixed host->worker assignment — the
     ``host`` policy (scheduler_policy_host_single.c); base for ``steal`` and
-    ``tpu``."""
+    ``tpu``.
+
+    Indexed ready tracking: each worker keeps a lazy min-heap of
+    ``(order_key, host_id)`` entries so pop() is O(log hosts) instead of the
+    O(hosts) scan the naive layout needs (the reference keeps explicit
+    unprocessed/processed host lists for the same reason,
+    scheduler_policy_host_steal.c:28-45).  The published-key map records the
+    earliest entry each host currently has in ANY heap; a push that lowers a
+    queue's minimum publishes a fresh (earlier) entry, so the invariant is:
+    every non-empty host queue has an entry with key <= its actual top.
+    Entries are validated against the queue top when popped; stale ones are
+    discarded (and the live top re-published), which keeps the index exact
+    without ever rebuilding it."""
 
     def __init__(self):
         self._host_queues: Dict[int, PriorityQueue] = {}
@@ -122,6 +147,15 @@ class HostQueuesPolicy(SchedulerPolicy):
         # unprocessed/processed host lists + ordered dual-locking,
         # scheduler_policy_host_steal.c:366-416).
         self._exec_locks: Dict[int, threading.Lock] = {}
+        # ready-host index: worker -> heap of (key, hid), plus the earliest
+        # published key per host; one lock guards the whole index (pushes
+        # already serialize on host locks, and index ops are tiny)
+        self._ready_heaps: Dict[int, List] = {}
+        self._ready_lock = threading.Lock()
+        self._published: Dict[int, tuple] = {}       # hid -> earliest entry key
+        # set by Scheduler when n_workers == 0: single-threaded, so host
+        # locks, exec locks and the ready-index lock are pure overhead
+        self.serial = False
 
     def pending_count(self) -> int:
         return sum(len(q) for q in self._host_queues.values())
@@ -137,8 +171,22 @@ class HostQueuesPolicy(SchedulerPolicy):
                     q = self._host_queues[hid] = PriorityQueue()
         return q
 
+    def _publish(self, wid: int, key, hid: int) -> None:
+        """Publish 'host hid has pending work, earliest = key' to worker
+        wid's ready heap unless an entry at least as early already exists."""
+        with self._ready_lock:
+            cur = self._published.get(hid)
+            if cur is None or key < cur:
+                self._published[hid] = key
+                heap = self._ready_heaps.get(wid)
+                if heap is None:
+                    heap = self._ready_heaps[wid] = []
+                heapq.heappush(heap, (key, hid))
+
     def add_host(self, host, worker_id: int) -> None:
         self._queue_for_host(host.id)
+        with self._ready_lock:
+            self._ready_heaps.setdefault(worker_id, [])
         self._assignment.setdefault(worker_id, []).append(host)
         self._host_worker[host.id] = worker_id
 
@@ -150,53 +198,107 @@ class HostQueuesPolicy(SchedulerPolicy):
             event.time = barrier
         hid = event.dst_host.id if event.dst_host is not None else -1
         q = self._queue_for_host(hid)
+        if self.serial:
+            q.push(event)
+            top = q.peek_key()
+            cur = self._published.get(hid)
+            if cur is None or top < cur:
+                self._published[hid] = top
+                heap = self._ready_heaps.get(0)
+                if heap is None:
+                    heap = self._ready_heaps[0] = []
+                heapq.heappush(heap, (top, hid))
+            return
         with self._host_locks[hid]:
             q.push(event)
+            top = q.peek_key()
+        self._publish(self._host_worker.get(hid, 0), top, hid)
+
+    def _pop_from_heap(self, heap_wid: int, window_end: int) -> Optional[Event]:
+        """Pop the earliest runnable event reachable through worker
+        ``heap_wid``'s ready heap.  Busy hosts (exec lock held elsewhere)
+        are set aside and re-published before returning."""
+        heap = self._ready_heaps.get(heap_wid)
+        if heap is None:
+            return None
+        busy: List = []
+        result = None
+        while True:
+            with self._ready_lock:
+                if not heap or heap[0][0][0] >= window_end:
+                    break
+                key, hid = heapq.heappop(heap)
+                if self._published.get(hid) == key:
+                    del self._published[hid]
+            q = self._host_queues[hid]
+            exec_lock = self._exec_locks[hid]
+            if not exec_lock.acquire(blocking=False):
+                # mid-event on another thread; retry it later
+                busy.append((key, hid))
+                continue
+            with self._host_locks[hid]:
+                actual = q.peek_key()
+                if actual is None:
+                    exec_lock.release()
+                    continue          # stale entry; queue drained
+                if actual[0] >= window_end:
+                    exec_lock.release()
+                    # live again next round
+                    self._publish(self._host_worker.get(hid, heap_wid),
+                                  actual, hid)
+                    continue
+                result = q.pop()
+                nxt = q.peek_key()
+            if nxt is not None:
+                self._publish(self._host_worker.get(hid, heap_wid), nxt, hid)
+            break
+        for key, hid in busy:
+            self._publish(self._host_worker.get(hid, heap_wid), key, hid)
+        return result
+
+    def _pop_serial(self, window_end: int) -> Optional[Event]:
+        """Single-threaded pop: same index algorithm, no locks."""
+        heap = self._ready_heaps.get(0)
+        if not heap:
+            return None
+        published = self._published
+        queues = self._host_queues
+        while heap:
+            key, hid = heap[0]
+            if key[0] >= window_end:
+                return None
+            heapq.heappop(heap)
+            if published.get(hid) == key:
+                del published[hid]
+            q = queues[hid]
+            actual = q.peek_key()
+            if actual is None:
+                continue
+            if actual[0] >= window_end:
+                cur = published.get(hid)
+                if cur is None or actual < cur:
+                    published[hid] = actual
+                    heapq.heappush(heap, (actual, hid))
+                continue
+            ev = q.pop()
+            nxt = q.peek_key()
+            if nxt is not None:
+                cur = published.get(hid)
+                if cur is None or nxt < cur:
+                    published[hid] = nxt
+                    heapq.heappush(heap, (nxt, hid))
+            return ev
+        return None
 
     def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
-        # pop the earliest event among this worker's hosts, honoring the
-        # global order key so same-window events execute deterministically
-        # per host (cross-host order within a window is free, as in the
-        # reference — causality is guaranteed by the lookahead window).
-        excluded: set = set()
-        while True:
-            best = None
-            best_key = None
-            for host in list(self._assignment.get(worker_id, [])):
-                if host.id in excluded:
-                    continue
-                q = self._host_queues[host.id]
-                with self._host_locks[host.id]:
-                    key = q.peek_key()
-                if key is not None and key[0] < window_end:
-                    if best_key is None or key < best_key:
-                        best, best_key = host, key
-            # also drain the detached (-1) queue from worker 0
-            if worker_id == 0 and -1 in self._host_queues:
-                with self._host_locks[-1]:
-                    key = self._host_queues[-1].peek_key()
-                    if key is not None and key[0] < window_end and (
-                            best_key is None or key < best_key):
-                        return self._host_queues[-1].pop()
-            if best is None:
-                return None
-            exec_lock = self._exec_locks[best.id]
-            if not exec_lock.acquire(blocking=False):
-                # another thread is mid-event on this host (stealing race);
-                # look at the remaining hosts instead
-                excluded.add(best.id)
-                continue
-            with self._host_locks[best.id]:
-                # re-check under the queue lock: a thief may have drained it
-                key = self._host_queues[best.id].peek_key()
-                if key is None or key[0] >= window_end:
-                    exec_lock.release()
-                    excluded.add(best.id)
-                    continue
-                return self._host_queues[best.id].pop()
+        if self.serial:
+            return self._pop_serial(window_end)
+        return self._pop_from_heap(worker_id, window_end)
 
     def done(self, event: Event, worker_id: int) -> None:
         """Release the host execution lock taken by pop()."""
+        if self.serial:
+            return
         hid = event.dst_host.id if event.dst_host is not None else -1
         lk = self._exec_locks.get(hid)
         if lk is not None and lk.locked():
@@ -206,66 +308,73 @@ class HostQueuesPolicy(SchedulerPolicy):
                 pass
 
     def next_time(self) -> int:
+        """Min pending event time.  Called at quiescent round boundaries
+        (workers parked).  Stale entries surfacing at a heap top are dropped
+        and the queue's live top re-published until the top entry is exact;
+        entries <= the published invariant make the first exact top the true
+        global minimum for that heap."""
         t = stime.SIM_TIME_MAX
-        for hid, q in self._host_queues.items():
-            with self._host_locks[hid]:
-                key = q.peek_key()
-            if key is not None:
-                t = min(t, key[0])
+        for wid, heap in self._ready_heaps.items():
+            while heap:
+                key, hid = heap[0]
+                actual = self._host_queues[hid].peek_key()
+                if actual == key:
+                    if key[0] < t:
+                        t = key[0]
+                    break
+                heapq.heappop(heap)
+                if self._published.get(hid) == key:
+                    del self._published[hid]
+                if actual is not None:
+                    cur = self._published.get(hid)
+                    if cur is None or actual < cur:
+                        self._published[hid] = actual
+                        heapq.heappush(heap, (actual, hid))
         return t
 
 
 class HostStealPolicy(HostQueuesPolicy):
     """Work stealing on top of per-host queues
-    (scheduler_policy_host_steal.c): when a worker's own hosts are drained
-    for this window, it scans other workers' hosts and migrates one with
-    runnable events (host_migrate :172-196).  Migration only moves queue
-    ownership; host state follows because the thief executes the host's
-    events after the migration point."""
+    (scheduler_policy_host_steal.c): when a worker's own ready heap is
+    drained for this window, it pops directly from other workers' heaps
+    (earliest-first) and migrates the host it took (host_migrate :172-196),
+    so future pushes for that host land on this worker.  Exclusive execution
+    is enforced by the per-host exec locks in the base pop, so a racy
+    migration can never run one host on two threads."""
 
     def __init__(self):
         super().__init__()
         self._steal_lock = threading.Lock()
 
+    def _migrate(self, hid: int, to_worker: int) -> None:
+        with self._steal_lock:
+            victim = self._host_worker.get(hid)
+            if victim is None or victim == to_worker:
+                return
+            for host in self._assignment.get(victim, []):
+                if host.id == hid:
+                    self._assignment[victim].remove(host)
+                    self._assignment.setdefault(to_worker, []).append(host)
+                    break
+            self._host_worker[hid] = to_worker
+
     def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
-        ev = super().pop(worker_id, window_end)
+        ev = self._pop_from_heap(worker_id, window_end)
         if ev is not None:
             return ev
-        # steal: find a host with runnable work that nobody is mid-event on
-        # and take it over.  Exclusive execution is enforced by the per-host
-        # exec locks in the base pop(), so even a racy migration here cannot
-        # run one host on two threads; the busy check just avoids migrating
-        # hosts that are actively being drained.  The O(hosts) victim scan
-        # runs lock-free on list snapshots; only the migration itself takes
-        # the steal lock, so concurrent idle workers scan in parallel.
-        while True:
-            candidate = victim = None
-            for victim_worker, hosts in list(self._assignment.items()):
-                if victim_worker == worker_id:
-                    continue
-                for host in list(hosts):
-                    if self._exec_locks[host.id].locked():
-                        continue
-                    q = self._host_queues[host.id]
-                    with self._host_locks[host.id]:
-                        key = q.peek_key()
-                    if key is not None and key[0] < window_end:
-                        candidate, victim = host, victim_worker
-                        break
-                if candidate is not None:
-                    break
-            if candidate is None:
-                return None
-            with self._steal_lock:
-                hosts = self._assignment.get(victim, [])
-                if candidate in hosts:  # still the victim's: migrate it
-                    hosts.remove(candidate)
-                    self._assignment.setdefault(worker_id, []).append(candidate)
-                    self._host_worker[candidate.id] = worker_id
-            ev = super().pop(worker_id, window_end)
+        # steal from the victim whose earliest entry is oldest; snapshot the
+        # heap tops under the index lock (concurrent pops mutate the heaps)
+        with self._ready_lock:
+            tops = [(heap[0], wid)
+                    for wid, heap in self._ready_heaps.items()
+                    if wid != worker_id and heap]
+        for _top, wid in sorted(tops):
+            ev = self._pop_from_heap(wid, window_end)
             if ev is not None:
+                hid = ev.dst_host.id if ev.dst_host is not None else -1
+                self._migrate(hid, worker_id)
                 return ev
-            # raced with another thief or the queue drained; rescan
+        return None
 
 
 class ThreadSinglePolicy(SchedulerPolicy):
@@ -415,6 +524,9 @@ class Scheduler:
             policy_name = "global"
             self.policy_name = "global"
         self.policy = make_policy(policy_name)
+        if self.n_workers == 0 and isinstance(
+                self.policy, (GlobalSinglePolicy, HostQueuesPolicy)):
+            self.policy.serial = True
         self.seed_key = seed_key
         self.window_start = 0
         self.window_end = 1

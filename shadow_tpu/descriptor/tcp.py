@@ -24,8 +24,14 @@ Capability parity with the reference's tcp.c (2520 LoC; SURVEY.md §2.5):
 
 Design deltas from the reference (deliberate, simulation-idiomatic):
 sequence numbers are unbounded Python ints (no u32 wraparound handling
-needed); ACKs are sent immediately (no delayed-ACK timer); the initial
-sequence number is 0 for reproducible traces.
+needed); the initial sequence number is 0 for reproducible traces.
+
+Delayed ACKs follow the reference exactly (tcp.c:2047-2088): a pure ACK in
+response to in-order data is coalesced behind a per-socket timer — 1 ms for
+the first 1000 "quick ACKs" of a connection, 5 ms after — so all packets
+received within the window produce ONE ACK.  DUPACKs and any packet that
+already carries an ACK flag (data, FIN) are sent immediately and clear the
+pending delayed-ACK counter (tcp.c:1106-1107).
 """
 
 from __future__ import annotations
@@ -126,6 +132,10 @@ class TCPSocket(Socket):
         self.app_closed = False
         self.write_shutdown = False    # shutdown(SHUT_WR) called
         self._persist_scheduled = False
+        # --- delayed ACK (tcp.c:2047-2088) ---
+        self._delack_scheduled = False
+        self._delack_counter = 0
+        self._quick_acks = 0
         # --- autotuning (tcp.c:441-600) ---
         self.autotune_recv = host.params.autotune_recv
         self.autotune_send = host.params.autotune_send
@@ -138,8 +148,9 @@ class TCPSocket(Socket):
     # helpers
     # ------------------------------------------------------------------
     def _now(self) -> int:
-        w = current_worker()
-        return w.now if w is not None else 0
+        # the executing worker mirrors the clock onto the host (event.py);
+        # one attribute read instead of a thread-local lookup
+        return self.host.now
 
     def _engine_options(self):
         eng = self.host.engine
@@ -170,17 +181,23 @@ class TCPSocket(Socket):
     def _emit(self, flags: int, seq: int, payload: bytes = b"",
               echo_ts: Optional[int] = None, track: bool = True) -> None:
         """Create one packet and hand it to the interface qdisc."""
-        now = self._now()
+        now = self.host.now
+        adv_window = self._adv_window()
         header = TCPHeader(self.bound_ip, self.bound_port,
                            self.peer_ip, self.peer_port,
-                           flags=flags, sequence=seq,
-                           acknowledgment=self.rcv_nxt if flags & TCP_ACK else 0,
-                           window=self._adv_window(),
-                           sel_acks=self._sack_blocks() if flags & TCP_ACK else [],
-                           timestamp=now,
-                           timestamp_echo=echo_ts if echo_ts is not None else 0)
+                           flags, seq,
+                           self.rcv_nxt if flags & TCP_ACK else 0,
+                           adv_window,
+                           self._sack_blocks() if (self.reorder
+                                                   and flags & TCP_ACK) else None,
+                           now,
+                           echo_ts if echo_ts is not None else 0)
         pkt = Packet.new_tcp(self.host.next_packet_uid(),
                              self.host.next_packet_priority(), header, payload)
+        if flags & TCP_ACK:
+            # this packet carries a current ACK; any pending delayed ACK is
+            # now redundant (tcp.c:1106-1107)
+            self._delack_counter = 0
         consumes = len(payload) + (1 if flags & (TCP_SYN | TCP_FIN) else 0)
         if track and consumes:
             seg = _Segment(seq, seq + consumes, payload, flags, now)
@@ -217,6 +234,38 @@ class TCPSocket(Socket):
 
     def _send_ack(self, echo_ts: Optional[int] = None) -> None:
         self._emit(TCP_ACK, self.snd_nxt, b"", echo_ts=echo_ts, track=False)
+
+    def _schedule_delayed_ack(self) -> None:
+        """Coalesce pure ACKs for in-order data behind a short timer
+        (tcp.c:2066-2091): quick ACKs (1 ms) early in the connection to keep
+        the peer's send rate growing, 5 ms after.  One timer per socket; the
+        counter is cleared whenever any ACK-carrying packet goes out."""
+        self._delack_counter += 1
+        if self._delack_scheduled:
+            return
+        w = current_worker()
+        if w is None:
+            self._delack_counter = 0
+            self._send_ack()
+            return
+        if self._quick_acks < 1000:
+            self._quick_acks += 1
+            delay = stime.SIM_TIME_MS
+        else:
+            delay = 5 * stime.SIM_TIME_MS
+        self._delack_scheduled = True
+        if w.schedule_task(Task(_delayed_ack_task, self, None,
+                                name="tcp_delack"),
+                           delay, dst_host=self.host) is None:
+            # scheduling declined (engine stopping / past end time): leave
+            # the timer unarmed so a later segment can try again
+            self._delack_scheduled = False
+
+    def _on_delayed_ack_fire(self) -> None:
+        self._delack_scheduled = False
+        if self._delack_counter > 0 and not self.closed \
+                and self.state != CLOSED:
+            self._send_ack()   # _emit clears the counter
 
     # ------------------------------------------------------------------
     # user API: connect / listen / accept
@@ -318,18 +367,42 @@ class TCPSocket(Socket):
             self.tally.clear_lost()
             for b, e in lost:
                 self._retransmit_range(b, e)
-        # 2. new data within min(cwnd, peer window)
-        while self.send_pending and self._send_capacity() > 0:
-            cap = self._send_capacity()
-            chunk = self.send_pending[0]
-            n = min(len(chunk), MSS, cap)
+        # 2. new data within min(cwnd, peer window).  The send buffer is a
+        # byte STREAM: small app writes coalesce into full-MSS segments,
+        # exactly like the reference segmentizing its buffered user bytes
+        # (tcp.c:1121-1278) — a 512 B-per-write app still fills 1460 B
+        # packets here.
+        pending = self.send_pending
+        while pending:
+            n = min(MSS, self._send_capacity())
             if n == 0:
                 break
-            if n == len(chunk):
-                self.send_pending.popleft()
+            chunk = pending[0]
+            clen = len(chunk)
+            if clen == n:
+                payload = chunk
+                pending.popleft()
+            elif clen > n:
+                payload = chunk[:n]
+                pending[0] = chunk[n:]
             else:
-                self.send_pending[0] = chunk[n:]
-            payload = bytes(chunk[:n])
+                # gather several queued writes into one segment
+                parts = [chunk]
+                pending.popleft()
+                size = clen
+                while pending and size < n:
+                    chunk = pending[0]
+                    take = n - size
+                    if len(chunk) <= take:
+                        parts.append(chunk)
+                        pending.popleft()
+                        size += len(chunk)
+                    else:
+                        parts.append(chunk[:take])
+                        pending[0] = chunk[take:]
+                        size += take
+                payload = b"".join(parts)
+                n = size
             self.send_pending_bytes -= n
             self._emit(TCP_ACK, self.snd_nxt, payload)
             self.snd_nxt += n
@@ -716,7 +789,13 @@ class TCPSocket(Socket):
             if fin_seq == self.rcv_nxt:
                 self.rcv_nxt = fin_seq + 1
                 self._on_fin_received()
-        self._send_ack(echo_ts=h.timestamp)
+        if fin:
+            # FIN ACKs go out now so the close sequence completes promptly
+            # (the reference always sends FIN-related control immediately)
+            self._send_ack(echo_ts=h.timestamp)
+        else:
+            # in-order new data: the pure ACK can be delayed (tcp.c:2047-2051)
+            self._schedule_delayed_ack()
         if size > 0:
             self._rtt_bytes_in += size
             self._update_readable()
@@ -869,6 +948,10 @@ def _rto_fire_task(sock: TCPSocket, generation: int) -> None:
 
 def _persist_fire_task(sock: TCPSocket, _arg) -> None:
     sock._on_persist_fire()
+
+
+def _delayed_ack_task(sock: TCPSocket, _arg) -> None:
+    sock._on_delayed_ack_fire()
 
 
 def _time_wait_task(sock: TCPSocket, _arg) -> None:

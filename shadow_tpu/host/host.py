@@ -86,6 +86,12 @@ class Host:
         self._packet_priority = 0
         self._process_id_counter = 1000
         self.engine = None  # set on registration
+        # virtual clock mirror: the executing worker stamps the event time
+        # here so host-side code (TCP, router) reads the clock with one
+        # attribute access instead of a thread-local lookup
+        self.now = 0
+        # topology matrix row, cached by Engine.add_host at attach time
+        self.topo_row: int = 0
 
     # -- setup (host_setup :162-220) --------------------------------------
     def setup(self, engine, eth_address: Address) -> None:

@@ -70,7 +70,9 @@ class NetworkInterface:
         # (protocol, port, peer_ip, peer_port) -> socket; wildcard peer = (0,0)
         self._bindings: Dict[Tuple[str, int, int, int], object] = {}
         # sockets with queued outbound packets, FIFO arrival order for RR
+        # (deque preserves order; the set makes the membership test O(1))
         self._ready_senders: deque = deque()
+        self._ready_set: set = set()
         self._refill_scheduled = False
         # local delivery buffer for loopback/self-directed packets
         self._arrivals: deque = deque()
@@ -209,7 +211,8 @@ class NetworkInterface:
     # -- send path ---------------------------------------------------------
     def wants_send(self, socket) -> None:
         """A socket has queued outbound data (network_interface.c:581)."""
-        if socket not in self._ready_senders:
+        if socket not in self._ready_set:
+            self._ready_set.add(socket)
             self._ready_senders.append(socket)
         self.send_packets()
         if self._has_pending_work():
@@ -223,6 +226,7 @@ class NetworkInterface:
                 s = self._ready_senders[0]
                 if s.peek_out_packet() is None:
                     self._ready_senders.popleft()
+                    self._ready_set.discard(s)
                     continue
                 return s
             best, best_prio = None, None
@@ -234,6 +238,7 @@ class NetworkInterface:
                     best, best_prio = s, p.priority
             if best is None:
                 self._ready_senders.clear()
+                self._ready_set.clear()
                 return None
             return best
         return None

@@ -204,8 +204,12 @@ class Router:
         """Arrival from the internet core (router.c:104-122): AQM admit or
         drop, then nudge the interface to start receiving if this is the
         first buffered packet."""
-        w = current_worker()
-        now = w.now if w is not None else 0
+        iface = self.interface
+        if iface is not None:
+            now = iface.host.now
+        else:
+            w = current_worker()
+            now = w.now if w is not None else 0
         was_empty = len(self.queue) == 0
         admitted = self.queue.enqueue(packet, now)
         if not admitted:

@@ -99,15 +99,52 @@ class PacketHopKernel:
     drop key; turns a round's (src_row, dst_row, uid, send_time) arrays into
     (deliver_time, keep) numpy arrays with one device call."""
 
-    def __init__(self, topology, drop_key: int, bootstrap_end_ns: int):
+    # Below this batch size the per-call dispatch + host<->device transfer
+    # costs more than the hop math itself; the kernel then computes the
+    # round with the bitwise-identical vectorized numpy path instead
+    # (uniform_np and the jnp threefry are the same cipher — asserted by
+    # tests/test_rng.py — so results are indistinguishable).
+    DEVICE_THRESHOLD = 4096
+
+    def __init__(self, topology, drop_key: int, bootstrap_end_ns: int,
+                 device_threshold: Optional[int] = None):
         lat, rel = topology.device_tensors()
         self.latency = lat
         self.reliability = rel
+        # host-side copies for the small-batch path
+        self.latency_np = np.asarray(topology.latency_ns)
+        self.reliability_np = np.asarray(topology.reliability,
+                                         dtype=np.float32)
         kv = int(drop_key) & 0xFFFFFFFFFFFFFFFF
+        self.drop_key = kv
         self.key_lo = jnp.uint32(kv & 0xFFFFFFFF)
         self.key_hi = jnp.uint32((kv >> 32) & 0xFFFFFFFF)
         self.bootstrap_end = jnp.int64(bootstrap_end_ns)
+        self.bootstrap_end_ns = int(bootstrap_end_ns)
         self.device_calls = 0
+        self.host_calls = 0
+        if device_threshold is not None:
+            self.DEVICE_THRESHOLD = device_threshold
+        # distinct padded batch shapes seen = XLA recompile count (the
+        # engine heartbeat reports this; SURVEY.md §7 hard part d)
+        self.buckets_seen: set = set()
+
+    def _step_numpy(self, src_rows, dst_rows, uids, send_times,
+                    barrier_ns: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorized host path for small rounds — same math, same cipher,
+        same f32 comparison as the device kernel, so the decision per packet
+        is identical bit for bit."""
+        from ..core.rng import uniform_np
+        lat = self.latency_np[src_rows, dst_rows]
+        rel = self.reliability_np[src_rows, dst_rows]
+        u = uniform_np(self.drop_key, uids.astype(np.uint64))
+        send_times = send_times.astype(np.int64, copy=False)
+        keep = ((send_times < self.bootstrap_end_ns)
+                | (rel >= np.float32(1.0))
+                | (u.astype(np.float32) <= rel))
+        deliver = np.maximum(send_times + lat, np.int64(barrier_ns))
+        self.host_calls += 1
+        return deliver, keep
 
     def _padded_batch(self, src_rows, dst_rows, uids, send_times, b: int):
         """Pad the round's arrays to bucket size b and split 64-bit uids
@@ -135,8 +172,13 @@ class PacketHopKernel:
         n = len(src_rows)
         if n == 0:
             return (np.empty(0, dtype=np.int64), np.empty(0, dtype=bool))
-        batch = self._padded_batch(src_rows, dst_rows, uids, send_times,
-                                   bucket_size(n))
+        if n < self.DEVICE_THRESHOLD:
+            return self._step_numpy(np.asarray(src_rows), np.asarray(dst_rows),
+                                    np.asarray(uids), np.asarray(send_times),
+                                    barrier_ns)
+        b = bucket_size(n)
+        self.buckets_seen.add(b)
+        batch = self._padded_batch(src_rows, dst_rows, uids, send_times, b)
         deliver, keep = packet_hop_step(
             self.latency, self.reliability,
             *(jnp.asarray(a) for a in batch),
@@ -294,6 +336,7 @@ class ShardedPacketHopKernel(PacketHopKernel):
         b = max(bucket_size(n), self.n_devices * MIN_BUCKET)
         if b % self.n_devices:
             b = -(-b // self.n_devices) * self.n_devices
+        self.buckets_seen.add(b)
         batch = self._padded_batch(src_rows, dst_rows, uids, send_times, b)
         put = partial(jax.device_put, device=self._batch_placement)
         deliver, keep = self._step(

@@ -12,6 +12,13 @@ intra-round causality violation, the same argument the reference's
 host-steal policy uses for its cross-host barrier clamp
 (scheduler_policy_host_steal.c:229-242).
 
+The batch is structure-of-arrays from the moment of capture: offer_packet
+appends into parallel columns (row indices come from the per-host cached
+topology row, so there is no per-packet dict lookup), and flush_round turns
+them into numpy arrays with one bulk conversion each before the device step.
+Survivor delivery events are then pushed with the per-host queue locks taken
+once per destination host, not once per packet.
+
 Parity: drops are keyed by packet uid through the same threefry cipher the
 CPU policies use, so a simulation under ``tpu`` delivers/drops exactly the
 same packets at exactly the same times as under ``global``/``steal``
@@ -21,6 +28,7 @@ same packets at exactly the same times as under ``global``/``steal``
 from __future__ import annotations
 
 import threading
+import time as _walltime
 from typing import List, Optional, Tuple
 
 import numpy as np
@@ -35,12 +43,22 @@ class TPUPolicy(HostQueuesPolicy):
     def __init__(self):
         super().__init__()
         self._batch_lock = threading.Lock()
-        # pending hop: (packet, src_host, dst_host, seq, send_time)
-        self._pending: List[Tuple] = []
+        # SoA pending batch (parallel columns, one row per offered packet)
+        self._p_pkts: List = []
+        self._p_src_hosts: List = []
+        self._p_dst_hosts: List = []
+        self._p_seqs: List[int] = []
+        self._p_src_rows: List[int] = []
+        self._p_dst_rows: List[int] = []
+        self._p_uids: List[int] = []
+        self._p_times: List[int] = []
         self._kernel = None
-        self._rows_by_ip = {}
         self.packets_batched = 0
         self.packets_dropped = 0
+        # per-round introspection (read by the engine heartbeat)
+        self.last_batch = 0
+        self.device_ns = 0          # cumulative wall ns inside kernel.step
+        self.host_flush_ns = 0      # cumulative wall ns in flush outside step
 
     # -- worker-facing batching -------------------------------------------
     def offer_packet(self, packet, worker) -> bool:
@@ -57,8 +75,15 @@ class TPUPolicy(HostQueuesPolicy):
         seq_owner = src_host if src_host is not None else dst_host
         seq = seq_owner.next_event_sequence()
         with self._batch_lock:
-            self._pending.append(
-                (packet, src_host, dst_host, seq, worker.now))
+            self._p_pkts.append(packet)
+            self._p_src_hosts.append(src_host)
+            self._p_dst_hosts.append(dst_host)
+            self._p_seqs.append(seq)
+            self._p_src_rows.append(src_host.topo_row if src_host is not None
+                                    else dst_host.topo_row)
+            self._p_dst_rows.append(dst_host.topo_row)
+            self._p_uids.append(packet.uid)
+            self._p_times.append(worker.now)
         self.packets_batched += 1
         return True
 
@@ -84,61 +109,83 @@ class TPUPolicy(HostQueuesPolicy):
             else:
                 self._kernel = PacketHopKernel(
                     topo, engine._drop_key, engine.bootstrap_end)
-            self._rows = topo  # row lookups go through topology
         return self._kernel
 
     def flush_round(self, engine) -> int:
         """Run the device step for the round's batch and push the surviving
         delivery events.  Called by the engine once per round, after workers
         drain and before the next window is computed."""
+        t0 = _walltime.perf_counter_ns()
         with self._batch_lock:
-            pending, self._pending = self._pending, []
-        if not pending:
-            return 0
+            n = len(self._p_pkts)
+            if n == 0:
+                self.last_batch = 0
+                return 0
+            pkts = self._p_pkts;      self._p_pkts = []
+            src_hosts = self._p_src_hosts;  self._p_src_hosts = []
+            dst_hosts = self._p_dst_hosts;  self._p_dst_hosts = []
+            seqs = self._p_seqs;      self._p_seqs = []
+            src_rows = self._p_src_rows;    self._p_src_rows = []
+            dst_rows = self._p_dst_rows;    self._p_dst_rows = []
+            uids = self._p_uids;      self._p_uids = []
+            times = self._p_times;    self._p_times = []
+        self.last_batch = n
         kernel = self._ensure_kernel(engine)
         topo = engine.topology
-        n = len(pending)
-        src_rows = np.empty(n, dtype=np.int32)
-        dst_rows = np.empty(n, dtype=np.int32)
-        uids = np.empty(n, dtype=np.uint64)
-        send_times = np.empty(n, dtype=np.int64)
-        for i, (pkt, _s, _d, _q, t) in enumerate(pending):
-            src_rows[i] = topo.row_for_ip(pkt.src_ip)
-            dst_rows[i] = topo.row_for_ip(pkt.dst_ip)
-            uids[i] = pkt.uid
-            send_times[i] = t
+
+        src_arr = np.array(src_rows, dtype=np.int32)
+        dst_arr = np.array(dst_rows, dtype=np.int32)
+        uid_arr = np.array(uids, dtype=np.uint64)
+        time_arr = np.array(times, dtype=np.int64)
 
         barrier = engine.scheduler.window_end
-        deliver, keep = kernel.step(src_rows, dst_rows, uids, send_times, barrier)
+        t1 = _walltime.perf_counter_ns()
+        deliver, keep = kernel.step(src_arr, dst_arr, uid_arr, time_arr,
+                                    barrier)
+        t2 = _walltime.perf_counter_ns()
+
+        # per-path packet accounting for the kept lanes, vectorized
+        # (the CPU latency lookup path counts per call)
+        np.add.at(topo.path_packet_counts, (src_arr[keep], dst_arr[keep]),
+                  1)
+        deliver_list = deliver.tolist()
+        keep_list = keep.tolist()
 
         delivered = 0
+        dropped = 0
         end_time = engine.end_time
-        for i, (pkt, src_host, dst_host, seq, _t) in enumerate(pending):
-            if not keep[i]:
+        count_drop = engine.count_packet_drop
+        push = super().push
+        counters = engine.counters
+        for i in range(n):
+            pkt = pkts[i]
+            if not keep_list[i]:
                 pkt.add_status("INET_DROPPED")
-                engine.count_packet_drop(pkt)
-                self.packets_dropped += 1
+                count_drop(pkt)
+                dropped += 1
                 continue
-            # per-path packet accounting, as the CPU latency lookup does
-            topo.path_packet_counts[src_rows[i], dst_rows[i]] += 1
-            t = int(deliver[i])
+            t = deliver_list[i]
             if t >= end_time:
                 continue
             pkt.add_status("INET_SENT")
-            task = Task(_deliver_packet_task, dst_host, pkt,
+            task = Task(_deliver_packet_task, dst_hosts[i], pkt,
                         name="deliver_packet")
-            ev = Event(task, t, dst_host, src_host, seq)
-            engine.counters.count_new("event")
-            super().push(ev, 0, barrier)
+            ev = Event(task, t, dst_hosts[i], src_hosts[i], seqs[i])
+            push(ev, 0, barrier)
             delivered += 1
+        counters.count_new("event", delivered)
+        self.packets_dropped += dropped
+        t3 = _walltime.perf_counter_ns()
+        self.device_ns += t2 - t1
+        self.host_flush_ns += (t1 - t0) + (t3 - t2)
         return delivered
 
     def pending_count(self) -> int:
-        return super().pending_count() + len(self._pending)
+        return super().pending_count() + len(self._p_pkts)
 
     def next_time(self) -> int:
         # A non-empty batch means there are future deliveries not yet pushed;
         # flush_round always runs before next_time in the engine loop, so the
         # base implementation is correct — assert the contract in debug runs.
-        assert not self._pending, "flush_round must run before next_time"
+        assert not self._p_pkts, "flush_round must run before next_time"
         return super().next_time()

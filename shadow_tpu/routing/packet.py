@@ -83,7 +83,8 @@ class Packet:
     """A simulated network packet."""
 
     __slots__ = ("uid", "header", "payload", "priority", "statuses",
-                 "header_size", "arrival_time", "total_size", "retransmit")
+                 "header_size", "arrival_time", "total_size", "retransmit",
+                 "src_ip", "dst_ip", "src_port", "dst_port", "payload_size")
 
     _uid_counter = 0
 
@@ -96,9 +97,16 @@ class Packet:
         self.header_size = header_size
         self.statuses: List[str] = ["CREATED"] if AUDIT_STATUSES else []
         self.arrival_time = -1
-        # bytes charged to token buckets; header and payload are immutable
-        self.total_size = header_size + len(self.payload)
+        # bytes charged to token buckets; header and payload are immutable,
+        # so sizes and addresses are flattened to plain attributes (these are
+        # the hottest reads in the whole pipeline)
+        self.payload_size = len(self.payload)
+        self.total_size = header_size + self.payload_size
         self.retransmit = False
+        self.src_ip = header.src_ip
+        self.dst_ip = header.dst_ip
+        self.src_port = header.src_port
+        self.dst_port = header.dst_port
 
     # -- constructors ------------------------------------------------------
     @classmethod
@@ -125,26 +133,6 @@ class Packet:
         return p
 
     # -- accessors ---------------------------------------------------------
-    @property
-    def src_ip(self):
-        return self.header.src_ip
-
-    @property
-    def dst_ip(self):
-        return self.header.dst_ip
-
-    @property
-    def src_port(self):
-        return self.header.src_port
-
-    @property
-    def dst_port(self):
-        return self.header.dst_port
-
-    @property
-    def payload_size(self) -> int:
-        return len(self.payload)
-
     def is_tcp(self) -> bool:
         return isinstance(self.header, TCPHeader)
 

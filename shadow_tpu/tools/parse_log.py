@@ -105,8 +105,16 @@ def strip_log(lines: Iterable[str]) -> Iterable[str]:
     (nondeterministic), keep (sim time, level, domain, text) — the exact
     transformation of the reference's strip_log_for_compare.py."""
     for rec in iter_records(lines):
+        text = rec["text"]
+        # engine heartbeats are wall-clock-gated (fire after N wall seconds):
+        # both their presence and their content are nondeterministic, exactly
+        # like the reference's getrusage heartbeats its strip tool drops
+        if text.startswith("[engine-heartbeat]"):
+            continue
         # wall-clock durations inside message text are nondeterministic too
-        text = re.sub(r"[\d.]+s wall", "<wall>s wall", rec["text"])
+        text = re.sub(r"[\d.]+s wall", "<wall>s wall", text)
+        text = re.sub(r"\(host_exec [\d.]+s, flush [\d.]+s\)",
+                      "(host_exec <s>, flush <s>)", text)
         yield f"{rec['sim']} [{rec['level']}] [{rec['domain']}] {text}"
 
 
