@@ -23,6 +23,7 @@
 
 #define _GNU_SOURCE 1
 #include "protocol.h"
+#include "shim_threads.h"
 
 #include <dlfcn.h>
 #include <errno.h>
@@ -63,6 +64,10 @@ static pthread_mutex_t g_lock = PTHREAD_MUTEX_INITIALIZER;
  * shadow-fd vs OS-fd split, host.c shadowToOSHandleMap). */
 static unsigned char g_sim_fd[SHADOW_TPU_SIM_FD_MAX];
 static int64_t g_appfd_handle[SHADOW_TPU_SIM_FD_MAX];
+/* local mirror of each sim fd's O_NONBLOCK (authoritative copy lives
+ * simulator-side; the mirror decides whether EAGAIN goes to the app or
+ * parks the green thread) */
+static unsigned char g_fd_nonblock[SHADOW_TPU_SIM_FD_MAX];
 
 /* real libc entry points (dlsym RTLD_NEXT, like interposer.c SETSYM_OR_FAIL) */
 #define REAL(name) real_##name
@@ -289,6 +294,20 @@ static int64_t transact0(uint32_t op, int64_t a, int64_t b, int64_t c,
   return transact(op, a, b, c, d, NULL, 0, NULL, 0, NULL);
 }
 
+/* ----------------------- exports for shim_threads.cc / shim_misc.cc ------ */
+
+extern "C" int64_t shd_transact(uint32_t op, int64_t a, int64_t b, int64_t c,
+                                int64_t d, const void *payload,
+                                uint32_t payload_len, void *resp_buf,
+                                uint32_t resp_cap, uint32_t *resp_len) {
+  return transact(op, a, b, c, d, payload, payload_len, resp_buf, resp_cap,
+                  resp_len);
+}
+
+extern "C" int64_t shd_vtime_ns(void) { return g_vtime_ns; }
+extern "C" int64_t shd_epoch_ns(void) { return g_epoch_ns; }
+extern "C" int shd_active(void) { return g_active; }
+
 /* --------------------------------------------------------------- helpers -- */
 
 static int sockaddr_to_ip_port(const struct sockaddr *addr, socklen_t len,
@@ -355,11 +374,22 @@ extern "C" time_t time(time_t *out) {
   return t;
 }
 
+/* virtual sleep: direct OP_SLEEP single-threaded; park when other green
+ * threads could run meanwhile */
+static int shd_sleep_ns(int64_t ns) {
+  if (ns <= 0) return 0;
+  if (gt_should_park()) {
+    gt_park_sleep(g_vtime_ns + ns);
+    return 0;
+  }
+  return transact0(SHD_OP_SLEEP, ns, 0, 0, 0) < 0 ? -1 : 0;
+}
+
 extern "C" int nanosleep(const struct timespec *req, struct timespec *rem) {
   if (!g_active) return REAL(nanosleep)(req, rem);
   if (!req) { errno = EFAULT; return -1; }
   int64_t ns = (int64_t)req->tv_sec * 1000000000LL + req->tv_nsec;
-  if (transact0(SHD_OP_SLEEP, ns, 0, 0, 0) < 0) return -1;
+  if (shd_sleep_ns(ns) < 0) return -1;
   if (rem) { rem->tv_sec = 0; rem->tv_nsec = 0; }
   return 0;
 }
@@ -375,20 +405,20 @@ extern "C" int clock_nanosleep(clockid_t clk, int flags,
                   ((clk == CLOCK_REALTIME) ? g_epoch_ns : 0);
     ns = ns > now ? ns - now : 0;
   }
-  if (transact0(SHD_OP_SLEEP, ns, 0, 0, 0) < 0) return errno;
+  if (shd_sleep_ns(ns) < 0) return errno;
   if (rem) { rem->tv_sec = 0; rem->tv_nsec = 0; }
   return 0;
 }
 
 extern "C" unsigned int sleep(unsigned int seconds) {
   if (!g_active) return REAL(sleep)(seconds);
-  transact0(SHD_OP_SLEEP, (int64_t)seconds * 1000000000LL, 0, 0, 0);
+  shd_sleep_ns((int64_t)seconds * 1000000000LL);
   return 0;
 }
 
 extern "C" int usleep(useconds_t usec) {
   if (!g_active) return REAL(usleep)(usec);
-  return transact0(SHD_OP_SLEEP, (int64_t)usec * 1000LL, 0, 0, 0) < 0 ? -1 : 0;
+  return shd_sleep_ns((int64_t)usec * 1000LL);
 }
 
 /* -------------------------------------------------------------- sockets -- */
@@ -403,8 +433,10 @@ extern "C" int socket(int domain, int type, int protocol) {
   if (h < 0) return -1;
   int fd = to_appfd(h);
   mark_sim_fd(fd, 1);
-  if (type & SOCK_NONBLOCK)
+  if (type & SOCK_NONBLOCK) {
     transact0(SHD_OP_FCNTL, h, F_SETFL, O_NONBLOCK, 0);
+    g_fd_nonblock[fd] = 1;
+  }
   return fd;
 }
 
@@ -426,23 +458,35 @@ extern "C" int listen(int fd, int backlog) {
 static int do_accept(int fd, struct sockaddr *addr, socklen_t *alen,
                      int flags) {
   unsigned char buf[8];
-  uint32_t got = 0;
-  int64_t h = transact(SHD_OP_ACCEPT, to_handle(fd),
-                       (flags & SOCK_NONBLOCK) ? 1 : 0, 0, 0, NULL, 0, buf,
-                       sizeof buf, &got);
-  if (h < 0) return -1;
-  int newfd = to_appfd(h);
-  mark_sim_fd(newfd, 1);
-  if (got >= 6) {
-    uint32_t ip;
-    uint16_t port;
-    memcpy(&ip, buf, 4);
-    memcpy(&port, buf + 4, 2);
-    fill_sockaddr(addr, alen, ip, port);
+  int app_nb = (flags & SOCK_NONBLOCK) || g_fd_nonblock[fd];
+  int64_t h;
+  for (;;) {
+    uint32_t got = 0;
+    int park = gt_should_park() && !app_nb;
+    h = transact(SHD_OP_ACCEPT, to_handle(fd), (app_nb || park) ? 1 : 0, 0,
+                 0, NULL, 0, buf, sizeof buf, &got);
+    if (h < 0) {
+      if (park && errno == EAGAIN) {
+        gt_park_fd(to_handle(fd), POLLIN);
+        continue;
+      }
+      return -1;
+    }
+    int newfd = to_appfd(h);
+    mark_sim_fd(newfd, 1);
+    if (got >= 6) {
+      uint32_t ip;
+      uint16_t port;
+      memcpy(&ip, buf, 4);
+      memcpy(&port, buf + 4, 2);
+      fill_sockaddr(addr, alen, ip, port);
+    }
+    if (flags & SOCK_NONBLOCK) {
+      transact0(SHD_OP_FCNTL, h, F_SETFL, O_NONBLOCK, 0);
+      g_fd_nonblock[newfd] = 1;
+    }
+    return newfd;
   }
-  if (flags & SOCK_NONBLOCK)
-    transact0(SHD_OP_FCNTL, h, F_SETFL, O_NONBLOCK, 0);
-  return newfd;
 }
 
 extern "C" int accept(int fd, struct sockaddr *addr, socklen_t *alen) {
@@ -463,45 +507,94 @@ extern "C" int connect(int fd, const struct sockaddr *addr, socklen_t len) {
     errno = EINVAL;
     return -1;
   }
-  return transact0(SHD_OP_CONNECT, to_handle(fd), ip, port, 0) < 0 ? -1 : 0;
+  int park = gt_should_park() && !g_fd_nonblock[fd];
+  int64_t r = transact0(SHD_OP_CONNECT, to_handle(fd), ip, port,
+                        park ? 1 : 0);
+  if (r >= 0) return 0;
+  if (!(park && errno == EINPROGRESS)) return -1;
+  /* other green threads may run while the handshake completes */
+  gt_park_fd(to_handle(fd), POLLOUT);
+  int32_t soerr = 0;
+  uint32_t got = 0;
+  if (transact(SHD_OP_GETSOCKOPT, to_handle(fd), SOL_SOCKET, SO_ERROR, 0,
+               NULL, 0, &soerr, sizeof soerr, &got) < 0)
+    return -1;
+  if (soerr != 0) {
+    errno = soerr;
+    return -1;
+  }
+  return 0;
 }
 
 extern "C" ssize_t send(int fd, const void *buf, size_t n, int flags) {
   if (!is_sim_fd(fd)) return REAL(send)(fd, buf, n, flags);
   if (n > SHD_MAX_PAYLOAD) n = SHD_MAX_PAYLOAD;
-  return (ssize_t)transact(SHD_OP_SEND, to_handle(fd), nb_flag(flags), 0, 0,
-                           buf, (uint32_t)n, NULL, 0, NULL);
+  int app_nb = nb_flag(flags) || g_fd_nonblock[fd];
+  size_t total = 0;
+  for (;;) {
+    int park = gt_should_park() && !app_nb;
+    int64_t r = transact(SHD_OP_SEND, to_handle(fd), (app_nb || park) ? 1 : 0,
+                         0, 0, (const char *)buf + total,
+                         (uint32_t)(n - total), NULL, 0, NULL);
+    if (r < 0) {
+      if (park && errno == EAGAIN) {
+        gt_park_fd(to_handle(fd), POLLOUT);
+        continue;
+      }
+      return total ? (ssize_t)total : -1;
+    }
+    total += (size_t)r;
+    if (app_nb || total >= n) return (ssize_t)total;
+    if (!park) return (ssize_t)total;   /* sim's blocking path sent it all */
+    gt_park_fd(to_handle(fd), POLLOUT);
+  }
 }
 
 extern "C" ssize_t sendto(int fd, const void *buf, size_t n, int flags,
                           const struct sockaddr *addr, socklen_t alen) {
   if (!is_sim_fd(fd)) return REAL(sendto)(fd, buf, n, flags, addr, alen);
   if (n > SHD_MAX_PAYLOAD) n = SHD_MAX_PAYLOAD;
-  if (!addr)
-    return (ssize_t)transact(SHD_OP_SEND, to_handle(fd), nb_flag(flags), 0, 0,
-                             buf, (uint32_t)n, NULL, 0, NULL);
+  if (!addr) return send(fd, buf, n, flags);
   uint32_t ip; uint16_t port;
   if (sockaddr_to_ip_port(addr, alen, &ip, &port) != 0) {
     errno = EINVAL;
     return -1;
   }
-  return (ssize_t)transact(SHD_OP_SENDTO, to_handle(fd), nb_flag(flags), ip,
-                           port, buf, (uint32_t)n, NULL, 0, NULL);
+  int app_nb = nb_flag(flags) || g_fd_nonblock[fd];
+  for (;;) {
+    int park = gt_should_park() && !app_nb;
+    int64_t r = transact(SHD_OP_SENDTO, to_handle(fd),
+                         (app_nb || park) ? 1 : 0, ip, port, buf, (uint32_t)n,
+                         NULL, 0, NULL);
+    if (r < 0 && park && errno == EAGAIN) {
+      gt_park_fd(to_handle(fd), POLLOUT);
+      continue;
+    }
+    return (ssize_t)r;
+  }
 }
 
 extern "C" ssize_t recv(int fd, void *buf, size_t n, int flags) {
   if (!is_sim_fd(fd)) return REAL(recv)(fd, buf, n, flags);
+  int app_nb = nb_flag(flags) || g_fd_nonblock[fd];
   size_t total = 0;
-  do {
+  for (;;) {
     uint32_t got = 0;
+    int park = gt_should_park() && !app_nb;
     int64_t r = transact(SHD_OP_RECV, to_handle(fd), (int64_t)(n - total),
-                         nb_flag(flags), 0, NULL, 0, (char *)buf + total,
-                         (uint32_t)(n - total), &got);
-    if (r < 0) return total ? (ssize_t)total : -1;
+                         (app_nb || park) ? 1 : 0, 0, NULL, 0,
+                         (char *)buf + total, (uint32_t)(n - total), &got);
+    if (r < 0) {
+      if (park && errno == EAGAIN) {
+        gt_park_fd(to_handle(fd), POLLIN);
+        continue;
+      }
+      return total ? (ssize_t)total : -1;
+    }
     if (got == 0) return (ssize_t)total; /* EOF */
     total += got;
-  } while ((flags & MSG_WAITALL) && total < n);
-  return (ssize_t)total;
+    if (!((flags & MSG_WAITALL) && total < n)) return (ssize_t)total;
+  }
 }
 
 extern "C" ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
@@ -512,9 +605,20 @@ extern "C" ssize_t recvfrom(int fd, void *buf, size_t n, int flags,
   size_t cap = (n > SHD_MAX_PAYLOAD ? SHD_MAX_PAYLOAD : n) + 6;
   unsigned char *tmp = (unsigned char *)malloc(cap);
   if (!tmp) { errno = ENOMEM; return -1; }
+  int app_nb = nb_flag(flags) || g_fd_nonblock[fd];
   uint32_t got = 0;
-  int64_t r = transact(SHD_OP_RECVFROM, to_handle(fd), (int64_t)n,
-                       nb_flag(flags), 0, NULL, 0, tmp, (uint32_t)cap, &got);
+  int64_t r;
+  for (;;) {
+    int park = gt_should_park() && !app_nb;
+    r = transact(SHD_OP_RECVFROM, to_handle(fd), (int64_t)n,
+                 (app_nb || park) ? 1 : 0, 0, NULL, 0, tmp, (uint32_t)cap,
+                 &got);
+    if (r < 0 && park && errno == EAGAIN) {
+      gt_park_fd(to_handle(fd), POLLIN);
+      continue;
+    }
+    break;
+  }
   if (r < 0) { free(tmp); return -1; }
   if (got < 6) { free(tmp); return 0; }
   uint32_t ip;
@@ -634,18 +738,37 @@ extern "C" int getpeername(int fd, struct sockaddr *addr, socklen_t *alen) {
 
 extern "C" ssize_t read(int fd, void *buf, size_t n) {
   if (!is_sim_fd(fd)) return REAL(read)(fd, buf, n);
-  uint32_t got = 0;
-  int64_t r = transact(SHD_OP_READ, to_handle(fd), (int64_t)n, 0, 0, NULL, 0,
-                       buf, (uint32_t)n, &got);
-  if (r < 0) return -1;
-  return (ssize_t)got;
+  int app_nb = g_fd_nonblock[fd];
+  for (;;) {
+    uint32_t got = 0;
+    int park = gt_should_park() && !app_nb;
+    int64_t r = transact(SHD_OP_READ, to_handle(fd), (int64_t)n,
+                         park ? 1 : 0, 0, NULL, 0, buf, (uint32_t)n, &got);
+    if (r < 0) {
+      if (park && errno == EAGAIN) {
+        gt_park_fd(to_handle(fd), POLLIN);
+        continue;
+      }
+      return -1;
+    }
+    return (ssize_t)got;
+  }
 }
 
 extern "C" ssize_t write(int fd, const void *buf, size_t n) {
   if (!is_sim_fd(fd)) return REAL(write)(fd, buf, n);
   if (n > SHD_MAX_PAYLOAD) n = SHD_MAX_PAYLOAD;
-  return (ssize_t)transact(SHD_OP_WRITE, to_handle(fd), 0, 0, 0, buf,
-                           (uint32_t)n, NULL, 0, NULL);
+  int app_nb = g_fd_nonblock[fd];
+  for (;;) {
+    int park = gt_should_park() && !app_nb;
+    int64_t r = transact(SHD_OP_WRITE, to_handle(fd), park ? 1 : 0, 0, 0,
+                         buf, (uint32_t)n, NULL, 0, NULL);
+    if (r < 0 && park && errno == EAGAIN) {
+      gt_park_fd(to_handle(fd), POLLOUT);
+      continue;
+    }
+    return (ssize_t)r;
+  }
 }
 
 extern "C" ssize_t readv(int fd, const struct iovec *iov, int iovcnt) {
@@ -675,8 +798,11 @@ extern "C" ssize_t writev(int fd, const struct iovec *iov, int iovcnt) {
 extern "C" int close(int fd) {
   if (!is_sim_fd(fd)) return REAL(close)(fd);
   mark_sim_fd(fd, 0);
+  g_fd_nonblock[fd] = 0;
   return transact0(SHD_OP_CLOSE, to_handle(fd), 0, 0, 0) < 0 ? -1 : 0;
 }
+
+extern "C" int shd_close_appfd(int fd) { return close(fd); }
 
 extern "C" int fcntl(int fd, int cmd, ...) {
   va_list ap;
@@ -686,8 +812,10 @@ extern "C" int fcntl(int fd, int cmd, ...) {
   resolve_reals();
   if (!is_sim_fd(fd)) return REAL(fcntl)(fd, cmd, arg);
   switch (cmd) {
-    case F_GETFL:
     case F_SETFL:
+      g_fd_nonblock[fd] = (arg & O_NONBLOCK) ? 1 : 0;
+      return (int)transact0(SHD_OP_FCNTL, to_handle(fd), cmd, arg, 0);
+    case F_GETFL:
       return (int)transact0(SHD_OP_FCNTL, to_handle(fd), cmd, arg, 0);
     case F_GETFD:
       return 0;
@@ -711,6 +839,7 @@ extern "C" int ioctl(int fd, unsigned long request, ...) {
     int64_t fl = transact0(SHD_OP_FCNTL, to_handle(fd), F_GETFL, 0, 0);
     if (fl < 0) return -1;
     long nf = on ? (fl | O_NONBLOCK) : (fl & ~O_NONBLOCK);
+    g_fd_nonblock[fd] = on ? 1 : 0;
     return (int)transact0(SHD_OP_FCNTL, to_handle(fd), F_SETFL, nf, 0);
   }
   if (request == FIONREAD) {
@@ -779,8 +908,34 @@ extern "C" int epoll_wait(int epfd, struct epoll_event *events, int maxevents,
   if (maxevents > 256) maxevents = 256;
   unsigned char buf[256 * 12];
   uint32_t got = 0;
-  int64_t n = transact(SHD_OP_EPOLL_WAIT, to_handle(epfd), maxevents, timeout,
-                       0, NULL, 0, buf, sizeof buf, &got);
+  int64_t n;
+  if (gt_should_park() && timeout != 0) {
+    /* scan nonblocking; park on the epoll descriptor (its READABLE bit
+     * tracks the ready set) so sibling green threads can run */
+    int64_t deadline = timeout > 0
+        ? g_vtime_ns + (int64_t)timeout * 1000000LL : -1;
+    for (;;) {
+      got = 0;
+      n = transact(SHD_OP_EPOLL_WAIT, to_handle(epfd), maxevents, 0, 0,
+                   NULL, 0, buf, sizeof buf, &got);
+      if (n != 0) break;   /* events ready (or error) */
+      if (deadline >= 0) {
+        if (g_vtime_ns >= deadline) break;
+        if (!gt_park_fd_deadline(to_handle(epfd), POLLIN, deadline)) {
+          /* deadline expired: one final scan below */
+          got = 0;
+          n = transact(SHD_OP_EPOLL_WAIT, to_handle(epfd), maxevents, 0, 0,
+                       NULL, 0, buf, sizeof buf, &got);
+          break;
+        }
+      } else {
+        gt_park_fd(to_handle(epfd), POLLIN);
+      }
+    }
+  } else {
+    n = transact(SHD_OP_EPOLL_WAIT, to_handle(epfd), maxevents, timeout,
+                 0, NULL, 0, buf, sizeof buf, &got);
+  }
   if (n < 0) return -1;
   int count = (int)(got / 12);
   for (int i = 0; i < count; i++) {
@@ -821,8 +976,33 @@ extern "C" int poll(struct pollfd *fds, nfds_t nfds, int timeout) {
   }
   unsigned char resp[512 * 2];
   uint32_t got = 0;
-  int64_t n = transact(SHD_OP_POLL, (int64_t)nfds, timeout, 0, 0, req,
-                       (uint32_t)(nfds * 6), resp, sizeof resp, &got);
+  int64_t n;
+  if (gt_should_park() && timeout != 0) {
+    /* nonblocking scans + a multi-fd park between them */
+    int64_t deadline = timeout > 0
+        ? g_vtime_ns + (int64_t)timeout * 1000000LL : -1;
+    int64_t park_handles[GT_PARK_MAX];
+    short park_events[GT_PARK_MAX];
+    int park_n = 0;
+    for (nfds_t i = 0; i < nfds && park_n < GT_PARK_MAX; i++) {
+      if (is_sim_fd(fds[i].fd)) {
+        park_handles[park_n] = to_handle(fds[i].fd);
+        park_events[park_n] = fds[i].events;
+        park_n++;
+      }
+    }
+    for (;;) {
+      got = 0;
+      n = transact(SHD_OP_POLL, (int64_t)nfds, 0, 0, 0, req,
+                   (uint32_t)(nfds * 6), resp, sizeof resp, &got);
+      if (n != 0) break;
+      if (deadline >= 0 && g_vtime_ns >= deadline) break;
+      gt_park_fds(park_handles, park_events, park_n, deadline);
+    }
+  } else {
+    n = transact(SHD_OP_POLL, (int64_t)nfds, timeout, 0, 0, req,
+                 (uint32_t)(nfds * 6), resp, sizeof resp, &got);
+  }
   if (n < 0) return -1;
   for (nfds_t i = 0; i < nfds && i * 2 + 2 <= got; i++) {
     int16_t rev;
@@ -898,8 +1078,10 @@ extern "C" int timerfd_create(int clockid, int flags) {
   if (h < 0) return -1;
   int fd = to_appfd(h);
   mark_sim_fd(fd, 1);
-  if (flags & TFD_NONBLOCK)
+  if (flags & TFD_NONBLOCK) {
     transact0(SHD_OP_FCNTL, h, F_SETFL, O_NONBLOCK, 0);
+    g_fd_nonblock[fd] = 1;
+  }
   return fd;
 }
 
@@ -946,6 +1128,8 @@ extern "C" int pipe2(int fds[2], int flags) {
   if (flags & O_NONBLOCK) {
     transact0(SHD_OP_FCNTL, to_handle(fds[0]), F_SETFL, O_NONBLOCK, 0);
     transact0(SHD_OP_FCNTL, to_handle(fds[1]), F_SETFL, O_NONBLOCK, 0);
+    g_fd_nonblock[fds[0]] = 1;
+    g_fd_nonblock[fds[1]] = 1;
   }
   return 0;
 }
@@ -1060,19 +1244,21 @@ static int is_random_path(const char *path) {
                   strcmp(path, "/dev/srandom") == 0);
 }
 
+extern "C" int shd_open_random_fd(void) {
+  int64_t h = transact0(SHD_OP_OPEN_RANDOM, 0, 0, 0, 0);
+  if (h < 0) return -1;
+  int fd = to_appfd(h);
+  mark_sim_fd(fd, 1);
+  return fd;
+}
+
 extern "C" int open(const char *path, int flags, ...) {
   va_list ap;
   va_start(ap, flags);
   mode_t mode = (mode_t)va_arg(ap, int);
   va_end(ap);
   resolve_reals();
-  if (g_active && is_random_path(path)) {
-    int64_t h = transact0(SHD_OP_OPEN_RANDOM, 0, 0, 0, 0);
-    if (h < 0) return -1;
-    int fd = to_appfd(h);
-    mark_sim_fd(fd, 1);
-    return fd;
-  }
+  if (g_active && is_random_path(path)) return shd_open_random_fd();
   return REAL(open)(path, flags, mode);
 }
 

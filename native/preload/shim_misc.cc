@@ -1,0 +1,370 @@
+/* Misc interposed families: process identity, fork/exec stubs, signals,
+ * uname, getifaddrs, rand, and the fopen-path to deterministic randomness.
+ *
+ * Reference parity map (process.c):
+ *   fork/exec        -> warn + ENOSYS stubs (process_emu_fork family)
+ *   signal/sigaction -> accepted no-ops (signals are not modelled; the
+ *                       reference routes them to warnings too)
+ *   uname            -> fixed deterministic identity + virtual hostname
+ *   getpid/getppid   -> virtual pid from the simulator (env), ppid 1
+ *   getifaddrs       -> lo + eth0 with the host's simulated address
+ *   rand/random      -> host Random stream (process_emu_rand -> host rng)
+ *   fopen(/dev/*random) -> deterministic FILE* (emu_fopen special paths)
+ */
+
+#define _GNU_SOURCE 1
+#include "protocol.h"
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <ifaddrs.h>
+#include <net/if.h>
+#include <netinet/in.h>
+#include <signal.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <sys/utsname.h>
+#include <unistd.h>
+
+extern "C" int64_t shd_transact(uint32_t op, int64_t a, int64_t b, int64_t c,
+                                int64_t d, const void *payload,
+                                uint32_t payload_len, void *resp_buf,
+                                uint32_t resp_cap, uint32_t *resp_len);
+extern "C" int shd_active(void);
+extern "C" int shd_open_random_fd(void);   /* appfd for a sim random source */
+extern "C" int shd_close_appfd(int fd);
+
+/* ------------------------------------------------------------ identity -- */
+
+extern "C" pid_t getpid(void) {
+  static pid_t (*real_getpid)(void);
+  if (!real_getpid) *(void **)(&real_getpid) = dlsym(RTLD_NEXT, "getpid");
+  if (!shd_active()) return real_getpid();
+  const char *p = getenv("SHADOW_TPU_PID");
+  return p && *p ? (pid_t)atoi(p) : real_getpid();
+}
+
+extern "C" pid_t getppid(void) {
+  static pid_t (*real_getppid)(void);
+  if (!real_getppid) *(void **)(&real_getppid) = dlsym(RTLD_NEXT, "getppid");
+  return shd_active() ? 1 : real_getppid();
+}
+
+extern "C" int uname(struct utsname *buf) {
+  static int (*real_uname)(struct utsname *);
+  if (!real_uname) *(void **)(&real_uname) = dlsym(RTLD_NEXT, "uname");
+  if (!shd_active()) return real_uname(buf);
+  if (!buf) { errno = EFAULT; return -1; }
+  memset(buf, 0, sizeof *buf);
+  snprintf(buf->sysname, sizeof buf->sysname, "Linux");
+  char hn[sizeof buf->nodename];
+  uint32_t got = 0;
+  if (shd_transact(SHD_OP_GETHOSTNAME, 0, 0, 0, 0, NULL, 0, hn,
+                   sizeof hn - 1, &got) >= 0) {
+    hn[got] = '\0';
+    snprintf(buf->nodename, sizeof buf->nodename, "%s", hn);
+  }
+  snprintf(buf->release, sizeof buf->release, "5.15.0-shadow-tpu");
+  snprintf(buf->version, sizeof buf->version, "#1 SMP shadow_tpu virtual");
+  snprintf(buf->machine, sizeof buf->machine, "x86_64");
+  return 0;
+}
+
+/* -------------------------------------------------------- fork/exec stubs -- */
+
+extern "C" pid_t fork(void) {
+  static pid_t (*real_fork)(void);
+  if (!real_fork) *(void **)(&real_fork) = dlsym(RTLD_NEXT, "fork");
+  if (!shd_active()) return real_fork();
+  errno = ENOSYS;   /* virtual processes cannot fork (reference stubs too) */
+  return -1;
+}
+
+extern "C" pid_t vfork(void) {
+  if (!shd_active()) {
+    static pid_t (*real_vfork)(void);
+    if (!real_vfork) *(void **)(&real_vfork) = dlsym(RTLD_NEXT, "fork");
+    return real_vfork();   /* degrade vfork to fork: safe for interposers */
+  }
+  errno = ENOSYS;
+  return -1;
+}
+
+static int exec_stub(void) {
+  errno = ENOSYS;
+  return -1;
+}
+
+extern "C" int execve(const char *p, char *const a[], char *const e[]) {
+  static int (*real_execve)(const char *, char *const[], char *const[]);
+  if (!real_execve) *(void **)(&real_execve) = dlsym(RTLD_NEXT, "execve");
+  return shd_active() ? exec_stub() : real_execve(p, a, e);
+}
+
+extern "C" int execv(const char *p, char *const a[]) {
+  static int (*real_execv)(const char *, char *const[]);
+  if (!real_execv) *(void **)(&real_execv) = dlsym(RTLD_NEXT, "execv");
+  return shd_active() ? exec_stub() : real_execv(p, a);
+}
+
+extern "C" int execvp(const char *p, char *const a[]) {
+  static int (*real_execvp)(const char *, char *const[]);
+  if (!real_execvp) *(void **)(&real_execvp) = dlsym(RTLD_NEXT, "execvp");
+  return shd_active() ? exec_stub() : real_execvp(p, a);
+}
+
+extern "C" int system(const char *cmd) {
+  static int (*real_system)(const char *);
+  if (!real_system) *(void **)(&real_system) = dlsym(RTLD_NEXT, "system");
+  if (!shd_active()) return real_system(cmd);
+  errno = ENOSYS;
+  return -1;
+}
+
+/* --------------------------------------------------------------- signals -- */
+
+static sighandler_t g_sig_handlers[65];
+
+extern "C" sighandler_t signal(int signum, sighandler_t handler) {
+  static sighandler_t (*real_signal)(int, sighandler_t);
+  if (!real_signal) *(void **)(&real_signal) = dlsym(RTLD_NEXT, "signal");
+  if (!shd_active()) return real_signal(signum, handler);
+  if (signum < 1 || signum > 64) { errno = EINVAL; return SIG_ERR; }
+  sighandler_t old = g_sig_handlers[signum];
+  g_sig_handlers[signum] = handler;   /* recorded, never delivered */
+  return old;
+}
+
+extern "C" int sigaction(int signum, const struct sigaction *act,
+                         struct sigaction *oldact) {
+  static int (*real_sigaction)(int, const struct sigaction *,
+                               struct sigaction *);
+  if (!real_sigaction)
+    *(void **)(&real_sigaction) = dlsym(RTLD_NEXT, "sigaction");
+  if (!shd_active()) return real_sigaction(signum, act, oldact);
+  if (signum < 1 || signum > 64) { errno = EINVAL; return -1; }
+  if (oldact) {
+    memset(oldact, 0, sizeof *oldact);
+    oldact->sa_handler = g_sig_handlers[signum];
+  }
+  if (act) g_sig_handlers[signum] = act->sa_handler;
+  return 0;
+}
+
+extern "C" int sigprocmask(int how, const sigset_t *set, sigset_t *oldset) {
+  static int (*real_spm)(int, const sigset_t *, sigset_t *);
+  if (!real_spm) *(void **)(&real_spm) = dlsym(RTLD_NEXT, "sigprocmask");
+  if (!shd_active()) return real_spm(how, set, oldset);
+  if (oldset) sigemptyset(oldset);
+  return 0;
+}
+
+extern "C" int pthread_sigmask(int how, const sigset_t *set,
+                               sigset_t *oldset) {
+  if (!shd_active()) {
+    static int (*real_psm)(int, const sigset_t *, sigset_t *);
+    if (!real_psm) *(void **)(&real_psm) = dlsym(RTLD_NEXT, "pthread_sigmask");
+    return real_psm(how, set, oldset);
+  }
+  if (oldset) sigemptyset(oldset);
+  return 0;
+}
+
+/* ------------------------------------------------------------ getifaddrs -- */
+
+struct shd_ifaddrs_blob {
+  struct ifaddrs ifa[2];
+  struct sockaddr_in addrs[6];
+  char names[2][8];
+};
+
+extern "C" int getifaddrs(struct ifaddrs **ifap) {
+  static int (*real_getifaddrs)(struct ifaddrs **);
+  if (!real_getifaddrs)
+    *(void **)(&real_getifaddrs) = dlsym(RTLD_NEXT, "getifaddrs");
+  if (!shd_active()) return real_getifaddrs(ifap);
+  /* the host's eth address: resolve our own hostname */
+  char hn[256];
+  uint32_t got = 0;
+  uint32_t eth_ip = 0;
+  if (shd_transact(SHD_OP_GETHOSTNAME, 0, 0, 0, 0, NULL, 0, hn,
+                   sizeof hn - 1, &got) >= 0) {
+    hn[got] = '\0';
+    uint32_t ip_buf = 0;
+    uint32_t g2 = 0;
+    if (shd_transact(SHD_OP_GETADDRINFO, 0, 0, 0, 0, hn,
+                     (uint32_t)strlen(hn), &ip_buf, sizeof ip_buf, &g2) >= 0)
+      eth_ip = ip_buf;
+  }
+  shd_ifaddrs_blob *b = (shd_ifaddrs_blob *)calloc(1, sizeof *b);
+  if (!b) { errno = ENOMEM; return -1; }
+  snprintf(b->names[0], sizeof b->names[0], "lo");
+  snprintf(b->names[1], sizeof b->names[1], "eth0");
+  /* [0]=lo addr [1]=lo mask [2]=eth addr [3]=eth mask [4]=eth broadcast */
+  for (int i = 0; i < 5; i++) b->addrs[i].sin_family = AF_INET;
+  b->addrs[0].sin_addr.s_addr = htonl(0x7F000001u);
+  b->addrs[1].sin_addr.s_addr = htonl(0xFF000000u);
+  b->addrs[2].sin_addr.s_addr = htonl(eth_ip);
+  b->addrs[3].sin_addr.s_addr = htonl(0xFFFFFF00u);
+  b->addrs[4].sin_addr.s_addr = htonl((eth_ip & 0xFFFFFF00u) | 0xFFu);
+  b->ifa[0].ifa_next = &b->ifa[1];
+  b->ifa[0].ifa_name = b->names[0];
+  b->ifa[0].ifa_flags = IFF_UP | IFF_RUNNING | IFF_LOOPBACK;
+  b->ifa[0].ifa_addr = (struct sockaddr *)&b->addrs[0];
+  b->ifa[0].ifa_netmask = (struct sockaddr *)&b->addrs[1];
+  b->ifa[1].ifa_next = NULL;
+  b->ifa[1].ifa_name = b->names[1];
+  b->ifa[1].ifa_flags = IFF_UP | IFF_RUNNING | IFF_BROADCAST;
+  b->ifa[1].ifa_addr = (struct sockaddr *)&b->addrs[2];
+  b->ifa[1].ifa_netmask = (struct sockaddr *)&b->addrs[3];
+  b->ifa[1].ifa_ifu.ifu_broadaddr = (struct sockaddr *)&b->addrs[4];
+  *ifap = &b->ifa[0];
+  return 0;
+}
+
+extern "C" void freeifaddrs(struct ifaddrs *ifa) {
+  static void (*real_freeifaddrs)(struct ifaddrs *);
+  if (!real_freeifaddrs)
+    *(void **)(&real_freeifaddrs) = dlsym(RTLD_NEXT, "freeifaddrs");
+  if (!shd_active()) { real_freeifaddrs(ifa); return; }
+  free(ifa);   /* ours is one calloc blob headed by ifa[0] */
+}
+
+/* ----------------------------------------------------------------- rand -- */
+
+/* rand/random route to the host Random stream (reference process_emu_rand).
+ * Bytes are fetched in blocks to amortize protocol round trips. */
+static unsigned char g_rand_buf[4096];
+static size_t g_rand_avail = 0;
+
+static uint32_t shd_rand_u32(void) {
+  if (g_rand_avail < 4) {
+    uint32_t got = 0;
+    if (shd_transact(SHD_OP_RANDOM, sizeof g_rand_buf, 0, 0, 0, NULL, 0,
+                     g_rand_buf, sizeof g_rand_buf, &got) < 0 || got < 4)
+      return 0;
+    g_rand_avail = got;
+  }
+  uint32_t v;
+  memcpy(&v, g_rand_buf + sizeof g_rand_buf - g_rand_avail, 4);
+  g_rand_avail -= 4;
+  return v;
+}
+
+extern "C" int rand(void) {
+  static int (*real_rand)(void);
+  if (!real_rand) *(void **)(&real_rand) = dlsym(RTLD_NEXT, "rand");
+  if (!shd_active()) return real_rand();
+  return (int)(shd_rand_u32() & 0x7FFFFFFFu);
+}
+
+extern "C" long random(void) {
+  static long (*real_random)(void);
+  if (!real_random) *(void **)(&real_random) = dlsym(RTLD_NEXT, "random");
+  if (!shd_active()) return real_random();
+  return (long)(shd_rand_u32() & 0x7FFFFFFFu);
+}
+
+extern "C" void srand(unsigned int seed) {
+  static void (*real_srand)(unsigned int);
+  if (!real_srand) *(void **)(&real_srand) = dlsym(RTLD_NEXT, "srand");
+  if (!shd_active()) { real_srand(seed); return; }
+  /* seeding is owned by the simulator's seed hierarchy: ignored */
+}
+
+extern "C" void srandom(unsigned int seed) {
+  static void (*real_srandom)(unsigned int);
+  if (!real_srandom) *(void **)(&real_srandom) = dlsym(RTLD_NEXT, "srandom");
+  if (!shd_active()) { real_srandom(seed); return; }
+}
+
+/* ------------------------------------------- fopen(/dev/*random) family -- */
+
+/* A fake FILE for deterministic random reads.  Only the fread/fgets/fclose/
+ * fileno/feof/ferror surface is modelled — apps read entropy, nothing else.
+ * Real glibc stdio on a sim fd would bypass the interposer (glibc calls its
+ * internal __read), so the FILE* itself must be ours. */
+struct shd_file {
+  uint32_t magic;     /* 0x5HADF11E */
+  int appfd;
+};
+#define SHD_FILE_MAGIC 0x5AADF11Eu
+
+static int is_random_path2(const char *path) {
+  return path && (strcmp(path, "/dev/random") == 0 ||
+                  strcmp(path, "/dev/urandom") == 0 ||
+                  strcmp(path, "/dev/srandom") == 0);
+}
+
+static struct shd_file *as_shd_file(FILE *f) {
+  struct shd_file *s = (struct shd_file *)f;
+  /* alignment-safe: our files come from calloc */
+  return (s && s->magic == SHD_FILE_MAGIC) ? s : NULL;
+}
+
+extern "C" FILE *fopen(const char *path, const char *mode) {
+  static FILE *(*real_fopen)(const char *, const char *);
+  if (!real_fopen) *(void **)(&real_fopen) = dlsym(RTLD_NEXT, "fopen");
+  if (!shd_active() || !is_random_path2(path)) return real_fopen(path, mode);
+  int fd = shd_open_random_fd();
+  if (fd < 0) return NULL;
+  struct shd_file *s = (struct shd_file *)calloc(1, sizeof *s);
+  s->magic = SHD_FILE_MAGIC;
+  s->appfd = fd;
+  return (FILE *)s;
+}
+
+extern "C" FILE *fopen64(const char *path, const char *mode) {
+  static FILE *(*real_fopen64)(const char *, const char *);
+  if (!real_fopen64) *(void **)(&real_fopen64) = dlsym(RTLD_NEXT, "fopen64");
+  if (!shd_active() || !is_random_path2(path)) return real_fopen64(path, mode);
+  return fopen(path, mode);
+}
+
+extern "C" size_t fread(void *ptr, size_t size, size_t nmemb, FILE *f) {
+  static size_t (*real_fread)(void *, size_t, size_t, FILE *);
+  if (!real_fread) *(void **)(&real_fread) = dlsym(RTLD_NEXT, "fread");
+  struct shd_file *s = as_shd_file(f);
+  if (!s) return real_fread(ptr, size, nmemb, f);
+  size_t want = size * nmemb;
+  ssize_t r = read(s->appfd, ptr, want);   /* interposed read: sim fd */
+  if (r <= 0 || size == 0) return 0;
+  return (size_t)r / size;
+}
+
+extern "C" int fclose(FILE *f) {
+  static int (*real_fclose)(int (*)(FILE *), FILE *);
+  static int (*rf)(FILE *);
+  (void)real_fclose;
+  if (!rf) *(void **)(&rf) = dlsym(RTLD_NEXT, "fclose");
+  struct shd_file *s = as_shd_file(f);
+  if (!s) return rf(f);
+  shd_close_appfd(s->appfd);
+  free(s);
+  return 0;
+}
+
+extern "C" int fileno(FILE *f) {
+  static int (*real_fileno)(FILE *);
+  if (!real_fileno) *(void **)(&real_fileno) = dlsym(RTLD_NEXT, "fileno");
+  struct shd_file *s = as_shd_file(f);
+  return s ? s->appfd : real_fileno(f);
+}
+
+extern "C" int feof(FILE *f) {
+  static int (*real_feof)(FILE *);
+  if (!real_feof) *(void **)(&real_feof) = dlsym(RTLD_NEXT, "feof");
+  struct shd_file *s = as_shd_file(f);
+  return s ? 0 : real_feof(f);   /* entropy never ends */
+}
+
+extern "C" int ferror(FILE *f) {
+  static int (*real_ferror)(FILE *);
+  if (!real_ferror) *(void **)(&real_ferror) = dlsym(RTLD_NEXT, "ferror");
+  struct shd_file *s = as_shd_file(f);
+  return s ? 0 : real_ferror(f);
+}

@@ -1,0 +1,596 @@
+/* Cooperative pthread layer (see shim_threads.h).
+ *
+ * Capability parity: the reference routes the pthread family to rpth green
+ * threads (process.c pthread_* emulations -> rpth/pthread.c), so plugin
+ * threads are deterministic coroutines.  This file does the same inside the
+ * plugin process with ucontext: one OS thread, many green threads, context
+ * switches only at interposed blocking calls, and a single combined
+ * simulator wait when everything is parked.
+ */
+
+#define _GNU_SOURCE 1
+#include "shim_threads.h"
+#include "protocol.h"
+
+#include <dlfcn.h>
+#include <errno.h>
+#include <poll.h>
+#include <pthread.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <ucontext.h>
+#include <unistd.h>
+
+#include <map>
+#include <vector>
+
+/* provided by shim.cc */
+extern "C" int64_t shd_transact(uint32_t op, int64_t a, int64_t b, int64_t c,
+                                int64_t d, const void *payload,
+                                uint32_t payload_len, void *resp_buf,
+                                uint32_t resp_cap, uint32_t *resp_len);
+extern "C" int64_t shd_vtime_ns(void);
+
+#define GT_MAX_THREADS 256
+#define GT_STACK_SIZE (1024 * 1024)
+#define GT_MAX_WAIT_FDS GT_PARK_MAX
+
+enum { GT_RUNNABLE = 0, GT_BLOCKED = 1, GT_DONE = 2 };
+enum { W_NONE = 0, W_FD = 1, W_SLEEP = 2, W_JOIN = 3, W_MUTEX = 4,
+       W_COND = 5 };
+
+struct gt_thread {
+  int tid;
+  ucontext_t ctx;
+  char *stack;
+  int state;
+  int wait_kind;
+  /* W_FD: parked on any of these (handle, events) pairs */
+  int64_t wait_handles[GT_MAX_WAIT_FDS];
+  short wait_events[GT_MAX_WAIT_FDS];
+  int wait_nfds;
+  int64_t wait_deadline;   /* vtime ns; -1 = none (W_SLEEP / W_FD timeout) */
+  int deadline_fired;      /* set by the scheduler when the deadline woke us */
+  const void *wait_obj;    /* W_JOIN: target thread; W_MUTEX/W_COND: address */
+  void *(*start)(void *);
+  void *arg;
+  void *retval;
+  int detached;
+  int joined_by;           /* tid waiting in pthread_join, -1 none */
+};
+
+static gt_thread *g_threads[GT_MAX_THREADS];
+static int g_nthreads = 0;        /* slots used (never reused) */
+static int g_alive = 0;           /* threads not yet DONE */
+static gt_thread *g_current = NULL;
+static ucontext_t g_sched_ctx;
+static char *g_sched_stack = NULL;
+static int g_engaged = 0;
+
+extern "C" int gt_engaged(void) { return g_engaged; }
+
+extern "C" int gt_should_park(void) { return g_engaged && g_alive > 1; }
+
+/* ------------------------------------------------------------- scheduler -- */
+
+static void gt_fatal(const char *msg) {
+  ssize_t r = ::write(2, msg, strlen(msg));
+  (void)r;
+  _exit(70);
+}
+
+/* Wait in the simulator until some parked thread can make progress: one
+ * OP_POLL over every parked fd (with the earliest deadline as timeout), or
+ * a plain OP_SLEEP when only deadlines exist.  This is the plugin-side twin
+ * of the reference's pth scheduler polling its gctx epollfd
+ * (process.c:1095). */
+static void gt_sim_wait(void) {
+  int64_t handles[GT_MAX_WAIT_FDS];
+  short events[GT_MAX_WAIT_FDS];
+  gt_thread *owners[GT_MAX_WAIT_FDS];
+  int nfds = 0;
+  int64_t earliest = -1;
+  int have_wait = 0;
+  for (int i = 0; i < g_nthreads; i++) {
+    gt_thread *t = g_threads[i];
+    if (!t || t->state != GT_BLOCKED) continue;
+    if (t->wait_kind == W_FD) {
+      have_wait = 1;
+      for (int j = 0; j < t->wait_nfds && nfds < GT_MAX_WAIT_FDS; j++) {
+        handles[nfds] = t->wait_handles[j];
+        events[nfds] = t->wait_events[j];
+        owners[nfds] = t;
+        nfds++;
+      }
+      if (t->wait_deadline >= 0 &&
+          (earliest < 0 || t->wait_deadline < earliest))
+        earliest = t->wait_deadline;
+    } else if (t->wait_kind == W_SLEEP) {
+      have_wait = 1;
+      if (earliest < 0 || t->wait_deadline < earliest)
+        earliest = t->wait_deadline;
+    }
+  }
+  if (!have_wait)
+    gt_fatal("shadow_tpu shim: green-thread deadlock (all threads parked "
+             "on mutexes/conds/joins with no I/O or sleep pending)\n");
+
+  if (nfds == 0) {
+    /* only sleepers: advance the virtual clock to the earliest deadline */
+    int64_t now = shd_vtime_ns();
+    int64_t ns = earliest > now ? earliest - now : 0;
+    shd_transact(SHD_OP_SLEEP, ns, 0, 0, 0, NULL, 0, NULL, 0, NULL);
+  } else {
+    unsigned char req[GT_MAX_WAIT_FDS * 6];
+    for (int i = 0; i < nfds; i++) {
+      int32_t h = (int32_t)handles[i];
+      int16_t e = (int16_t)events[i];
+      memcpy(req + i * 6, &h, 4);
+      memcpy(req + i * 6 + 4, &e, 2);
+    }
+    int64_t timeout_ms = -1;
+    if (earliest >= 0) {
+      int64_t now = shd_vtime_ns();
+      int64_t ns = earliest > now ? earliest - now : 0;
+      timeout_ms = (ns + 999999) / 1000000;   /* ceil to ms */
+    }
+    unsigned char resp[GT_MAX_WAIT_FDS * 2];
+    uint32_t got = 0;
+    int64_t n = shd_transact(SHD_OP_POLL, nfds, timeout_ms, 0, 0, req,
+                             (uint32_t)(nfds * 6), resp, sizeof resp, &got);
+    if (n >= 0) {
+      for (int i = 0; i < nfds && (uint32_t)(i * 2 + 2) <= got; i++) {
+        int16_t rev;
+        memcpy(&rev, resp + i * 2, 2);
+        if (rev && owners[i]->state == GT_BLOCKED) {
+          owners[i]->state = GT_RUNNABLE;
+          owners[i]->wait_kind = W_NONE;
+        }
+      }
+    }
+  }
+  /* wake expired sleepers / deadline waits (vtime was refreshed by the
+   * response header) */
+  int64_t now = shd_vtime_ns();
+  for (int i = 0; i < g_nthreads; i++) {
+    gt_thread *t = g_threads[i];
+    if (!t || t->state != GT_BLOCKED) continue;
+    if ((t->wait_kind == W_SLEEP || t->wait_kind == W_FD) &&
+        t->wait_deadline >= 0 && now >= t->wait_deadline) {
+      t->state = GT_RUNNABLE;
+      t->wait_kind = W_NONE;
+      t->deadline_fired = 1;
+    }
+  }
+}
+
+static int g_rr_next = 0;   /* round-robin cursor (deterministic order) */
+
+static gt_thread *gt_pick_runnable(void) {
+  for (int k = 0; k < g_nthreads; k++) {
+    int i = (g_rr_next + k) % g_nthreads;
+    gt_thread *t = g_threads[i];
+    if (t && t->state == GT_RUNNABLE) {
+      g_rr_next = (i + 1) % g_nthreads;
+      return t;
+    }
+  }
+  return NULL;
+}
+
+static void gt_scheduler_loop(void) {
+  for (;;) {
+    gt_thread *next = gt_pick_runnable();
+    if (next) {
+      g_current = next;
+      swapcontext(&g_sched_ctx, &next->ctx);
+      continue;
+    }
+    if (g_alive == 0) _exit(0);
+    gt_sim_wait();
+  }
+}
+
+static void gt_switch_to_scheduler(void) {
+  gt_thread *self = g_current;
+  swapcontext(&self->ctx, &g_sched_ctx);
+}
+
+/* ----------------------------------------------------------- park points -- */
+
+extern "C" void gt_park_fd(int64_t handle, short ev) {
+  gt_thread *t = g_current;
+  t->state = GT_BLOCKED;
+  t->wait_kind = W_FD;
+  t->wait_handles[0] = handle;
+  t->wait_events[0] = ev;
+  t->wait_nfds = 1;
+  t->wait_deadline = -1;
+  t->deadline_fired = 0;
+  gt_switch_to_scheduler();
+}
+
+extern "C" int gt_park_fd_deadline(int64_t handle, short ev,
+                                   int64_t deadline_ns) {
+  gt_thread *t = g_current;
+  t->state = GT_BLOCKED;
+  t->wait_kind = W_FD;
+  t->wait_handles[0] = handle;
+  t->wait_events[0] = ev;
+  t->wait_nfds = 1;
+  t->wait_deadline = deadline_ns;
+  t->deadline_fired = 0;
+  gt_switch_to_scheduler();
+  return !t->deadline_fired;
+}
+
+extern "C" void gt_park_fds(const int64_t *handles, const short *events,
+                            int n, int64_t deadline_ns) {
+  gt_thread *t = g_current;
+  if (n > GT_MAX_WAIT_FDS) n = GT_MAX_WAIT_FDS;
+  t->state = GT_BLOCKED;
+  t->wait_kind = W_FD;
+  for (int i = 0; i < n; i++) {
+    t->wait_handles[i] = handles[i];
+    t->wait_events[i] = events[i];
+  }
+  t->wait_nfds = n;
+  t->wait_deadline = deadline_ns;
+  t->deadline_fired = 0;
+  gt_switch_to_scheduler();
+}
+
+extern "C" void gt_park_sleep(int64_t deadline_ns) {
+  gt_thread *t = g_current;
+  t->state = GT_BLOCKED;
+  t->wait_kind = W_SLEEP;
+  t->wait_nfds = 0;
+  t->wait_deadline = deadline_ns;
+  t->deadline_fired = 0;
+  gt_switch_to_scheduler();
+}
+
+/* ------------------------------------------------------- thread lifecycle -- */
+
+static void gt_thread_exit(void *retval) {
+  gt_thread *t = g_current;
+  t->retval = retval;
+  t->state = GT_DONE;
+  g_alive--;
+  /* wake a joiner parked on us */
+  if (t->joined_by >= 0 && t->joined_by < g_nthreads) {
+    gt_thread *j = g_threads[t->joined_by];
+    if (j && j->state == GT_BLOCKED && j->wait_kind == W_JOIN &&
+        j->wait_obj == t) {
+      j->state = GT_RUNNABLE;
+      j->wait_kind = W_NONE;
+    }
+  }
+  gt_switch_to_scheduler();
+  gt_fatal("shadow_tpu shim: resumed a finished green thread\n");
+}
+
+static void gt_trampoline(unsigned int hi, unsigned int lo) {
+  gt_thread *t =
+      (gt_thread *)(((uintptr_t)hi << 32) | (uintptr_t)lo);
+  void *rv = t->start(t->arg);
+  gt_thread_exit(rv);
+}
+
+static gt_thread *gt_alloc_thread(void) {
+  if (g_nthreads >= GT_MAX_THREADS) return NULL;
+  gt_thread *t = (gt_thread *)calloc(1, sizeof(gt_thread));
+  t->tid = g_nthreads;
+  t->joined_by = -1;
+  t->wait_deadline = -1;
+  g_threads[g_nthreads++] = t;
+  return t;
+}
+
+static void gt_engage(void) {
+  if (g_engaged) return;
+  /* wrap the currently-running (main) flow as green thread 0 */
+  gt_thread *main_t = gt_alloc_thread();
+  main_t->state = GT_RUNNABLE;
+  g_alive = 1;
+  g_current = main_t;
+  g_sched_stack = (char *)malloc(GT_STACK_SIZE);
+  getcontext(&g_sched_ctx);
+  g_sched_ctx.uc_stack.ss_sp = g_sched_stack;
+  g_sched_ctx.uc_stack.ss_size = GT_STACK_SIZE;
+  g_sched_ctx.uc_link = NULL;
+  makecontext(&g_sched_ctx, (void (*)())gt_scheduler_loop, 0);
+  g_engaged = 1;
+}
+
+/* -------------------------------------------------------- pthread family -- */
+
+/* reals for pass-through before gt mode engages */
+static int (*real_mutex_lock)(pthread_mutex_t *);
+static int (*real_mutex_trylock)(pthread_mutex_t *);
+static int (*real_mutex_unlock)(pthread_mutex_t *);
+static int (*real_cond_wait)(pthread_cond_t *, pthread_mutex_t *);
+static int (*real_cond_signal)(pthread_cond_t *);
+static int (*real_cond_broadcast)(pthread_cond_t *);
+static pthread_t (*real_self)(void);
+
+static void resolve_pthread_reals(void) {
+  if (!real_mutex_lock) {
+    *(void **)(&real_mutex_lock) = dlsym(RTLD_NEXT, "pthread_mutex_lock");
+    *(void **)(&real_mutex_trylock) =
+        dlsym(RTLD_NEXT, "pthread_mutex_trylock");
+    *(void **)(&real_mutex_unlock) = dlsym(RTLD_NEXT, "pthread_mutex_unlock");
+    *(void **)(&real_cond_wait) = dlsym(RTLD_NEXT, "pthread_cond_wait");
+    *(void **)(&real_cond_signal) = dlsym(RTLD_NEXT, "pthread_cond_signal");
+    *(void **)(&real_cond_broadcast) =
+        dlsym(RTLD_NEXT, "pthread_cond_broadcast");
+    *(void **)(&real_self) = dlsym(RTLD_NEXT, "pthread_self");
+  }
+}
+
+/* mutex/cond state lives in side tables keyed by object address; a zeroed
+ * static initializer is simply "absent = unlocked/no waiters" */
+struct gt_mutex_state {
+  int locked;
+  int owner;
+  std::vector<int> waiters;   /* FIFO */
+};
+static std::map<const void *, gt_mutex_state> *g_mutexes;
+static std::map<const void *, std::vector<int>> *g_cond_waiters;
+
+static gt_mutex_state &mutex_state(const void *m) {
+  if (!g_mutexes) g_mutexes = new std::map<const void *, gt_mutex_state>();
+  return (*g_mutexes)[m];
+}
+
+extern "C" int pthread_create(pthread_t *thread, const pthread_attr_t *attr,
+                              void *(*start)(void *), void *arg) {
+  (void)attr;
+  resolve_pthread_reals();
+  gt_engage();
+  gt_thread *t = gt_alloc_thread();
+  if (!t) return EAGAIN;
+  t->start = start;
+  t->arg = arg;
+  t->stack = (char *)malloc(GT_STACK_SIZE);
+  if (!t->stack) return EAGAIN;
+  getcontext(&t->ctx);
+  t->ctx.uc_stack.ss_sp = t->stack;
+  t->ctx.uc_stack.ss_size = GT_STACK_SIZE;
+  t->ctx.uc_link = NULL;
+  uintptr_t p = (uintptr_t)t;
+  makecontext(&t->ctx, (void (*)())gt_trampoline, 2,
+              (unsigned int)(p >> 32), (unsigned int)(p & 0xFFFFFFFFu));
+  t->state = GT_RUNNABLE;
+  g_alive++;
+  if (thread) *thread = (pthread_t)(uintptr_t)(t->tid + 1);
+  return 0;
+}
+
+static gt_thread *gt_by_pthread(pthread_t pt) {
+  int tid = (int)(uintptr_t)pt - 1;
+  if (tid < 0 || tid >= g_nthreads) return NULL;
+  return g_threads[tid];
+}
+
+extern "C" int pthread_join(pthread_t pt, void **retval) {
+  if (!g_engaged) return ESRCH;
+  gt_thread *target = gt_by_pthread(pt);
+  if (!target) return ESRCH;
+  if (target == g_current) return EDEADLK;
+  while (target->state != GT_DONE) {
+    target->joined_by = g_current->tid;
+    g_current->state = GT_BLOCKED;
+    g_current->wait_kind = W_JOIN;
+    g_current->wait_obj = target;
+    gt_switch_to_scheduler();
+  }
+  if (retval) *retval = target->retval;
+  return 0;
+}
+
+extern "C" int pthread_detach(pthread_t pt) {
+  gt_thread *t = g_engaged ? gt_by_pthread(pt) : NULL;
+  if (t) t->detached = 1;
+  return 0;
+}
+
+extern "C" pthread_t pthread_self(void) {
+  resolve_pthread_reals();
+  if (g_engaged && g_current)
+    return (pthread_t)(uintptr_t)(g_current->tid + 1);
+  return real_self ? real_self() : (pthread_t)0;
+}
+
+extern "C" int pthread_equal(pthread_t a, pthread_t b) { return a == b; }
+
+extern "C" void pthread_exit(void *retval) {
+  if (g_engaged) gt_thread_exit(retval);
+  /* no green threads: behave like exit of the only thread */
+  _exit(0);
+}
+
+extern "C" int sched_yield(void) {
+  if (gt_should_park()) {
+    /* cooperative yield: stay runnable, let the scheduler rotate */
+    gt_switch_to_scheduler();
+  }
+  return 0;
+}
+
+/* -- mutexes -- */
+
+extern "C" int pthread_mutex_lock(pthread_mutex_t *m) {
+  resolve_pthread_reals();
+  if (!g_engaged) return real_mutex_lock(m);
+  gt_mutex_state &st = mutex_state(m);
+  while (st.locked && st.owner != g_current->tid) {
+    st.waiters.push_back(g_current->tid);
+    g_current->state = GT_BLOCKED;
+    g_current->wait_kind = W_MUTEX;
+    g_current->wait_obj = m;
+    gt_switch_to_scheduler();
+  }
+  st.locked = 1;
+  st.owner = g_current->tid;
+  return 0;
+}
+
+extern "C" int pthread_mutex_trylock(pthread_mutex_t *m) {
+  resolve_pthread_reals();
+  if (!g_engaged) return real_mutex_trylock(m);
+  gt_mutex_state &st = mutex_state(m);
+  if (st.locked && st.owner != g_current->tid) return EBUSY;
+  st.locked = 1;
+  st.owner = g_current->tid;
+  return 0;
+}
+
+extern "C" int pthread_mutex_unlock(pthread_mutex_t *m) {
+  resolve_pthread_reals();
+  if (!g_engaged) return real_mutex_unlock(m);
+  gt_mutex_state &st = mutex_state(m);
+  st.locked = 0;
+  st.owner = -1;
+  /* wake the first waiter (FIFO — deterministic handoff order) */
+  while (!st.waiters.empty()) {
+    int tid = st.waiters.front();
+    st.waiters.erase(st.waiters.begin());
+    gt_thread *w = (tid >= 0 && tid < g_nthreads) ? g_threads[tid] : NULL;
+    if (w && w->state == GT_BLOCKED && w->wait_kind == W_MUTEX) {
+      w->state = GT_RUNNABLE;
+      w->wait_kind = W_NONE;
+      break;
+    }
+  }
+  return 0;
+}
+
+/* -- condition variables -- */
+
+static std::vector<int> &cond_waiters(const void *c) {
+  if (!g_cond_waiters)
+    g_cond_waiters = new std::map<const void *, std::vector<int>>();
+  return (*g_cond_waiters)[c];
+}
+
+extern "C" int pthread_cond_wait(pthread_cond_t *c, pthread_mutex_t *m) {
+  resolve_pthread_reals();
+  if (!g_engaged) return real_cond_wait(c, m);
+  cond_waiters(c).push_back(g_current->tid);
+  pthread_mutex_unlock(m);
+  g_current->state = GT_BLOCKED;
+  g_current->wait_kind = W_COND;
+  g_current->wait_obj = c;
+  gt_switch_to_scheduler();
+  pthread_mutex_lock(m);
+  return 0;
+}
+
+extern "C" int pthread_cond_timedwait(pthread_cond_t *c, pthread_mutex_t *m,
+                                      const struct timespec *abstime) {
+  resolve_pthread_reals();
+  if (!g_engaged) {
+    static int (*real_tw)(pthread_cond_t *, pthread_mutex_t *,
+                          const struct timespec *);
+    if (!real_tw)
+      *(void **)(&real_tw) = dlsym(RTLD_NEXT, "pthread_cond_timedwait");
+    return real_tw(c, m, abstime);
+  }
+  /* abstime is CLOCK_REALTIME = emulated epoch + vtime */
+  extern int64_t shd_epoch_ns(void);
+  int64_t deadline =
+      (int64_t)abstime->tv_sec * 1000000000LL + abstime->tv_nsec -
+      shd_epoch_ns();
+  cond_waiters(c).push_back(g_current->tid);
+  pthread_mutex_unlock(m);
+  g_current->state = GT_BLOCKED;
+  g_current->wait_kind = W_SLEEP;   /* cond with deadline: sleep-like wait */
+  g_current->wait_obj = c;
+  g_current->wait_deadline = deadline;
+  g_current->deadline_fired = 0;
+  gt_switch_to_scheduler();
+  int timed_out = g_current->deadline_fired;
+  /* drop our waiter registration if the timeout (not a signal) woke us */
+  std::vector<int> &ws = cond_waiters(c);
+  for (size_t i = 0; i < ws.size(); i++) {
+    if (ws[i] == g_current->tid) {
+      ws.erase(ws.begin() + i);
+      break;
+    }
+  }
+  pthread_mutex_lock(m);
+  return timed_out ? ETIMEDOUT : 0;
+}
+
+static void cond_wake(const void *c, int all) {
+  std::vector<int> &ws = cond_waiters(c);
+  while (!ws.empty()) {
+    int tid = ws.front();
+    ws.erase(ws.begin());
+    gt_thread *w = (tid >= 0 && tid < g_nthreads) ? g_threads[tid] : NULL;
+    if (w && w->state == GT_BLOCKED &&
+        (w->wait_kind == W_COND || w->wait_kind == W_SLEEP) &&
+        w->wait_obj == c) {
+      w->state = GT_RUNNABLE;
+      w->wait_kind = W_NONE;
+      w->deadline_fired = 0;
+      if (!all) break;
+    }
+  }
+}
+
+extern "C" int pthread_cond_signal(pthread_cond_t *c) {
+  resolve_pthread_reals();
+  if (!g_engaged) return real_cond_signal(c);
+  cond_wake(c, 0);
+  return 0;
+}
+
+extern "C" int pthread_cond_broadcast(pthread_cond_t *c) {
+  resolve_pthread_reals();
+  if (!g_engaged) return real_cond_broadcast(c);
+  cond_wake(c, 1);
+  return 0;
+}
+
+/* -- thread-specific data (keys shared with real impl before engage) -- */
+
+static std::map<std::pair<unsigned, int>, const void *> *g_tsd;
+static unsigned g_next_key = 1;
+
+extern "C" int pthread_key_create(pthread_key_t *key,
+                                  void (*destructor)(void *)) {
+  (void)destructor;   /* cooperative teardown: destructors not replayed */
+  if (!g_engaged) {
+    static int (*real_kc)(pthread_key_t *, void (*)(void *));
+    if (!real_kc) *(void **)(&real_kc) = dlsym(RTLD_NEXT, "pthread_key_create");
+    return real_kc(key, destructor);
+  }
+  *key = (pthread_key_t)g_next_key++;
+  return 0;
+}
+
+extern "C" int pthread_setspecific(pthread_key_t key, const void *value) {
+  if (!g_engaged) {
+    static int (*real_ss)(pthread_key_t, const void *);
+    if (!real_ss) *(void **)(&real_ss) = dlsym(RTLD_NEXT, "pthread_setspecific");
+    return real_ss(key, value);
+  }
+  if (!g_tsd)
+    g_tsd = new std::map<std::pair<unsigned, int>, const void *>();
+  (*g_tsd)[{(unsigned)key, g_current->tid}] = value;
+  return 0;
+}
+
+extern "C" void *pthread_getspecific(pthread_key_t key) {
+  if (!g_engaged) {
+    static void *(*real_gs)(pthread_key_t);
+    if (!real_gs) *(void **)(&real_gs) = dlsym(RTLD_NEXT, "pthread_getspecific");
+    return real_gs(key);
+  }
+  if (!g_tsd) return NULL;
+  auto it = g_tsd->find({(unsigned)key, g_current->tid});
+  return it == g_tsd->end() ? NULL : (void *)it->second;
+}

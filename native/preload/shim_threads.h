@@ -1,0 +1,54 @@
+/* Green-thread (cooperative pthread) layer for the shadow_tpu interposer.
+ *
+ * The reference runs multithreaded plugins by routing the whole pthread
+ * family to rpth green threads (process.c pthread_* -> pth_*, rpth/pthread.c)
+ * so plugin "threads" are cooperative coroutines scheduled one at a time
+ * against the virtual clock.  This layer is the same capability for the
+ * split-process design: pthread_create makes a ucontext coroutine inside the
+ * plugin process; blocking libc calls become nonblocking protocol attempts
+ * plus a park; and when every green thread is parked, ONE combined wait
+ * (OP_POLL over all parked fds, or OP_SLEEP to the earliest deadline) blocks
+ * the plugin in the simulator until virtual readiness — which keeps
+ * execution deterministic: exactly one runnable context at any instant, and
+ * context switches happen only at syscall boundaries, like pth's
+ * run-until-block scheduling (process.c:1197).
+ */
+#ifndef SHADOW_TPU_SHIM_THREADS_H
+#define SHADOW_TPU_SHIM_THREADS_H
+
+#include <stdint.h>
+
+/* max fds in one multi-fd park (and in the combined scheduler wait) */
+#define GT_PARK_MAX 64
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* nonzero once pthread_create has been called (gt mode engaged) */
+int gt_engaged(void);
+
+/* nonzero when a blocking wrapper must NOT block the whole process:
+ * >= 2 live green threads exist, so use nonblock attempts + parks */
+int gt_should_park(void);
+
+/* park the current green thread until `handle` has `events`
+ * (POLLIN/POLLOUT); spurious wakeups possible — callers loop */
+void gt_park_fd(int64_t handle, short events);
+
+/* park until virtual time reaches `deadline_ns` */
+void gt_park_sleep(int64_t deadline_ns);
+
+/* park on handle/events with a wakeup deadline; returns 1 if woken before
+ * the deadline might have passed, 0 when the deadline definitely expired */
+int gt_park_fd_deadline(int64_t handle, short events, int64_t deadline_ns);
+
+/* park on several fds at once (poll); entries are (handle, events) pairs */
+void gt_park_fds(const int64_t *handles, const short *events, int n,
+                 int64_t deadline_ns);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SHADOW_TPU_SHIM_THREADS_H */

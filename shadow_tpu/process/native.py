@@ -200,11 +200,12 @@ class NativeKernel:
 
     def op_accept(self, a, b, c, d, payload):
         sock = self._desc(a)
+        nonblock = self._nonblock(sock) or bool(b)
         while True:
             child = sock.accept_child()
             if child is not None:
                 break
-            if self._nonblock(sock):
+            if nonblock:
                 return -errno_mod.EAGAIN, b""
             if self._is_eof(sock):
                 return -errno_mod.EINVAL, b""
@@ -217,7 +218,7 @@ class NativeKernel:
         done = sock.connect_to(int(b), int(c))
         if done:
             return 0, b""
-        if self._nonblock(sock):
+        if self._nonblock(sock) or bool(d):
             return -errno_mod.EINPROGRESS, b""
         yield _Block(sock, S_WRITABLE)
         err = sock.take_socket_error()
@@ -350,7 +351,7 @@ class NativeKernel:
             return 0, desc.read_bytes(int(b))
         if desc.kind == "timer":
             while desc.expire_count == 0:
-                if self._nonblock(desc):
+                if self._nonblock(desc) or bool(c):
                     return -errno_mod.EAGAIN, b""
                 yield _Block(desc, S_READABLE)
             n = desc.read_expirations()
@@ -618,6 +619,9 @@ def run_native_plugin(api, args: List[str], binary: str,
                                          if env.get("LD_PRELOAD") else ""))
     env["SHADOW_TPU_FD"] = str(child_side.fileno())
     env["SHADOW_TPU_EPOCH_NS"] = str(stime.EMULATED_TIME_OFFSET)
+    # deterministic virtual pid (the reference's plugins see their virtual
+    # process id through process_emu_getpid)
+    env["SHADOW_TPU_PID"] = str(api.process.pid)
     if extra_env:
         env.update(extra_env)
     # stdout/stderr go to per-process files (the reference writes each

@@ -463,10 +463,131 @@ static int cmd_halfclient(const char *host, uint16_t port, int64_t nbytes) {
   return 0;
 }
 
+/* ---- pthread scenarios (routed to the shim's cooperative green threads
+ * under simulation, to real pthreads natively — dual execution proves the
+ * cooperative semantics match) ---- */
+#include <pthread.h>
+#include <signal.h>
+#include <sys/utsname.h>
+#include <ifaddrs.h>
+
+static pthread_mutex_t th_lock = PTHREAD_MUTEX_INITIALIZER;
+static pthread_cond_t th_cond = PTHREAD_COND_INITIALIZER;
+static long th_counter = 0;
+static int th_turn = 0;   /* strict alternation: cond enforces the order */
+
+struct th_arg { int id; int iters; };
+
+static void *th_worker(void *argp) {
+  struct th_arg *a = (struct th_arg *)argp;
+  for (int i = 0; i < a->iters; i++) {
+    pthread_mutex_lock(&th_lock);
+    /* strict alternation via condvar: worker id must match the turn */
+    while (th_turn != a->id) pthread_cond_wait(&th_cond, &th_lock);
+    th_counter++;
+    th_turn = 1 - th_turn;
+    pthread_cond_broadcast(&th_cond);
+    pthread_mutex_unlock(&th_lock);
+    /* a virtual-time pause so interleaving crosses sleep parks too */
+    usleep(1000);
+  }
+  return (void *)(long)(a->id + 100);
+}
+
+static int cmd_threads(void) {
+  int iters = 50;
+  pthread_t t1, t2;
+  struct th_arg a1 = {0, iters}, a2 = {1, iters};
+  int64_t t0 = now_ns();
+  if (pthread_create(&t1, NULL, th_worker, &a1) != 0) return 1;
+  if (pthread_create(&t2, NULL, th_worker, &a2) != 0) return 1;
+  void *r1 = NULL, *r2 = NULL;
+  if (pthread_join(t1, &r1) != 0 || pthread_join(t2, &r2) != 0) return 2;
+  if ((long)r1 != 100 || (long)r2 != 101) return 3;
+  if (th_counter != 2L * iters) return 4;
+  int64_t elapsed = now_ns() - t0;
+  /* each worker usleeps 1ms x iters; interleaved they cover ~iters ms of
+   * virtual time at least (loose bound holds natively too) */
+  if (elapsed < (int64_t)iters * 1000000LL / 2) {
+    printf("threads: clock advanced only %lld ns\n", (long long)elapsed);
+    return 6;
+  }
+  printf("threads OK counter=%ld elapsed_ms=%lld\n", th_counter,
+         (long long)(elapsed / 1000000));
+  return 0;
+}
+
+/* one thread serves a TCP connection while the main thread sleeps in
+ * virtual time — proves fd parks and sleep parks coexist */
+static void *th_tcpserver(void *argp) {
+  long port = (long)argp;
+  return (void *)(long)cmd_tcpserver((uint16_t)port, 50000);
+}
+
+static int cmd_mtserver(uint16_t port) {
+  pthread_t t;
+  if (pthread_create(&t, NULL, th_tcpserver, (void *)(long)port) != 0)
+    return 1;
+  for (int i = 0; i < 10; i++) usleep(200000);   /* 2 virtual seconds */
+  void *rv = NULL;
+  if (pthread_join(t, &rv) != 0) return 2;
+  return (int)(long)rv;
+}
+
+static int cmd_miscsys(const char *expected_host) {
+  struct utsname un;
+  if (uname(&un) != 0) return 1;
+  if (strcmp(un.sysname, "Linux") != 0) return 2;
+  if (under_sim() && strcmp(un.nodename, expected_host) != 0) {
+    printf("uname nodename %s != %s\n", un.nodename, expected_host);
+    return 3;
+  }
+  if (getpid() <= 0) return 4;
+  if (under_sim()) {
+    /* fork/exec are ENOSYS stubs inside the simulation */
+    if (fork() != -1 || errno != ENOSYS) return 5;
+    char *const eargv[] = {(char *)"/bin/true", NULL};
+    if (execv("/bin/true", eargv) != -1 || errno != ENOSYS) return 6;
+  }
+  if (signal(SIGUSR1, SIG_IGN) == SIG_ERR) return 7;
+  struct sigaction sa, old;
+  memset(&sa, 0, sizeof sa);
+  sa.sa_handler = SIG_DFL;
+  if (sigaction(SIGUSR2, &sa, &old) != 0) return 8;
+  struct ifaddrs *ifa = NULL;
+  if (getifaddrs(&ifa) != 0 || ifa == NULL) return 9;
+  int saw_lo = 0, saw_eth = 0;
+  for (struct ifaddrs *p = ifa; p; p = p->ifa_next) {
+    if (p->ifa_name && !strcmp(p->ifa_name, "lo")) saw_lo = 1;
+    if (p->ifa_name && (!strncmp(p->ifa_name, "eth", 3) ||
+                        !strncmp(p->ifa_name, "en", 2) ||
+                        !strncmp(p->ifa_name, "wl", 2)))
+      saw_eth = 1;
+  }
+  freeifaddrs(ifa);
+  if (!saw_lo) return 10;
+  if (under_sim() && !saw_eth) return 11;
+  srand(42);
+  int r1 = rand(), r2 = rand();
+  if (r1 < 0 || r2 < 0) return 12;
+  FILE *f = fopen("/dev/urandom", "rb");
+  if (!f) return 13;
+  unsigned char buf[16] = {0}, zero[16] = {0};
+  if (fread(buf, 1, sizeof buf, f) != sizeof buf) { fclose(f); return 14; }
+  fclose(f);
+  if (memcmp(buf, zero, sizeof buf) == 0) return 15;
+  printf("miscsys OK pid=%d node=%s\n", (int)getpid(), un.nodename);
+  return 0;
+}
+
 int main(int argc, char **argv) {
   if (argc < 2) return 64;
   const char *cmd = argv[1];
   if (!strcmp(cmd, "vtime")) return cmd_vtime();
+  if (!strcmp(cmd, "threads")) return cmd_threads();
+  if (!strcmp(cmd, "mtserver") && argc >= 3)
+    return cmd_mtserver((uint16_t)atoi(argv[2]));
+  if (!strcmp(cmd, "miscsys") && argc >= 3) return cmd_miscsys(argv[2]);
   if (!strcmp(cmd, "udpserver") && argc >= 4)
     return cmd_udpserver((uint16_t)atoi(argv[2]), atoi(argv[3]));
   if (!strcmp(cmd, "udpclient") && argc >= 6)

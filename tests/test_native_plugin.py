@@ -29,7 +29,8 @@ def native_bin(tmp_path_factory):
                    capture_output=True)
     out = tmp_path_factory.mktemp("nativebin") / "testapp"
     subprocess.run(["gcc", "-O1", "-o", str(out),
-                    os.path.join(REPO, "tests", "native_src", "testapp.c")],
+                    os.path.join(REPO, "tests", "native_src", "testapp.c"),
+                    "-lpthread"],
                    check=True, capture_output=True)
     return str(out)
 
@@ -217,3 +218,68 @@ def test_native_mixed_with_python_plugin(native_bin):
     rc, ctrl = run_sim(xml)
     assert rc == 0
     assert exit_codes(ctrl, "client") == {"client": [0]}
+
+
+def test_native_pthreads_dual_execution(native_bin):
+    """Two pthreads + mutex + condvar alternation, run natively (real
+    pthreads) and simulated (the shim's cooperative green threads, the
+    rpth-capability analog).  Exit code 0 both ways is the oracle
+    (reference: src/test/pthreads)."""
+    native = subprocess.run([native_bin, "threads"], timeout=30)
+    assert native.returncode == 0
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_bin}" />
+          <host id="node">
+            <process plugin="app" starttime="1" arguments="threads" />
+          </host>
+        </shadow>
+    """)
+    t0 = time.monotonic()
+    rc, ctrl = run_sim(xml)
+    wall = time.monotonic() - t0
+    assert rc == 0
+    assert exit_codes(ctrl, "node") == {"node": [0]}
+    # 100 x 1ms virtual usleeps must not leak into wall time
+    assert wall < 5.0
+
+
+def test_native_threaded_tcp_server(native_bin):
+    """One green thread serves TCP while the main thread sleeps: fd parks
+    and sleep parks coexist in one plugin process."""
+    nbytes = 50_000
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="60">
+          <plugin id="app" path="{native_bin}" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="1" arguments="mtserver 8002" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="2"
+                     arguments="tcpclient server 8002 {nbytes}" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "server", "client") == \
+        {"server": [0], "client": [0]}
+
+
+def test_native_miscsys(native_bin):
+    """uname/getpid/fork-ENOSYS/exec-ENOSYS/signal/getifaddrs/rand/fopen
+    surface (reference: process.c misc emu families), dual execution."""
+    native = subprocess.run([native_bin, "miscsys", "ignored"], timeout=30)
+    assert native.returncode == 0
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_bin}" />
+          <host id="mynode">
+            <process plugin="app" starttime="1"
+                     arguments="miscsys mynode" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "mynode") == {"mynode": [0]}
