@@ -39,7 +39,7 @@ enum shadow_tpu_op {
   SHD_OP_CONNECT = 5,       /* a=fd b=ip c=port d=nonblock */
   SHD_OP_SEND = 6,          /* a=fd b=nonblock, payload data -> n */
   SHD_OP_SENDTO = 7,        /* a=fd b=nonblock c=ip d=port, payload -> n */
-  SHD_OP_RECV = 8,          /* a=fd b=maxlen c=nonblock -> payload data */
+  SHD_OP_RECV = 8,          /* a=fd b=maxlen c=nonblock d=peek -> payload */
   SHD_OP_RECVFROM = 9,      /* a=fd b=maxlen c=nonblock -> u32 ip u16 port data */
   SHD_OP_CLOSE = 10,        /* a=fd */
   SHD_OP_EPOLL_CREATE = 11, /* -> fd */

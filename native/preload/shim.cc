@@ -577,12 +577,13 @@ extern "C" ssize_t sendto(int fd, const void *buf, size_t n, int flags,
 extern "C" ssize_t recv(int fd, void *buf, size_t n, int flags) {
   if (!is_sim_fd(fd)) return REAL(recv)(fd, buf, n, flags);
   int app_nb = nb_flag(flags) || g_fd_nonblock[fd];
+  int peek = (flags & MSG_PEEK) ? 1 : 0;
   size_t total = 0;
   for (;;) {
     uint32_t got = 0;
     int park = gt_should_park() && !app_nb;
     int64_t r = transact(SHD_OP_RECV, to_handle(fd), (int64_t)(n - total),
-                         (app_nb || park) ? 1 : 0, 0, NULL, 0,
+                         (app_nb || park) ? 1 : 0, peek, NULL, 0,
                          (char *)buf + total, (uint32_t)(n - total), &got);
     if (r < 0) {
       if (park && errno == EAGAIN) {
@@ -593,7 +594,7 @@ extern "C" ssize_t recv(int fd, void *buf, size_t n, int flags) {
     }
     if (got == 0) return (ssize_t)total; /* EOF */
     total += got;
-    if (!((flags & MSG_WAITALL) && total < n)) return (ssize_t)total;
+    if (peek || !((flags & MSG_WAITALL) && total < n)) return (ssize_t)total;
   }
 }
 

@@ -39,4 +39,4 @@ def resolve(path: str) -> Callable:
 
 def _ensure_builtins() -> None:
     from . import (echo, filetransfer, tgen, phold, blast,  # noqa: F401
-                   tor, bitcoin)  # noqa: F401
+                   tor, bitcoin, httpd)  # noqa: F401

@@ -332,6 +332,22 @@ class TCPSocket(Socket):
         self._update_writable()
         return n
 
+    def peek_user_data(self, nbytes: int):
+        """MSG_PEEK: copy up to nbytes of in-order data without consuming
+        (reference socket buffers support peeking the same way; real HTTP
+        clients like wget peek the response head before reading it)."""
+        if not self.read_queue:
+            if self.eof_received or self.error is not None:
+                return b"", self.peer_ip or 0, self.peer_port or 0
+            return None
+        out = bytearray()
+        for chunk in self.read_queue:
+            take = nbytes - len(out)
+            if take <= 0:
+                break
+            out.extend(chunk[:take])
+        return bytes(out), self.peer_ip or 0, self.peer_port or 0
+
     def receive_user_data(self, nbytes: int):
         if not self.read_queue:
             if self.eof_received or self.error is not None:

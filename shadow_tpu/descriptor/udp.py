@@ -48,6 +48,13 @@ class UDPSocket(Socket):
         return len(data)
 
     # -- receive -----------------------------------------------------------
+    def peek_user_data(self, nbytes: int):
+        """MSG_PEEK: the next datagram's payload without consuming it."""
+        if not self.in_packets:
+            return None
+        p = self.in_packets[0]
+        return p.payload[:nbytes], p.src_ip, p.src_port
+
     def receive_user_data(self, nbytes: int):
         if not self.in_packets:
             return None

@@ -261,8 +261,15 @@ class NativeKernel:
     def op_recv(self, a, b, c, d, payload):
         sock = self._desc(a)
         nonblock = self._nonblock(sock) or bool(c)
+        peek = bool(d)
         while True:
-            r = self._recv_payload(sock, b)
+            if peek:
+                peeker = getattr(sock, "peek_user_data", None)
+                if peeker is None:
+                    return -errno_mod.EINVAL, b""
+                r = peeker(int(b))
+            else:
+                r = self._recv_payload(sock, b)
             if r is not None:
                 data = r[0] if isinstance(r, tuple) else r
                 return len(data), bytes(data)
