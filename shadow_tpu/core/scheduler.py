@@ -485,10 +485,95 @@ class ThreadPerThreadPolicy(ThreadSinglePolicy):
         return t
 
 
-class ThreadPerHostPolicy(HostQueuesPolicy):
-    """Per-(thread,host) queues (scheduler_policy_thread_perhost.c).  With
-    our per-host locking the host-queue layout already gives the same
-    contention profile; kept as a named policy for config parity."""
+class ThreadPerHostPolicy(SchedulerPolicy):
+    """Per-(thread, src-host) mailboxes + one main queue per thread
+    (scheduler_policy_thread_perhost.c:1-258): a push whose destination host
+    belongs to the pushing thread goes straight into that thread's main
+    queue (:131-134); a cross-thread push lands in the destination thread's
+    per-source-host mailbox (:141-148, locked only when the pusher isn't the
+    destination thread); mailboxes are drained into the main queues at round
+    boundaries (:194-206 getNextTime), so during a round each worker pops
+    its main queue with zero cross-thread contention."""
+
+    def __init__(self):
+        self._main: Dict[int, PriorityQueue] = {}
+        self._main_locks: Dict[int, threading.Lock] = {}
+        self._mailboxes: Dict[tuple, PriorityQueue] = {}  # (dst_wid, src_hid)
+        self._mbox_locks: Dict[int, threading.Lock] = {}  # per dst wid
+        self._assignment: Dict[int, List] = {}
+        self._host_worker: Dict[int, int] = {}
+        self._create_lock = threading.Lock()
+
+    def _ensure_worker(self, wid: int) -> None:
+        if wid not in self._main:
+            with self._create_lock:
+                if wid not in self._main:
+                    self._main_locks[wid] = threading.Lock()
+                    self._mbox_locks[wid] = threading.Lock()
+                    self._main[wid] = PriorityQueue()
+
+    def add_host(self, host, worker_id: int) -> None:
+        self._ensure_worker(worker_id)
+        self._assignment.setdefault(worker_id, []).append(host)
+        self._host_worker[host.id] = worker_id
+
+    def assigned_hosts(self, worker_id: int) -> List:
+        return self._assignment.get(worker_id, [])
+
+    def push(self, event: Event, worker_id: int, barrier: int) -> None:
+        src_hid = event.src_host.id if event.src_host is not None else -1
+        dst_hid = event.dst_host.id if event.dst_host is not None else -1
+        src_wid = self._host_worker.get(src_hid, worker_id)
+        dst_wid = self._host_worker.get(dst_hid, 0)
+        # inter-thread events are delayed to the barrier for causality
+        # (thread_perhost.c:120-124 clamps when the threads differ)
+        if src_wid != dst_wid and event.time < barrier:
+            event.time = barrier
+        self._ensure_worker(dst_wid)
+        if dst_wid == worker_id:
+            with self._main_locks[dst_wid]:
+                self._main[dst_wid].push(event)
+            return
+        with self._mbox_locks[dst_wid]:
+            key = (dst_wid, src_hid)
+            mb = self._mailboxes.get(key)
+            if mb is None:
+                mb = self._mailboxes[key] = PriorityQueue()
+            mb.push(event)
+
+    def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
+        q = self._main.get(worker_id)
+        if q is None:
+            return None
+        with self._main_locks[worker_id]:
+            key = q.peek_key()
+            if key is None or key[0] >= window_end:
+                return None
+            return q.pop()
+
+    def _drain_mailboxes(self) -> None:
+        """Between rounds (quiescent): empty every mailbox into its
+        destination thread's main queue (thread_perhost.c:194-206)."""
+        for (dst_wid, _src), mb in self._mailboxes.items():
+            q = self._main[dst_wid]
+            while True:
+                ev = mb.pop()
+                if ev is None:
+                    break
+                q.push(ev)
+
+    def next_time(self) -> int:
+        self._drain_mailboxes()
+        t = stime.SIM_TIME_MAX
+        for wid, q in self._main.items():
+            key = q.peek_key()
+            if key is not None and key[0] < t:
+                t = key[0]
+        return t
+
+    def pending_count(self) -> int:
+        return (sum(len(q) for q in self._main.values())
+                + sum(len(mb) for mb in self._mailboxes.values()))
 
 
 def make_policy(name: str) -> SchedulerPolicy:

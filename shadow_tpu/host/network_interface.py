@@ -17,9 +17,11 @@ Capability parity with the reference's hot-path component
   (:519-579).
 * pcap capture hook per packet in/out (:337-373).
 
-Under the TPU policy the same token-bucket state is mirrored on device and
-updated vectorially; this class remains the source of truth for CPU policies
-and for the (rare) host-side queries.
+This class is the source of truth for bandwidth state under every scheduler
+policy.  A vectorized device twin of the token-bucket admission math lives
+in ops/bandwidth.py (parity-tested against this implementation); wiring it
+into the tpu policy's round step — so bandwidth drops are decided on device
+— is the remaining north-star integration (BASELINE.json).
 """
 
 from __future__ import annotations

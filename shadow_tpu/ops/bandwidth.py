@@ -1,0 +1,139 @@
+"""Device-side token-bucket admission: the bandwidth term of the north star.
+
+Reference semantics being modeled (host/network_interface.c:421-455 receive
+loop + :93-95/:207-214 refill):
+
+* each host's receive bucket holds ``tokens`` bytes, capacity
+  ``refill * CAPACITY_FACTOR + MTU``, and gains ``refill`` bytes at every
+  1 ms boundary while there is pending work;
+* arriving packets drain in FIFO order; a packet is delivered when the
+  bucket covers its full size, otherwise it waits for the refill tick that
+  covers it.  The capacity cap only binds across idle gaps (a bucket never
+  accumulates past ``capacity``).
+
+The kernel computes one round's per-packet admission time for EVERY host at
+once: the batch is pre-sorted by (dst_row, arrival, order) so each host's
+packets form a contiguous FIFO run, and a single ``lax.scan`` walks the
+sorted batch carrying ``(dst, tick, tokens)`` — exact whole-packet bucket
+semantics, including the idle-gap cap, in one fused device pass.  Per-round
+batches are padded to power-of-two buckets like the hop kernel, so shapes
+compile once.
+
+Exactness is asserted bit-for-bit against the event-driven host
+implementation (the TokenBucket class the CPU policies use) by
+tests/test_bandwidth_ops.py.  Wiring this into the tpu policy's flush —
+so bandwidth-delayed delivery times are decided on device — is the staged
+remaining north-star integration; upstream queue admission (drop-tail /
+CoDel sojourn AQM) stays host-side with the router model.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import defs, stime
+
+REFILL_NS = defs.INTERFACE_REFILL_INTERVAL_NS   # 1 ms
+
+
+def bucket_params(rate_kibps: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Vector twin of host/network_interface.py TokenBucket.__init__."""
+    time_factor = stime.SIM_TIME_SEC // REFILL_NS
+    refill = (np.asarray(rate_kibps).astype(np.int64) * 1024) // time_factor
+    capacity = refill * defs.INTERFACE_CAPACITY_FACTOR + defs.CONFIG_MTU
+    return refill, capacity
+
+
+@jax.jit
+def admit_sorted(dst_rows: jnp.ndarray,      # int32 [N] sorted ascending
+                 sizes: jnp.ndarray,         # int64 [N] packet bytes
+                 arrive: jnp.ndarray,        # int64 [N] ns, sorted within dst
+                 valid: jnp.ndarray,         # bool  [N]
+                 tokens0: jnp.ndarray,       # int64 [H] fill at each host's
+                                             #   first arrival in the batch
+                 refill: jnp.ndarray,        # int64 [H] bytes per 1ms tick
+                 capacity: jnp.ndarray,      # int64 [H] bucket cap
+                 ) -> jnp.ndarray:
+    """FIFO token-bucket admission times for a dst-sorted batch.
+
+    Exact recurrence per host run (= the event-driven drain):
+        start_i = max(arrive_i, admit_{i-1})
+        avail   = min(cap, tokens + refill * (tick(start_i) - tick_state))
+        admit_i = start_i                    if avail >= size_i
+                = (tick(start_i)+k)*REFILL   with k = ceil((size-avail)/refill)
+    carrying (dst, tick_state, tokens, admit) across the scan; the carry
+    resets from tokens0 whenever dst changes (new host's run begins).
+    """
+    def step(carry, x):
+        prev_dst, tick_state, tok, prev_admit = carry
+        dst, size, arr, ok = x
+        new_seg = dst != prev_dst
+        tick_state = jnp.where(new_seg, arr // REFILL_NS, tick_state)
+        tok = jnp.where(new_seg, tokens0[dst], tok)
+        prev_admit = jnp.where(new_seg, jnp.int64(0), prev_admit)
+        ref = jnp.maximum(refill[dst], jnp.int64(1))
+        cap = capacity[dst]
+        start = jnp.maximum(arr, prev_admit)
+        stick = start // REFILL_NS
+        avail = jnp.minimum(cap, tok + ref * (stick - tick_state))
+        kneed = jnp.maximum(size - avail, jnp.int64(0))
+        k = (kneed + ref - 1) // ref
+        admit = jnp.where(kneed > 0, (stick + k) * REFILL_NS, start)
+        tok_after = jnp.minimum(cap, avail + k * ref) - size
+        new_tick = jnp.where(kneed > 0, stick + k, stick)
+        # invalid (padding) lanes leave the carry untouched
+        out_carry = (jnp.where(ok, dst, prev_dst),
+                     jnp.where(ok, new_tick, tick_state),
+                     jnp.where(ok, tok_after, tok),
+                     jnp.where(ok, admit, prev_admit))
+        return out_carry, jnp.where(ok, admit, jnp.int64(0))
+
+    init = (jnp.int32(-1), jnp.int64(0), jnp.int64(0), jnp.int64(0))
+    _, admits = jax.lax.scan(step, init,
+                             (dst_rows, sizes, arrive, valid))
+    return admits
+
+
+class BandwidthKernel:
+    """Host-side wrapper: sorts a round's batch by (dst, arrival, order),
+    runs :func:`admit_sorted`, and scatters results back to batch order."""
+
+    def __init__(self, rate_down_kibps: np.ndarray):
+        refill, capacity = bucket_params(rate_down_kibps)
+        self.refill = jnp.asarray(refill)
+        self.capacity = jnp.asarray(capacity)
+        self.capacity_np = capacity
+        self.device_calls = 0
+
+    def admit(self, dst_rows: np.ndarray, sizes: np.ndarray,
+              arrive: np.ndarray, tokens0: np.ndarray) -> np.ndarray:
+        """Admission time per packet (batch order)."""
+        n = len(dst_rows)
+        if n == 0:
+            return np.empty(0, dtype=np.int64)
+        b = 1 << max(8, int(np.ceil(np.log2(n))))
+        order = np.lexsort((np.arange(n), arrive, dst_rows))
+        inv = np.empty(n, dtype=np.int64)
+        inv[order] = np.arange(n)
+
+        def pad(a, fill=0):
+            out = np.full(b, fill, dtype=a.dtype)
+            out[:n] = a
+            return out
+
+        valid = np.zeros(b, dtype=bool)
+        valid[:n] = True
+        admits = admit_sorted(
+            jnp.asarray(pad(dst_rows[order].astype(np.int32))),
+            jnp.asarray(pad(sizes[order].astype(np.int64))),
+            jnp.asarray(pad(arrive[order].astype(np.int64))),
+            jnp.asarray(valid),
+            jnp.asarray(np.asarray(tokens0, dtype=np.int64)),
+            self.refill, self.capacity)
+        self.device_calls += 1
+        return np.asarray(admits)[:n][inv]
