@@ -1,0 +1,300 @@
+/* shadow_pool — one OS process hosting many native plugin instances.
+ *
+ * The reference loads thousands of plugin namespaces into ONE process with
+ * its custom elf-loader (src/external/elf-loader dlmopen + per-namespace
+ * static TLS, SURVEY.md §2.7).  This helper is the same capability built on
+ * glibc's own dlmopen: each plugin instance is a `.so` (linked against
+ * libshadow_preload.so, exactly as reference plugins link shadow's libs)
+ * loaded into a fresh link-map namespace — its globals, its libc state, and
+ * its copy of the interposer shim are all private to the instance.
+ *
+ * Scheduling: every instance runs on a ucontext coroutine.  The instance's
+ * shim parks it (shd_set_pool_hooks) whenever a protocol transaction waits
+ * for the simulator's response, and the pool's poll() loop resumes whichever
+ * parked instance has a readable protocol fd — deterministic: one instance
+ * runs at a time, switches happen only at protocol boundaries, ready fds
+ * are served in fixed instance order.
+ *
+ * Control protocol on fd CONTROL_FD (a socketpair from the simulator):
+ *   ADD:  u32 len | u32 op=1 | i64 virtual_pid | argv bytes (NUL-separated,
+ *         argv[0] = absolute .so path), with the instance's protocol fd
+ *         attached via SCM_RIGHTS.
+ * The pool exits when the control fd closes and all instances are done.
+ *
+ * Capacity: glibc allows 16 link-map namespaces (DL_NNS); the simulator
+ * caps instances per pool below that and spawns additional pools.
+ */
+
+#define _GNU_SOURCE 1
+#include <dlfcn.h>
+#include <errno.h>
+#include <poll.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <ucontext.h>
+#include <unistd.h>
+
+/* control fd number is inherited; the simulator tells us which one via
+ * $SHADOW_POOL_CONTROL_FD (defaults to 3) */
+static int CONTROL_FD = 3;
+#define MAX_INSTANCES 13        /* < DL_NNS(16), headroom for base + spares */
+#define STACK_SIZE (1024 * 1024)
+
+enum { INST_EMPTY = 0, INST_RUNNABLE, INST_PARKED, INST_DONE };
+
+struct instance {
+  int state;
+  int fd;                 /* protocol fd (also in the instance's env copy) */
+  long vpid;
+  char *argv_buf;
+  char *argv[64];
+  int argc;
+  void *handle;           /* dlmopen handle of the plugin .so */
+  ucontext_t ctx;
+  char *stack;
+  int exit_status;
+  int64_t (*transact)(uint32_t, int64_t, int64_t, int64_t, int64_t,
+                      const void *, uint32_t, void *, uint32_t, uint32_t *);
+};
+
+static struct instance g_inst[MAX_INSTANCES];
+static int g_ninst = 0;
+static ucontext_t g_pool_ctx;
+static struct instance *g_current = NULL;
+static int g_control_open = 1;
+
+/* ---- hooks installed into each instance's shim copy ---- */
+
+static void pool_wait_readable(int fd) {
+  (void)fd;
+  struct instance *self = g_current;
+  self->state = INST_PARKED;
+  swapcontext(&self->ctx, &g_pool_ctx);
+  /* resumed: our fd is readable (or we are being torn down) */
+}
+
+static void pool_instance_exit(int status) {
+  struct instance *self = g_current;
+  self->exit_status = status;
+  self->state = INST_DONE;
+  if (self->fd >= 0) {
+    close(self->fd);
+    self->fd = -1;
+  }
+  swapcontext(&self->ctx, &g_pool_ctx);
+  /* a DONE instance must never resume */
+  fprintf(stderr, "shadow_pool: resumed finished instance\n");
+  _exit(70);
+}
+
+/* ---- instance bootstrap ---- */
+
+static void instance_tramp(unsigned int hi, unsigned int lo) {
+  struct instance *in =
+      (struct instance *)(((uintptr_t)hi << 32) | (uintptr_t)lo);
+  int (*pmain)(int, char **) =
+      (int (*)(int, char **))dlsym(in->handle, "main");
+  int rc = 127;
+  if (pmain)
+    rc = pmain(in->argc, in->argv);
+  else
+    fprintf(stderr, "shadow_pool: %s exports no main()\n", in->argv[0]);
+  /* report the exit code on the instance's own protocol channel */
+  if (in->transact && in->fd >= 0)
+    in->transact(30 /* SHD_OP_EXIT */, rc, 0, 0, 0, NULL, 0, NULL, 0, NULL);
+  pool_instance_exit(rc);
+}
+
+static int start_instance(long vpid, int proto_fd, char *argv_buf,
+                          size_t buf_len) {
+  if (g_ninst >= MAX_INSTANCES) {
+    fprintf(stderr, "shadow_pool: namespace capacity exceeded\n");
+    return -1;
+  }
+  struct instance *in = &g_inst[g_ninst];
+  memset(in, 0, sizeof *in);
+  in->fd = proto_fd;
+  in->vpid = vpid;
+  in->argv_buf = argv_buf;
+  /* split NUL-separated argv */
+  size_t off = 0;
+  while (off < buf_len && in->argc < 63) {
+    in->argv[in->argc++] = argv_buf + off;
+    off += strlen(argv_buf + off) + 1;
+  }
+  in->argv[in->argc] = NULL;
+  if (in->argc == 0) return -1;
+
+  /* the shim copy inside the new namespace reads its config from the
+   * environment during dlmopen (its constructor), so publish this
+   * instance's values just-in-time — the pool is single-threaded */
+  char fdbuf[16], pidbuf[24];
+  snprintf(fdbuf, sizeof fdbuf, "%d", proto_fd);
+  snprintf(pidbuf, sizeof pidbuf, "%ld", vpid);
+  setenv("SHADOW_TPU_FD", fdbuf, 1);
+  setenv("SHADOW_TPU_PID", pidbuf, 1);
+
+  in->handle = dlmopen(LM_ID_NEWLM, in->argv[0], RTLD_NOW | RTLD_LOCAL);
+  if (!in->handle) {
+    fprintf(stderr, "shadow_pool: dlmopen(%s) failed: %s\n", in->argv[0],
+            dlerror());
+    return -1;
+  }
+  /* install the park/exit hooks into this namespace's shim copy */
+  void (*set_hooks)(void (*)(int), void (*)(int)) =
+      (void (*)(void (*)(int), void (*)(int)))dlsym(in->handle,
+                                                    "shd_set_pool_hooks");
+  if (!set_hooks) {
+    fprintf(stderr, "shadow_pool: %s is not linked against "
+            "libshadow_preload.so\n", in->argv[0]);
+    return -1;
+  }
+  set_hooks(pool_wait_readable, pool_instance_exit);
+  *(void **)(&in->transact) = dlsym(in->handle, "shd_transact");
+
+  in->stack = (char *)malloc(STACK_SIZE);
+  getcontext(&in->ctx);
+  in->ctx.uc_stack.ss_sp = in->stack;
+  in->ctx.uc_stack.ss_size = STACK_SIZE;
+  in->ctx.uc_link = NULL;
+  uintptr_t p = (uintptr_t)in;
+  makecontext(&in->ctx, (void (*)())instance_tramp, 2,
+              (unsigned int)(p >> 32), (unsigned int)(p & 0xFFFFFFFFu));
+  in->state = INST_RUNNABLE;
+  g_ninst++;
+  return 0;
+}
+
+/* ---- control channel ---- */
+
+static int read_full(int fd, void *buf, size_t n) {
+  char *p = (char *)buf;
+  while (n) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    if (r == 0) return -1;
+    p += r;
+    n -= (size_t)r;
+  }
+  return 0;
+}
+
+static void handle_control(void) {
+  /* one ADD message: header (16 bytes) + argv payload, 1 fd attached */
+  unsigned char hdr[16];
+  struct iovec iov = {hdr, sizeof hdr};
+  union {
+    struct cmsghdr align;
+    char buf[CMSG_SPACE(sizeof(int))];
+  } cmsgu;
+  struct msghdr msg;
+  memset(&msg, 0, sizeof msg);
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  msg.msg_control = cmsgu.buf;
+  msg.msg_controllen = sizeof cmsgu.buf;
+  ssize_t r = recvmsg(CONTROL_FD, &msg, MSG_WAITALL);
+  if (r <= 0) {
+    g_control_open = 0;
+    close(CONTROL_FD);
+    return;
+  }
+  if (r < (ssize_t)sizeof hdr &&
+      read_full(CONTROL_FD, hdr + r, sizeof hdr - r) != 0) {
+    g_control_open = 0;
+    return;
+  }
+  uint32_t len, op;
+  int64_t vpid;
+  memcpy(&len, hdr, 4);
+  memcpy(&op, hdr + 4, 4);
+  memcpy(&vpid, hdr + 8, 8);
+  int proto_fd = -1;
+  struct cmsghdr *cm = CMSG_FIRSTHDR(&msg);
+  if (cm && cm->cmsg_type == SCM_RIGHTS)
+    memcpy(&proto_fd, CMSG_DATA(cm), sizeof proto_fd);
+  uint32_t plen = len - 16;
+  char *payload = (char *)malloc(plen + 1);
+  if (plen && read_full(CONTROL_FD, payload, plen) != 0) {
+    free(payload);
+    g_control_open = 0;
+    return;
+  }
+  payload[plen] = '\0';
+  if (op == 1 && proto_fd >= 0) {
+    if (start_instance(vpid, proto_fd, payload, plen) != 0) {
+      close(proto_fd);   /* sim sees EOF = instance failed to start */
+      free(payload);
+    }
+    /* payload ownership moved to the instance on success */
+  } else {
+    free(payload);
+  }
+}
+
+int main(void) {
+  const char *cf = getenv("SHADOW_POOL_CONTROL_FD");
+  if (cf && *cf) CONTROL_FD = atoi(cf);
+  for (;;) {
+    /* run every runnable instance to its next park (fixed order) */
+    int progressed = 1;
+    while (progressed) {
+      progressed = 0;
+      for (int i = 0; i < g_ninst; i++) {
+        if (g_inst[i].state == INST_RUNNABLE) {
+          progressed = 1;
+          g_current = &g_inst[i];
+          g_inst[i].state = INST_PARKED;  /* park unless it re-marks */
+          swapcontext(&g_pool_ctx, &g_inst[i].ctx);
+          g_current = NULL;
+        }
+      }
+    }
+    int alive = 0;
+    for (int i = 0; i < g_ninst; i++)
+      if (g_inst[i].state != INST_DONE) alive++;
+    if (!g_control_open && alive == 0) return 0;
+
+    /* poll: control fd + every parked instance's protocol fd */
+    struct pollfd pfds[MAX_INSTANCES + 1];
+    int idx_map[MAX_INSTANCES + 1];
+    int n = 0;
+    if (g_control_open) {
+      pfds[n].fd = CONTROL_FD;
+      pfds[n].events = POLLIN;
+      idx_map[n] = -1;
+      n++;
+    }
+    for (int i = 0; i < g_ninst; i++) {
+      if (g_inst[i].state == INST_PARKED && g_inst[i].fd >= 0) {
+        pfds[n].fd = g_inst[i].fd;
+        pfds[n].events = POLLIN;
+        idx_map[n] = i;
+        n++;
+      }
+    }
+    if (n == 0) {
+      if (!g_control_open) return 0;
+      continue;
+    }
+    int rv = poll(pfds, (nfds_t)n, -1);
+    if (rv < 0) {
+      if (errno == EINTR) continue;
+      return 1;
+    }
+    for (int k = 0; k < n; k++) {
+      if (!(pfds[k].revents & (POLLIN | POLLHUP | POLLERR))) continue;
+      if (idx_map[k] < 0) {
+        handle_control();
+      } else {
+        g_inst[idx_map[k]].state = INST_RUNNABLE;
+      }
+    }
+  }
+}

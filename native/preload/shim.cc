@@ -56,7 +56,25 @@ static int g_sock = -1;              /* protocol socketpair fd            */
 static int64_t g_vtime_ns = 0;       /* cached virtual time               */
 static int64_t g_epoch_ns = 0;       /* emulated-epoch offset             */
 static int g_active = 0;             /* simulator attached?               */
+static long g_virtual_pid = 0;       /* cached at init (pooled instances
+                                        share the env, so a live getenv
+                                        would read a sibling's pid)       */
 static pthread_mutex_t g_lock = PTHREAD_MUTEX_INITIALIZER;
+
+/* Pool mode (native/pool/pool_main.cc): many plugin instances live in one
+ * OS process, each in its own dlmopen namespace with its own copy of this
+ * shim.  The pool installs two hooks per namespace: wait_readable parks
+ * the instance's context until its protocol fd has a response (so sibling
+ * instances run meanwhile), and on_exit retires the instance without
+ * taking the whole pool down. */
+static void (*g_pool_wait_readable)(int fd) = NULL;
+static void (*g_pool_exit)(int status) = NULL;
+
+extern "C" void shd_set_pool_hooks(void (*wait_readable)(int fd),
+                                   void (*on_exit_fn)(int status)) {
+  g_pool_wait_readable = wait_readable;
+  g_pool_exit = on_exit_fn;
+}
 
 /* App-visible fds for simulated descriptors are allocated densely from
  * SHADOW_TPU_SIM_FD_BASE so they stay below FD_SETSIZE (select must work);
@@ -164,6 +182,8 @@ __attribute__((constructor)) static void shim_init(void) {
     g_active = 1;
     const char *ep = getenv(SHADOW_TPU_ENV_EPOCH);
     g_epoch_ns = ep ? strtoll(ep, NULL, 10) : 0;
+    const char *vp = getenv("SHADOW_TPU_PID");
+    g_virtual_pid = vp ? atol(vp) : 0;
     /* sync the cached clock to the process's virtual start time (the
      * reference's plugins see worker_getEmulatedTime from their first
      * instruction; our cache must match before main() runs) */
@@ -250,11 +270,14 @@ static int64_t transact(uint32_t op, int64_t a, int64_t b, int64_t c,
     errno = EPIPE;
     return -1;
   }
+  if (g_pool_wait_readable)
+    g_pool_wait_readable(g_sock);   /* park; siblings run until response */
   unsigned char rhdr[SHD_RESP_HDR_LEN];
   if (raw_read_full(rhdr, sizeof rhdr) != 0) {
     pthread_mutex_unlock(&g_lock);
     /* Simulator closed the channel: the virtual host was shut down.  Exit
      * quietly like a process whose machine powered off. */
+    if (g_pool_exit) g_pool_exit(0);   /* retire just this instance */
     syscall(SYS_exit_group, 0);
     errno = EPIPE;
     return -1;
@@ -307,6 +330,7 @@ extern "C" int64_t shd_transact(uint32_t op, int64_t a, int64_t b, int64_t c,
 extern "C" int64_t shd_vtime_ns(void) { return g_vtime_ns; }
 extern "C" int64_t shd_epoch_ns(void) { return g_epoch_ns; }
 extern "C" int shd_active(void) { return g_active; }
+extern "C" long shd_virtual_pid(void) { return g_virtual_pid; }
 
 /* --------------------------------------------------------------- helpers -- */
 
@@ -1289,5 +1313,6 @@ extern "C" void exit(int status) {
   static void (*real_exit)(int) __attribute__((noreturn)) = NULL;
   if (!real_exit) *(void **)(&real_exit) = dlsym(RTLD_NEXT, "exit");
   if (g_active) transact0(SHD_OP_EXIT, status, 0, 0, 0);
+  if (g_pool_exit) g_pool_exit(status);   /* retire only this instance */
   real_exit(status);
 }

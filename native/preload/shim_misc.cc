@@ -40,12 +40,14 @@ extern "C" int shd_close_appfd(int fd);
 
 /* ------------------------------------------------------------ identity -- */
 
+extern "C" long shd_virtual_pid(void);
+
 extern "C" pid_t getpid(void) {
   static pid_t (*real_getpid)(void);
   if (!real_getpid) *(void **)(&real_getpid) = dlsym(RTLD_NEXT, "getpid");
   if (!shd_active()) return real_getpid();
-  const char *p = getenv("SHADOW_TPU_PID");
-  return p && *p ? (pid_t)atoi(p) : real_getpid();
+  long vp = shd_virtual_pid();
+  return vp > 0 ? (pid_t)vp : real_getpid();
 }
 
 extern "C" pid_t getppid(void) {

@@ -24,6 +24,12 @@ def resolve(path: str) -> Callable:
     if path.startswith("exec:"):
         from ..process.native import make_native_app
         return make_native_app(path[5:])
+    if path.startswith("pool:") or (path.endswith(".so")
+                                    and os.path.isfile(path)):
+        # shared-object plugins are pooled: many dlmopen namespaces per
+        # helper process (the reference's elf-loader model)
+        from ..process.native import make_pooled_app
+        return make_pooled_app(path[5:] if path.startswith("pool:") else path)
     name = path[7:] if path.startswith("python:") else path
     _ensure_builtins()
     if name in _APPS:

@@ -737,3 +737,123 @@ def make_native_app(binary: str):
         rc = yield from run_native_plugin(api, args, binary)
         return rc
     return app_main
+
+
+# ---------------------------------------------------------------------------
+# Pooled plugins: many instances per OS process (native/pool/pool_main.cc).
+#
+# The reference hosts thousands of plugin namespaces in ONE process via its
+# custom elf-loader (dlmopen, SURVEY.md §2.7); shadow_pool is the same
+# capability on glibc dlmopen.  Plugins must be `.so`s linked against
+# libshadow_preload.so (reference plugins likewise link shadow's libs).
+# Each pool holds up to POOL_CAPACITY instances (glibc's DL_NNS namespace
+# limit is 16); the manager spawns additional pools as needed, so N
+# instances cost ceil(N / POOL_CAPACITY) OS processes instead of N.
+# ---------------------------------------------------------------------------
+
+POOL_CAPACITY = 13
+_POOL_BIN = os.path.join(os.path.dirname(_PRELOAD_LIB), "shadow_pool")
+
+
+class NativePool:
+    """One shadow_pool helper process + its control channel."""
+
+    def __init__(self):
+        self.control, child_control = real_socket.socketpair()
+        env = dict(os.environ)
+        env.pop("SHADOW_TPU_FD", None)  # the pool itself is not interposed
+        # every dlmopen namespace carries its own libc/shim static TLS; the
+        # default surplus covers ~10 namespaces, so raise it (the reference
+        # solved the same problem by computing LD_STATIC_TLS_EXTRA before
+        # re-exec, main.c:283-320 — glibc 2.35+ exposes it as a tunable)
+        tls = "glibc.rtld.optional_static_tls=4096000"
+        env["GLIBC_TUNABLES"] = (env["GLIBC_TUNABLES"] + ":" + tls
+                                 if env.get("GLIBC_TUNABLES") else tls)
+        # let pooled plugin .so's resolve libshadow_preload.so
+        lib_dir = os.path.dirname(_PRELOAD_LIB)
+        env["LD_LIBRARY_PATH"] = (lib_dir + ":" + env["LD_LIBRARY_PATH"]
+                                  if env.get("LD_LIBRARY_PATH") else lib_dir)
+        # pass_fds preserves the parent's fd number; tell the pool which
+        env["SHADOW_POOL_CONTROL_FD"] = str(child_control.fileno())
+        self.proc = subprocess.Popen(
+            [_POOL_BIN], env=env, pass_fds=(child_control.fileno(),),
+            stdout=subprocess.DEVNULL, close_fds=True)
+        child_control.close()
+        _live_children.append(self.proc)
+        self.count = 0
+
+    def add_instance(self, so_path: str, args: List[str], vpid: int):
+        """Returns the simulator-side protocol socket for the new instance."""
+        sim_side, inst_side = real_socket.socketpair()
+        argv = [so_path] + list(args)
+        payload = b"".join(a.encode() + b"\0" for a in argv)
+        hdr = struct.pack("<IIq", 16 + len(payload), 1, int(vpid))
+        real_socket.send_fds(self.control, [hdr + payload],
+                             [inst_side.fileno()])
+        inst_side.close()
+        self.count += 1
+        return sim_side
+
+    def close(self) -> None:
+        try:
+            self.control.close()
+        except OSError:
+            pass
+
+
+def _pool_for(engine) -> NativePool:
+    pools = getattr(engine, "_native_pools", None)
+    if pools is None:
+        pools = engine._native_pools = []
+    if not pools or pools[-1].count >= POOL_CAPACITY \
+            or pools[-1].proc.poll() is not None:
+        pools.append(NativePool())
+    return pools[-1]
+
+
+def run_pooled_plugin(api, args: List[str], so_path: str):
+    """App-main generator serving one pooled plugin instance: same protocol
+    loop as run_native_plugin, but the instance lives inside a shared
+    shadow_pool process instead of its own."""
+    log = get_logger()
+    name = api.process.name
+    engine = api.host.engine
+    pool = _pool_for(engine)
+    try:
+        sim_side = pool.add_instance(so_path, args, api.process.pid)
+    except OSError as e:
+        log.warning("native", f"{name}: pool add_instance failed: {e}")
+        return 127
+    kernel = NativeKernel(api, sim_side)
+    try:
+        while True:
+            hdr = _read_exact(sim_side, REQ_HDR.size)
+            if hdr is None:
+                break
+            length, op, a, b, c, d = REQ_HDR.unpack(hdr)
+            plen = length - REQ_HDR.size
+            payload = b""
+            if plen > 0:
+                payload = _read_exact(sim_side, plen)
+                if payload is None:
+                    break
+            ret, resp_payload = yield from kernel.dispatch(op, a, b, c, d,
+                                                           payload)
+            resp = RESP_HDR.pack(RESP_HDR.size + len(resp_payload), 0,
+                                 int(ret), api.now_ns()) + resp_payload
+            try:
+                sim_side.sendall(resp)
+            except OSError:
+                break
+    finally:
+        sim_side.close()
+    return kernel.exit_code if kernel.exit_code is not None else 0
+
+
+def make_pooled_app(so_path: str):
+    """Registry adapter for `.so` plugins: hosted in shared pool processes,
+    ceil(N/13) OS processes for N instances."""
+    def app_main(api, args):
+        rc = yield from run_pooled_plugin(api, args, so_path)
+        return rc
+    return app_main
