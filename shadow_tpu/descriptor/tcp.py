@@ -115,6 +115,7 @@ class TCPSocket(Socket):
         # --- congestion / loss state ---
         self.cong = None
         self.tally = make_tally()
+        self._tally_dirty = False
         self.dup_ack_count = 0
         self.last_ack_rcvd = 0
         # --- RTT / RTO (RFC 6298; tcp.c:991) ---
@@ -377,12 +378,17 @@ class TCPSocket(Socket):
     def _flush(self) -> None:
         if self.state == CLOSED:
             return
-        # 1. retransmit ranges the tally marked lost
-        lost = self.tally.lost_ranges()
-        if lost:
-            self.tally.clear_lost()
-            for b, e in lost:
-                self._retransmit_range(b, e)
+        # 1. retransmit ranges the tally marked lost.  The dirty flag is set
+        # by the two loss-marking paths (dup-ACK tally update, fast
+        # retransmit) so the vast majority of flushes skip the native-lib
+        # range query entirely.
+        if self._tally_dirty:
+            self._tally_dirty = False
+            lost = self.tally.lost_ranges()
+            if lost:
+                self.tally.clear_lost()
+                for b, e in lost:
+                    self._retransmit_range(b, e)
         # 2. new data within min(cwnd, peer window).  The send buffer is a
         # byte STREAM: small app writes coalesce into full-MSS segments,
         # exactly like the reference segmentizing its buffered user bytes
@@ -746,6 +752,7 @@ class TCPSocket(Socket):
             self.dup_ack_count += 1
             self.tally.update_lost(self.snd_una, self.snd_nxt,
                                    self.dup_ack_count)
+            self._tally_dirty = True
             if self.cong is not None \
                     and self.cong.on_duplicate_ack(self.dup_ack_count,
                                                    self.snd_nxt):
@@ -941,7 +948,8 @@ class TCPSocket(Socket):
     def _update_readable(self) -> None:
         readable = bool(self.read_queue) or self.eof_received \
             or bool(self.accept_queue)
-        self.adjust_status(S_READABLE, readable)
+        if bool(self.status & S_READABLE) != readable:
+            self.adjust_status(S_READABLE, readable)
 
     def _update_writable(self) -> None:
         if self.state not in (ESTABLISHED, CLOSE_WAIT):
@@ -950,7 +958,9 @@ class TCPSocket(Socket):
             return
         space = self.send_buf_size - self.send_pending_bytes \
             - (self.snd_nxt - self.snd_una)
-        self.adjust_status(S_WRITABLE, space > 0)
+        writable = space > 0
+        if bool(self.status & S_WRITABLE) != writable:
+            self.adjust_status(S_WRITABLE, writable)
 
     def pull_out_packet(self):
         p = super().pull_out_packet()

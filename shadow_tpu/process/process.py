@@ -388,13 +388,19 @@ class SyscallAPI:
     def send(self, fd: int, data: bytes):
         """Blocking send: waits for buffer space (generator)."""
         sock = self._sock(fd)
-        total = 0
-        view = memoryview(bytes(data))
-        while total < len(view):
-            n = sock.send_user_data(bytes(view[total:]))
-            total += n
-            if total < len(view) and n == 0:
+        if type(data) is not bytes:
+            data = bytes(data)
+        if not data:
+            return 0
+        # fast path: the whole buffer fits in one call (no copies)
+        n = sock.send_user_data(data)
+        total = n
+        size = len(data)
+        while total < size:
+            if n == 0:
                 yield _Block(sock, S_WRITABLE)
+            n = sock.send_user_data(data[total:])
+            total += n
         return total
 
     def recvfrom(self, fd: int, nbytes: int = 65536):
@@ -410,19 +416,36 @@ class SyscallAPI:
             yield _Block(sock, S_READABLE)
 
     def recv(self, fd: int, nbytes: int = 65536):
-        data, _ = yield from self.recvfrom(fd, nbytes)
-        return data
+        """Blocking receive, data only (flattened: one generator frame)."""
+        sock = self._sock(fd)
+        while True:
+            r = sock.receive_user_data(nbytes)
+            if r is not None:
+                return r[0]
+            if sock.closed or sock.has_status(S_CLOSED):
+                return b""
+            yield _Block(sock, S_READABLE)
 
     def recv_exact(self, fd: int, nbytes: int):
         """Blocking read of exactly ``nbytes``; None on EOF mid-read.  The
-        shared framing helper for stream-protocol apps."""
-        buf = b""
-        while len(buf) < nbytes:
-            chunk = yield from self.recv(fd, nbytes - len(buf))
-            if not chunk:
+        shared framing helper for stream-protocol apps (flattened — this is
+        the hottest read path of the cell-based app models)."""
+        sock = self._sock(fd)
+        parts = []
+        got = 0
+        while got < nbytes:
+            r = sock.receive_user_data(nbytes - got)
+            if r is None:
+                if sock.closed or sock.has_status(S_CLOSED):
+                    return None
+                yield _Block(sock, S_READABLE)
+                continue
+            data = r[0]
+            if not data:
                 return None
-            buf += chunk
-        return buf
+            parts.append(data)
+            got += len(data)
+        return parts[0] if len(parts) == 1 else b"".join(parts)
 
     def try_recvfrom(self, fd: int, nbytes: int = 65536):
         """Non-blocking: None if nothing available."""
