@@ -181,6 +181,11 @@ def bench_full_sims() -> dict:
     out["tor200_serial"] = _run_sim(xml200, "global", 0, TOR200_STOPTIME)
     out["tor200_tpu"] = _run_sim(xml200, "tpu", 0, TOR200_STOPTIME)
 
+    # star100: BASELINE config #2 (100-host bulk transfer, single-AS star)
+    xml_star = workloads.star_bulk(100, stoptime=30,
+                                   bulk_bytes=1024 * 1024)
+    out["star100_serial"] = _run_sim(xml_star, "global", 0, 30)
+
     # tor10k: workload #4 on the reference's Internet GraphML
     ncores = multiprocessing.cpu_count()
     topo_path = "/root/reference/resource/topology.graphml.xml.xz"

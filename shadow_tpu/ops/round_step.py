@@ -269,6 +269,53 @@ def make_matrix_sharded_hop_step(mesh, axis: str = "pkt"):
     return jax.jit(step)
 
 
+def make_2d_sharded_hop_step(mesh, batch_axis: str = "dp",
+                             row_axis: str = "tp"):
+    """Composed layout over a 2-D mesh — the simulator's dp x tp analog:
+    the packet batch is sharded over ``batch_axis`` (data parallel: each
+    group of devices handles a slice of the round's packets) while the
+    [A, A] path matrices are row-sharded over ``row_axis`` (tensor
+    parallel: each device holds A/tp rows).  Every device gathers the
+    entries whose src rows it owns for its own batch shard; one psum over
+    the row axis assembles each shard's full result.  Collectives ride the
+    mesh's ICI links exactly as a dp x tp LLM layout's do.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def step(latency_ns, reliability, src_rows, dst_rows,
+             uid_lo, uid_hi, send_times, valid,
+             key_lo, key_hi, bootstrap_end, barrier):
+
+        def shard_body(lat_shard, rel_shard, src, dst, u_lo, u_hi, st, va,
+                       klo, khi, bse, bar):
+            rows_per = lat_shard.shape[0]
+            shard = jax.lax.axis_index(row_axis)
+            local = src - shard * rows_per
+            mine = (local >= 0) & (local < rows_per)
+            idx = jnp.clip(local, 0, rows_per - 1)
+            lat = jnp.where(mine, lat_shard[idx, dst], jnp.int64(0))
+            rel = jnp.where(mine, rel_shard[idx, dst], jnp.float32(0.0))
+            # each packet's row lives on exactly one tp shard
+            lat = jax.lax.psum(lat, row_axis)
+            rel = jax.lax.psum(rel, row_axis)
+            return _finish_hop(lat, rel, u_lo, u_hi, st, va,
+                               klo, khi, bse, bar)
+
+        return shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(P(row_axis, None), P(row_axis, None),
+                      P(batch_axis), P(batch_axis), P(batch_axis),
+                      P(batch_axis), P(batch_axis), P(batch_axis),
+                      P(), P(), P(), P()),
+            out_specs=(P(batch_axis), P(batch_axis)))(
+                latency_ns, reliability, src_rows, dst_rows,
+                uid_lo, uid_hi, send_times, valid,
+                key_lo, key_hi, bootstrap_end, barrier)
+
+    return jax.jit(step)
+
+
 class ShardedPacketHopKernel(PacketHopKernel):
     """Multi-device kernel: same .step API as PacketHopKernel, over a 1-D
     device mesh (``--tpu-devices N``).

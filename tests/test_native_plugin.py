@@ -407,3 +407,22 @@ def test_pooled_plugins_100_hosts_few_processes(native_bin, native_so):
     for i in range(50):
         assert exit_codes(ctrl, f"srv{i}", f"cli{i}") == \
             {f"srv{i}": [0], f"cli{i}": [0]}
+
+
+def test_native_sockmisc(native_bin):
+    """setsockopt/getsockopt buffer sizes, EADDRINUSE on double bind,
+    getsockname, getpeername-ENOTCONN — dual execution (reference:
+    src/test/sockbuf + src/test/bind)."""
+    native = subprocess.run([native_bin, "sockmisc"], timeout=30)
+    assert native.returncode == 0
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_bin}" />
+          <host id="node">
+            <process plugin="app" starttime="1" arguments="sockmisc" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "node") == {"node": [0]}

@@ -115,3 +115,30 @@ def test_dryrun_multichip_entrypoint():
     """The driver's dryrun entry must pass on the virtual CPU mesh."""
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
+
+
+def test_2d_dp_tp_sharded_matches_single_device():
+    """Composed dp x tp layout: batch sharded over 'dp' AND matrices
+    row-sharded over 'tp' on a (4, 2) mesh — bitwise-identical to the
+    single-device kernel (the LLM-style 2-D mesh composition)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from shadow_tpu.ops.round_step import (make_2d_sharded_hop_step,
+                                           packet_hop_step)
+
+    devices = jax.devices("cpu")[:8]
+    if len(devices) < 8:
+        pytest.skip("need 8 devices")
+    mesh = Mesh(np.array(devices).reshape(4, 2), axis_names=("dp", "tp"))
+    args = _example(n_rows=16, n_pkts=2048)  # 16 rows / tp=2 -> 8 per shard
+    batch = NamedSharding(mesh, P("dp"))
+    rows = NamedSharding(mesh, P("tp", None))
+    repl = NamedSharding(mesh, P())
+    placements = (rows, rows, batch, batch, batch, batch, batch, batch,
+                  repl, repl, repl, repl)
+    placed = tuple(jax.device_put(a, s) for a, s in zip(args, placements))
+    deliver, keep = make_2d_sharded_hop_step(mesh)(*placed)
+    ref_deliver, ref_keep = packet_hop_step(
+        *tuple(jax.device_put(a, devices[0]) for a in args))
+    np.testing.assert_array_equal(np.asarray(deliver),
+                                  np.asarray(ref_deliver))
+    np.testing.assert_array_equal(np.asarray(keep), np.asarray(ref_keep))
