@@ -68,6 +68,7 @@ enum shadow_tpu_op {
   SHD_OP_TIMERFD_CREATE = 32, /* -> fd */
   SHD_OP_TIMERFD_SETTIME = 33, /* a=fd b=initial_ns c=interval_ns */
   SHD_OP_PIPE = 34,         /* -> ret=read fd, payload u32 write fd */
+  SHD_OP_SOCKETPAIR = 35,   /* -> ret=fd a, payload u32 fd b */
 };
 
 #define SHD_REQ_HDR_LEN 40u

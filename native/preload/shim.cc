@@ -1146,6 +1146,34 @@ extern "C" int pipe(int fds[2]) {
   return 0;
 }
 
+extern "C" int socketpair(int domain, int type, int protocol, int fds[2]) {
+  resolve_reals();
+  static int (*real_socketpair)(int, int, int, int[2]);
+  if (!real_socketpair)
+    *(void **)(&real_socketpair) = dlsym(RTLD_NEXT, "socketpair");
+  int base_type = type & ~(SOCK_NONBLOCK | SOCK_CLOEXEC);
+  if (!g_active || domain != AF_UNIX || base_type != SOCK_STREAM)
+    return real_socketpair(domain, type, protocol, fds);
+  unsigned char buf[4];
+  uint32_t got = 0;
+  int64_t ra = transact(SHD_OP_SOCKETPAIR, 0, 0, 0, 0, NULL, 0, buf,
+                        sizeof buf, &got);
+  if (ra < 0) return -1;
+  uint32_t hb;
+  memcpy(&hb, buf, 4);
+  fds[0] = to_appfd(ra);
+  fds[1] = to_appfd((int64_t)hb);
+  mark_sim_fd(fds[0], 1);
+  mark_sim_fd(fds[1], 1);
+  if (type & SOCK_NONBLOCK) {
+    transact0(SHD_OP_FCNTL, to_handle(fds[0]), F_SETFL, O_NONBLOCK, 0);
+    transact0(SHD_OP_FCNTL, to_handle(fds[1]), F_SETFL, O_NONBLOCK, 0);
+    g_fd_nonblock[fds[0]] = 1;
+    g_fd_nonblock[fds[1]] = 1;
+  }
+  return 0;
+}
+
 extern "C" int pipe2(int fds[2], int flags) {
   resolve_reals();
   if (!g_active) return REAL(pipe2)(fds, flags);

@@ -71,6 +71,7 @@ OP_LOG = 31
 OP_TIMERFD_CREATE = 32
 OP_TIMERFD_SETTIME = 33
 OP_PIPE = 34
+OP_SOCKETPAIR = 35
 
 REQ_HDR = struct.Struct("<IIqqqq")
 RESP_HDR = struct.Struct("<IIqq")
@@ -546,6 +547,11 @@ class NativeKernel:
         return rh, struct.pack("<I", wh)
         yield  # pragma: no cover
 
+    def op_socketpair(self, a, b, c, d, payload):
+        ha, hb = self.api.socketpair()
+        return ha, struct.pack("<I", hb)
+        yield  # pragma: no cover
+
     # -- misc --------------------------------------------------------------
     def op_exit(self, a, b, c, d, payload):
         self.exit_code = int(a)
@@ -573,6 +579,7 @@ class NativeKernel:
         OP_WRITE: op_write, OP_EXIT: op_exit, OP_LOG: op_log,
         OP_TIMERFD_CREATE: op_timerfd_create,
         OP_TIMERFD_SETTIME: op_timerfd_settime, OP_PIPE: op_pipe,
+        OP_SOCKETPAIR: op_socketpair,
     }
 
 

@@ -580,6 +580,32 @@ static int cmd_miscsys(const char *expected_host) {
   return 0;
 }
 
+/* socketpair + pipe self-messaging (reference: src/test/unistd pipes;
+ * real Tor signals its event loop over a socketpair) */
+static int cmd_selfpipe(void) {
+  int sp[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, sp) != 0) return 1;
+  const char ping[] = "ping-through-pair";
+  if (write(sp[0], ping, sizeof ping) != (ssize_t)sizeof ping) return 2;
+  char buf[64] = {0};
+  if (read(sp[1], buf, sizeof buf) != (ssize_t)sizeof ping) return 3;
+  if (strcmp(buf, ping) != 0) return 4;
+  /* poll readiness across the pair */
+  if (write(sp[1], "x", 1) != 1) return 5;
+  struct pollfd p = {sp[0], POLLIN, 0};
+  if (poll(&p, 1, 1000) != 1 || !(p.revents & POLLIN)) return 6;
+  close(sp[0]);
+  close(sp[1]);
+  int pfd[2];
+  if (pipe(pfd) != 0) return 7;
+  if (write(pfd[1], "z", 1) != 1) return 8;
+  if (read(pfd[0], buf, 1) != 1 || buf[0] != 'z') return 9;
+  close(pfd[0]);
+  close(pfd[1]);
+  printf("selfpipe OK\n");
+  return 0;
+}
+
 /* sockbuf/bind/name-query corner cases (reference: src/test/sockbuf,
  * src/test/bind) */
 static int cmd_sockmisc(void) {
@@ -622,6 +648,7 @@ int main(int argc, char **argv) {
   const char *cmd = argv[1];
   if (!strcmp(cmd, "vtime")) return cmd_vtime();
   if (!strcmp(cmd, "sockmisc")) return cmd_sockmisc();
+  if (!strcmp(cmd, "selfpipe")) return cmd_selfpipe();
   if (!strcmp(cmd, "threads")) return cmd_threads();
   if (!strcmp(cmd, "mtserver") && argc >= 3)
     return cmd_mtserver((uint16_t)atoi(argv[2]));

@@ -426,3 +426,45 @@ def test_native_sockmisc(native_bin):
     rc, ctrl = run_sim(xml)
     assert rc == 0
     assert exit_codes(ctrl, "node") == {"node": [0]}
+
+
+def test_native_selfpipe_socketpair(native_bin):
+    """socketpair + pipe self-messaging inside one plugin, dual execution
+    (real Tor signals its event loop over a socketpair)."""
+    native = subprocess.run([native_bin, "selfpipe"], timeout=30)
+    assert native.returncode == 0
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_bin}" />
+          <host id="node">
+            <process plugin="app" starttime="1" arguments="selfpipe" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "node") == {"node": [0]}
+
+
+def test_native_plugins_under_tpu_policy(native_bin):
+    """The native plane and the device-batched tpu scheduler compose: a
+    real-binary TCP transfer runs identically under global and tpu."""
+    nbytes = 100_000
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="60">
+          <plugin id="app" path="{native_bin}" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="1"
+                     arguments="tcpserver 8001 {nbytes}" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="2"
+                     arguments="tcpclient server 8001 {nbytes}" />
+          </host>
+        </shadow>
+    """)
+    for policy in ("global", "tpu"):
+        rc, ctrl = run_sim(xml, policy=policy)
+        assert rc == 0, policy
+        assert exit_codes(ctrl, "server", "client") == \
+            {"server": [0], "client": [0]}, policy
