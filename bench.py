@@ -30,6 +30,19 @@ import multiprocessing
 import os
 import time
 
+# Some environments pin JAX_PLATFORMS to a plugin name (e.g. "axon") that
+# does not register in every process; jax then refuses to start.  Probe in
+# a subprocess: if the pinned platform cannot initialize, fall back to
+# auto-pick (the real TPU when reachable, CPU otherwise).
+if os.environ.get("JAX_PLATFORMS") not in (None, "", "cpu"):
+    import subprocess
+    import sys
+    _probe = subprocess.run(
+        [sys.executable, "-c", "import jax; jax.devices()"],
+        capture_output=True, timeout=120)
+    if _probe.returncode != 0:
+        os.environ["JAX_PLATFORMS"] = ""
+
 import numpy as np
 
 TOR10K_STOPTIME = int(os.environ.get("BENCH_TOR10K_STOPTIME", "8"))

@@ -94,6 +94,14 @@ POLLHUP = 0x010
 _PRELOAD_LIB = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                             "native", "libshadow_preload.so")
 
+# A plugin that spins without making a syscall would freeze the virtual
+# clock forever (the simulator's determinism seam is a blocking read while
+# plugin code runs).  The reference bounds this with its CPU model + pth
+# preemption; our analog is a generous wall-clock stall watchdog: a plugin
+# silent for this long is declared dead and torn down loudly.
+STALL_TIMEOUT_SEC = float(os.environ.get("SHADOW_TPU_PLUGIN_STALL_TIMEOUT",
+                                         "300"))
+
 _live_children: List[subprocess.Popen] = []
 
 
@@ -694,11 +702,18 @@ def run_native_plugin(api, args: List[str], binary: str,
                         f"{name}: {binary} sent a partial first header and "
                         "stalled; killing it")
             raise OSError("plugin handshake timeout")
-        sim_side.settimeout(None)
+        # stall watchdog for the whole run: a timeout surfaces as EOF (the
+        # plugin is killed in the finally block), with a log line naming it
+        sim_side.settimeout(STALL_TIMEOUT_SEC)
         first = True
         while True:
             if not first:
                 hdr = _read_exact(sim_side, REQ_HDR.size)
+                if hdr is None and proc.poll() is None:
+                    log.warning("native",
+                                f"{name}: no syscall for "
+                                f"{STALL_TIMEOUT_SEC:.0f}s wall (busy spin "
+                                "without syscalls?); killing the plugin")
             first = False
             if hdr is None:
                 break
@@ -832,6 +847,7 @@ def run_pooled_plugin(api, args: List[str], so_path: str):
         log.warning("native", f"{name}: pool add_instance failed: {e}")
         return 127
     kernel = NativeKernel(api, sim_side)
+    sim_side.settimeout(STALL_TIMEOUT_SEC)
     try:
         while True:
             hdr = _read_exact(sim_side, REQ_HDR.size)

@@ -649,6 +649,12 @@ int main(int argc, char **argv) {
   if (!strcmp(cmd, "vtime")) return cmd_vtime();
   if (!strcmp(cmd, "sockmisc")) return cmd_sockmisc();
   if (!strcmp(cmd, "selfpipe")) return cmd_selfpipe();
+  if (!strcmp(cmd, "spin")) {
+    /* pathological plugin: burns CPU forever without any syscall — the
+     * simulator's stall watchdog must kill it rather than freeze */
+    volatile unsigned long x = 1;
+    for (;;) x = x * 2654435761u + 1;
+  }
   if (!strcmp(cmd, "threads")) return cmd_threads();
   if (!strcmp(cmd, "mtserver") && argc >= 3)
     return cmd_mtserver((uint16_t)atoi(argv[2]));

@@ -468,3 +468,27 @@ def test_native_plugins_under_tpu_policy(native_bin):
         assert rc == 0, policy
         assert exit_codes(ctrl, "server", "client") == \
             {"server": [0], "client": [0]}, policy
+
+
+def test_spinning_plugin_killed_not_frozen(native_bin, monkeypatch):
+    """A plugin that busy-spins without syscalls must not freeze the
+    virtual clock: the stall watchdog declares it dead and the simulation
+    completes (reference analog: the CPU model + pth preemption bound
+    plugin compute; VERDICT round-2 robustness gap)."""
+    from shadow_tpu.process import native as native_mod
+    monkeypatch.setattr(native_mod, "STALL_TIMEOUT_SEC", 2.0)
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_bin}" />
+          <host id="node">
+            <process plugin="app" starttime="1" arguments="spin" />
+          </host>
+        </shadow>
+    """)
+    t0 = time.monotonic()
+    rc, ctrl = run_sim(xml)
+    wall = time.monotonic() - t0
+    assert wall < 60, "simulator froze on a spinning plugin"
+    # the plugin was killed: nonzero exit surfaces as a plugin error
+    codes = exit_codes(ctrl, "node")["node"]
+    assert codes != [0]
