@@ -19,6 +19,20 @@ class UDPSocket(Socket):
         self.adjust_status(S_WRITABLE, True)
         self.default_interface = None   # set when bound
 
+    # -- connect (datagram semantics) --------------------------------------
+    def connect_to(self, dst_ip: int, dst_port: int) -> bool:
+        """UDP connect(2): record the default destination and filter
+        arrivals to that peer.  Completes immediately (returns True) —
+        there is no handshake.  Real resolver-style clients connect their
+        UDP sockets before send/recv."""
+        if not self.is_bound:
+            self.host.autobind_socket(self, dst_ip)
+        self.peer_ip, self.peer_port = dst_ip, dst_port
+        return True
+
+    def take_socket_error(self):
+        return None
+
     # -- send --------------------------------------------------------------
     def send_user_data(self, data: bytes, dst_ip: int = 0, dst_port: int = 0) -> int:
         host = self.host
@@ -67,6 +81,12 @@ class UDPSocket(Socket):
         return data, p.src_ip, p.src_port
 
     def push_in_packet(self, packet) -> None:
+        # a connected UDP socket only accepts datagrams from its peer
+        if self.peer_ip is not None and (
+                packet.src_ip != self.peer_ip
+                or packet.src_port != self.peer_port):
+            self.drop_packet(packet)
+            return
         if not self.has_in_space(packet.total_size):
             self.drop_packet(packet)
             return

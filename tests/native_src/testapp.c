@@ -580,6 +580,35 @@ static int cmd_miscsys(const char *expected_host) {
   return 0;
 }
 
+/* connected-UDP client: connect(2) on a datagram socket then plain
+ * send/recv (the resolver pattern; reference: src/test/udp) */
+static int cmd_udpconnclient(const char *host, uint16_t port, int count,
+                             int size) {
+  struct sockaddr_in sin;
+  if (resolve(host, port, &sin) != 0) return 1;
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) return 2;
+  if (connect(fd, (struct sockaddr *)&sin, sizeof sin) != 0) return 3;
+  char *buf = malloc((size_t)size);
+  char *echo = malloc((size_t)size);
+  for (int i = 0; i < count; i++) {
+    memset(buf, 'a' + (i % 26), (size_t)size);
+    if (send(fd, buf, (size_t)size, 0) != (ssize_t)size) return 4;
+    ssize_t r = recv(fd, echo, (size_t)size, 0);
+    if (r != (ssize_t)size || memcmp(buf, echo, (size_t)size) != 0) return 5;
+  }
+  /* getpeername reflects the connect */
+  struct sockaddr_in out;
+  socklen_t olen = sizeof out;
+  if (getpeername(fd, (struct sockaddr *)&out, &olen) != 0) return 6;
+  if (out.sin_port != sin.sin_port) return 7;
+  close(fd);
+  free(buf);
+  free(echo);
+  printf("udpconnclient OK\n");
+  return 0;
+}
+
 /* socketpair + pipe self-messaging (reference: src/test/unistd pipes;
  * real Tor signals its event loop over a socketpair) */
 static int cmd_selfpipe(void) {
@@ -664,6 +693,9 @@ int main(int argc, char **argv) {
   if (!strcmp(cmd, "udpclient") && argc >= 6)
     return cmd_udpclient(argv[2], (uint16_t)atoi(argv[3]), atoi(argv[4]),
                          atoi(argv[5]));
+  if (!strcmp(cmd, "udpconnclient") && argc >= 6)
+    return cmd_udpconnclient(argv[2], (uint16_t)atoi(argv[3]), atoi(argv[4]),
+                             atoi(argv[5]));
   if (!strcmp(cmd, "tcpserver") && argc >= 4)
     return cmd_tcpserver((uint16_t)atoi(argv[2]), atoll(argv[3]));
   if (!strcmp(cmd, "tcpclient") && argc >= 5)

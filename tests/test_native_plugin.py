@@ -492,3 +492,31 @@ def test_spinning_plugin_killed_not_frozen(native_bin, monkeypatch):
     # the plugin was killed: nonzero exit surfaces as a plugin error
     codes = exit_codes(ctrl, "node")["node"]
     assert codes != [0]
+
+
+def test_native_connected_udp(native_bin):
+    """connect(2) on a UDP socket: default destination via plain send(),
+    arrivals filtered to the connected peer, getpeername reflects it —
+    dual execution (the resolver pattern)."""
+    srv = subprocess.Popen([native_bin, "udpserver", "39482", "3"])
+    time.sleep(0.2)
+    cli = subprocess.run([native_bin, "udpconnclient", "127.0.0.1", "39482",
+                          "3", "200"], timeout=20)
+    assert cli.returncode == 0
+    assert srv.wait(timeout=20) == 0
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="60">
+          <plugin id="app" path="{native_bin}" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="1" arguments="udpserver 8000 3" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="2"
+                     arguments="udpconnclient server 8000 3 200" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "server", "client") == \
+        {"server": [0], "client": [0]}
