@@ -157,6 +157,59 @@ def bench_device_compute(topo, batch: int, rounds: int) -> float:
     return batch * rounds / dt
 
 
+def bench_phold() -> dict:
+    """PHOLD, the reference's own scheduler benchmark (src/test/phold), in
+    two architectures:
+
+    * engine: the apps/phold.py UDP workload through the full simulator
+      (events are real scheduler/interface/socket events);
+    * device-resident: ops/phold_device.py — the same hop semantics with
+      ALL state in HBM and windows stepped by lax.while_loop, i.e. the
+      architecture the tpu policy converges to as per-event work moves on
+      device.  The two event counts measure different amounts of work per
+      event (full protocol pipeline vs pure hop), which the labels say.
+    """
+    import time as _t
+
+    from shadow_tpu.ops.phold_device import DevicePhold
+
+    out = {}
+    # device-resident: 1024 hosts x 16384 messages, 30 virtual seconds
+    p = DevicePhold(n_hosts=1024, n_msgs=16384, seed=7)
+    p.run_device(int(1e8))                    # compile
+    t0 = _t.perf_counter()
+    _, _, hops = p.run_device(int(30e9))
+    dt = _t.perf_counter() - t0
+    out["phold_device_hops"] = hops
+    out["phold_device_hops_per_sec"] = round(hops / dt)
+    out["phold_device_sim_sec_per_wall_sec"] = round(30.0 / dt, 1)
+
+    # engine twin (small instance; the full pipeline costs more per event)
+    from shadow_tpu.core import configuration
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.core.logger import SimLogger, set_logger
+    from shadow_tpu.core.options import Options
+
+    set_logger(SimLogger(level="warning"))
+    n = 64
+    xml = (f'<shadow stoptime="30"><plugin id="phold" path="python:phold" />'
+           f'<host id="phold" quantity="{n}" bandwidthdown="10240" '
+           f'bandwidthup="10240"><process plugin="phold" starttime="1" '
+           f'arguments="{n} 4 9000" /></host></shadow>')
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = 30
+    ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                              stop_time_sec=30), cfg)
+    t0 = _t.perf_counter()
+    rc = ctrl.run()
+    dt = _t.perf_counter() - t0
+    assert rc == 0
+    out["phold_engine_events"] = ctrl.engine.events_executed
+    out["phold_engine_events_per_sec"] = round(
+        ctrl.engine.events_executed / dt)
+    return out
+
+
 def _run_sim(xml, policy: str, workers: int, stop: int) -> dict:
     from shadow_tpu.core import configuration
     from shadow_tpu.core.controller import Controller
@@ -225,6 +278,7 @@ def main() -> None:
     cpu_rate = bench_cpu_scalar(topo, 200_000)
     dev_rate = bench_device(topo, batch=1 << 20, iters=8)
     dev_compute = bench_device_compute(topo, batch=1 << 20, rounds=64)
+    phold = bench_phold()
     sims = bench_full_sims()
     tor200 = sims["tor200_tpu"]["sim_sec_per_wall_sec"]
     out = {
@@ -242,6 +296,7 @@ def main() -> None:
         "kernel_device_compute_mpkts": round(dev_compute / 1e6, 2),
         "own_scalar_python_mpkts": round(cpu_rate / 1e6, 4),
         "device_vs_own_scalar_python": round(dev_rate / cpu_rate, 2),
+        **phold,
         **sims,
     }
     print(json.dumps(out))

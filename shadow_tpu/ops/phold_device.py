@@ -1,0 +1,143 @@
+"""Fully device-resident PHOLD: the end-state of the north-star design.
+
+PHOLD (reference src/test/phold/test_phold.c; apps/phold.py is the
+engine-driven twin) is the classic PDES scheduler benchmark: a fixed
+population of messages bounces between hosts, each hop at the receiver's
+time plus the path latency.  Because every event is a packet hop, the
+ENTIRE simulation — event selection, RNG, latency lookup, time advance —
+fits on the device: message state lives in HBM, rounds are conservative
+lookahead windows exactly like the engine's (window = min latency), and a
+``lax.while_loop`` steps windows with zero host round-trips.
+
+This is the design target the tpu scheduler policy converges to as more
+per-event work moves on-device: the engine's round loop with the host
+removed from the hot path.  The numbers it produces are honest about what
+they are — a model workload with all state device-resident — and give the
+throughput ceiling of the architecture on this chip.
+
+Semantics (deterministic): message m at host h with ripeness time t
+forwards to dst = threefry(seed, hop_counter) % (H-1) skipping self, and
+arrives at t + latency[h, dst].  A window processes every message with
+t < window_end; remaining messages keep their state.  Event count = total
+hops executed.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.rng import threefry2x32_jnp
+
+
+@partial(jax.jit, static_argnums=(4,))
+def phold_run(latency_ns: jnp.ndarray,     # int64 [H, H]
+              msg_host: jnp.ndarray,       # int32 [M] current host per msg
+              msg_time: jnp.ndarray,       # int64 [M] ripeness time
+              key: jnp.ndarray,            # uint32 [2] threefry key
+              horizon_ns: int,
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run PHOLD to ``horizon_ns`` entirely on device.
+
+    Returns (msg_host, msg_time, hops): final message placement/times and
+    the total number of hops (= events) executed.
+    """
+    n_hosts = latency_ns.shape[0]
+    lookahead = jnp.min(jnp.where(latency_ns > 0, latency_ns,
+                                  jnp.int64(2**62)))
+
+    def window_body(state):
+        host, time, hops, counter = state
+        start = jnp.min(time)
+        end = start + lookahead
+        ripe = time < end
+        # deterministic per-message draw keyed by (msg index, hop round)
+        m = host.shape[0]
+        idx = jnp.arange(m, dtype=jnp.uint32)
+        x0, _ = threefry2x32_jnp(key[0], key[1], idx,
+                                 jnp.uint32(counter) + jnp.zeros_like(idx))
+        # random peer, never self (classic PHOLD population conservation)
+        k = (x0 % jnp.uint32(n_hosts - 1)).astype(jnp.int32)
+        dst = jnp.where(k >= host, k + 1, k)
+        lat = latency_ns[host, dst]
+        host = jnp.where(ripe, dst, host)
+        time = jnp.where(ripe, time + lat, time)
+        hops = hops + jnp.sum(ripe.astype(jnp.int64))
+        return host, time, hops, counter + 1
+
+    def window_cond(state):
+        _host, time, _hops, _counter = state
+        return jnp.min(time) < jnp.int64(horizon_ns)
+
+    host, time, hops, _ = jax.lax.while_loop(
+        window_cond, window_body,
+        (msg_host, msg_time, jnp.int64(0), jnp.uint32(0)))
+    return host, time, hops
+
+
+def phold_run_numpy(latency_ns: np.ndarray, msg_host: np.ndarray,
+                    msg_time: np.ndarray, key_lo: int, key_hi: int,
+                    horizon_ns: int):
+    """Bit-identical host twin (same cipher, same window logic) — the
+    parity oracle for the device loop."""
+    from ..core.rng import threefry2x32_np
+
+    host = msg_host.astype(np.int64).copy()
+    time = msg_time.astype(np.int64).copy()
+    n_hosts = latency_ns.shape[0]
+    pos = latency_ns[latency_ns > 0]
+    lookahead = int(pos.min()) if pos.size else 1
+    hops = 0
+    counter = 0
+    m = len(host)
+    idx = np.arange(m, dtype=np.uint32)
+    while time.min() < horizon_ns:
+        end = time.min() + lookahead
+        ripe = time < end
+        x0, _ = threefry2x32_np(np.uint32(key_lo), np.uint32(key_hi),
+                                idx, np.full(m, counter, dtype=np.uint32))
+        k = (x0 % np.uint32(n_hosts - 1)).astype(np.int64)
+        dst = np.where(k >= host, k + 1, k)
+        lat = latency_ns[host, dst]
+        host = np.where(ripe, dst, host)
+        time = np.where(ripe, time + lat, time)
+        hops += int(ripe.sum())
+        counter += 1
+    return host, time, hops
+
+
+class DevicePhold:
+    """Convenience wrapper: build a PHOLD instance and run it on device."""
+
+    def __init__(self, n_hosts: int, n_msgs: int, seed: int = 7,
+                 min_latency_ms: float = 1.0, max_latency_ms: float = 150.0):
+        rng = np.random.default_rng(seed)
+        lat = rng.integers(int(min_latency_ms * 1e6),
+                           int(max_latency_ms * 1e6),
+                           size=(n_hosts, n_hosts)).astype(np.int64)
+        np.fill_diagonal(lat, 0)
+        self.latency = jnp.asarray(lat)
+        self.latency_np = lat
+        self.msg_host = rng.integers(0, n_hosts, size=n_msgs).astype(np.int32)
+        self.msg_time = np.zeros(n_msgs, dtype=np.int64)
+        self.key_lo = 0xDEADBEEF
+        self.key_hi = 0x12345678
+        self.key = jnp.asarray(np.array([self.key_lo, self.key_hi],
+                                        dtype=np.uint32))
+
+    def run_device(self, horizon_ns: int):
+        host, time, hops = phold_run(self.latency,
+                                     jnp.asarray(self.msg_host),
+                                     jnp.asarray(self.msg_time),
+                                     self.key, horizon_ns)
+        jax.block_until_ready((host, time, hops))
+        return np.asarray(host), np.asarray(time), int(hops)
+
+    def run_numpy(self, horizon_ns: int):
+        return phold_run_numpy(self.latency_np, self.msg_host, self.msg_time,
+                               self.key_lo, self.key_hi, horizon_ns)
