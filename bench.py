@@ -169,44 +169,30 @@ def bench_phold() -> dict:
       device.  The two event counts measure different amounts of work per
       event (full protocol pipeline vs pure hop), which the labels say.
     """
-    import time as _t
-
     from shadow_tpu.ops.phold_device import DevicePhold
 
     out = {}
     # device-resident: 1024 hosts x 16384 messages, 30 virtual seconds
+    # (horizon is a traced scalar, so the warmup compile serves the timed
+    # run too)
     p = DevicePhold(n_hosts=1024, n_msgs=16384, seed=7)
     p.run_device(int(1e8))                    # compile
-    t0 = _t.perf_counter()
+    t0 = time.perf_counter()
     _, _, hops = p.run_device(int(30e9))
-    dt = _t.perf_counter() - t0
+    dt = time.perf_counter() - t0
     out["phold_device_hops"] = hops
     out["phold_device_hops_per_sec"] = round(hops / dt)
     out["phold_device_sim_sec_per_wall_sec"] = round(30.0 / dt, 1)
 
     # engine twin (small instance; the full pipeline costs more per event)
-    from shadow_tpu.core import configuration
-    from shadow_tpu.core.controller import Controller
-    from shadow_tpu.core.logger import SimLogger, set_logger
-    from shadow_tpu.core.options import Options
-
-    set_logger(SimLogger(level="warning"))
     n = 64
     xml = (f'<shadow stoptime="30"><plugin id="phold" path="python:phold" />'
            f'<host id="phold" quantity="{n}" bandwidthdown="10240" '
            f'bandwidthup="10240"><process plugin="phold" starttime="1" '
            f'arguments="{n} 4 9000" /></host></shadow>')
-    cfg = configuration.parse_xml(xml)
-    cfg.stop_time_sec = 30
-    ctrl = Controller(Options(scheduler_policy="global", workers=0,
-                              stop_time_sec=30), cfg)
-    t0 = _t.perf_counter()
-    rc = ctrl.run()
-    dt = _t.perf_counter() - t0
-    assert rc == 0
-    out["phold_engine_events"] = ctrl.engine.events_executed
-    out["phold_engine_events_per_sec"] = round(
-        ctrl.engine.events_executed / dt)
+    r = _run_sim(xml, "global", 0, 30)
+    out["phold_engine_events"] = r["events"]
+    out["phold_engine_events_per_sec"] = r["events_per_sec"]
     return out
 
 

@@ -24,7 +24,6 @@ hops executed.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Tuple
 
 import numpy as np
@@ -35,12 +34,13 @@ import jax.numpy as jnp
 from ..core.rng import threefry2x32_jnp
 
 
-@partial(jax.jit, static_argnums=(4,))
+@jax.jit
 def phold_run(latency_ns: jnp.ndarray,     # int64 [H, H]
               msg_host: jnp.ndarray,       # int32 [M] current host per msg
               msg_time: jnp.ndarray,       # int64 [M] ripeness time
               key: jnp.ndarray,            # uint32 [2] threefry key
-              horizon_ns: int,
+              horizon_ns: jnp.ndarray,     # int64 scalar (traced, so one
+                                           #   compile serves any horizon)
               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Run PHOLD to ``horizon_ns`` entirely on device.
 
@@ -72,7 +72,7 @@ def phold_run(latency_ns: jnp.ndarray,     # int64 [H, H]
 
     def window_cond(state):
         _host, time, _hops, _counter = state
-        return jnp.min(time) < jnp.int64(horizon_ns)
+        return jnp.min(time) < horizon_ns
 
     host, time, hops, _ = jax.lax.while_loop(
         window_cond, window_body,
@@ -134,7 +134,7 @@ class DevicePhold:
         host, time, hops = phold_run(self.latency,
                                      jnp.asarray(self.msg_host),
                                      jnp.asarray(self.msg_time),
-                                     self.key, horizon_ns)
+                                     self.key, jnp.int64(horizon_ns))
         jax.block_until_ready((host, time, hops))
         return np.asarray(host), np.asarray(time), int(hops)
 
