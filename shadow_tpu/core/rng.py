@@ -81,6 +81,39 @@ def threefry2x32_jnp(k0, k1, c0, c1):
     return x0, x1
 
 
+_M32 = 0xFFFFFFFF
+
+
+def threefry2x32_int(k0: int, k1: int, c0: int, c1: int) -> Tuple[int, int]:
+    """Threefry-2x32 on plain Python ints — bitwise identical to the numpy
+    version (same ops mod 2^32; asserted by tests/test_rng.py) and ~50x
+    faster for SCALAR draws: numpy scalar arithmetic pays per-op dispatch
+    that dominates host boot (20k hosts x 3 derive calls) and the per-packet
+    CPU drop draw."""
+    k0 &= _M32
+    k1 &= _M32
+    x0 = c0 & _M32
+    x1 = c1 & _M32
+    ks = (k0, k1, (_PARITY ^ k0 ^ k1) & _M32)
+    x0 = (x0 + ks[0]) & _M32
+    x1 = (x1 + ks[1]) & _M32
+    for block in range(5):
+        rots = _ROTATIONS[0:4] if block % 2 == 0 else _ROTATIONS[4:8]
+        for r in rots:
+            x0 = (x0 + x1) & _M32
+            x1 = ((x1 << r) | (x1 >> (32 - r))) & _M32
+            x1 ^= x0
+        x0 = (x0 + ks[(block + 1) % 3]) & _M32
+        x1 = (x1 + ks[(block + 2) % 3] + block + 1) & _M32
+    return x0, x1
+
+
+def _bits64_scalar(key: int, counter: int) -> int:
+    x0, x1 = threefry2x32_int(key & _M32, (key >> 32) & _M32,
+                              counter & _M32, (counter >> 32) & _M32)
+    return x0 | (x1 << 32)
+
+
 def _split64(v) -> Tuple[np.ndarray, np.ndarray]:
     v = np.asarray(v, dtype=np.uint64)
     return (v & np.uint64(0xFFFFFFFF)).astype(np.uint32), (v >> np.uint64(32)).astype(np.uint32)
@@ -92,6 +125,12 @@ def uniform_np(key: int, counter) -> np.ndarray:
     Uses the high lane's top 24 bits so the same construction is cheap and
     exact in float32 on device (see :func:`uniform_jnp`).
     """
+    if isinstance(counter, (int, np.integer)):
+        key_i = int(key) & 0xFFFFFFFFFFFFFFFF
+        c = int(counter) & 0xFFFFFFFFFFFFFFFF
+        x0, _ = threefry2x32_int(key_i & _M32, (key_i >> 32) & _M32,
+                                 c & _M32, (c >> 32) & _M32)
+        return np.float64((x0 >> 8) * (1.0 / (1 << 24)))
     k0, k1 = _split64(np.uint64(key & 0xFFFFFFFFFFFFFFFF))
     c0, c1 = _split64(counter)
     x0, _x1 = threefry2x32_np(k0, k1, c0, c1)
@@ -143,6 +182,9 @@ def uniform_jnp(key, counter):
 
 def bits64_np(key: int, counter) -> np.ndarray:
     """64 random bits as uint64 from key + counter."""
+    if isinstance(counter, (int, np.integer)):
+        return np.uint64(_bits64_scalar(int(key) & 0xFFFFFFFFFFFFFFFF,
+                                        int(counter) & 0xFFFFFFFFFFFFFFFF))
     k0, k1 = _split64(np.uint64(key & 0xFFFFFFFFFFFFFFFF))
     c0, c1 = _split64(counter)
     x0, x1 = threefry2x32_np(k0, k1, c0, c1)
@@ -186,12 +228,12 @@ class RandomSource:
         self.counter = 0
 
     def next_u64(self) -> int:
-        v = int(bits64_np(self.key, np.uint64(self.counter)))
+        v = _bits64_scalar(self.key, self.counter)
         self.counter += 1
         return v
 
     def next_double(self) -> float:
-        v = float(uniform_np(self.key, np.uint64(self.counter)))
+        v = float(uniform_np(self.key, self.counter))
         self.counter += 1
         return v
 

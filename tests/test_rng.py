@@ -76,3 +76,27 @@ def test_random_source_sequence():
     assert all(0 <= r1.next_int(100) < 100 for _ in range(100))
     b = r1.next_bytes(33)
     assert len(b) == 33
+
+
+def test_scalar_int_threefry_matches_numpy():
+    """The pure-int scalar fast path is bitwise-identical to the numpy
+    implementation (and therefore to the jax one)."""
+    from shadow_tpu.core.rng import (threefry2x32_int, threefry2x32_np,
+                                     bits64_np, uniform_np)
+    import numpy as np
+    rng = np.random.default_rng(123)
+    for _ in range(200):
+        k0, k1, c0, c1 = (int(x) for x in
+                          rng.integers(0, 2**32, size=4, dtype=np.uint64))
+        want = threefry2x32_np(np.uint32(k0), np.uint32(k1),
+                               np.uint32(c0), np.uint32(c1))
+        got = threefry2x32_int(k0, k1, c0, c1)
+        assert (int(want[0]), int(want[1])) == got
+    # the scalar entry points agree with the array entry points
+    for _ in range(50):
+        key = int(rng.integers(0, 2**63))
+        ctr = int(rng.integers(0, 2**63))
+        arr_bits = bits64_np(key, np.array([ctr], dtype=np.uint64))[0]
+        assert int(bits64_np(key, ctr)) == int(arr_bits)
+        arr_u = uniform_np(key, np.array([ctr], dtype=np.uint64))[0]
+        assert float(uniform_np(key, ctr)) == float(arr_u)
