@@ -180,8 +180,13 @@ class TCPSocket(Socket):
     # packet construction / emission
     # ------------------------------------------------------------------
     def _emit(self, flags: int, seq: int, payload: bytes = b"",
-              echo_ts: Optional[int] = None, track: bool = True) -> None:
-        """Create one packet and hand it to the interface qdisc."""
+              echo_ts: Optional[int] = None, track: bool = True,
+              notify: bool = True) -> None:
+        """Create one packet and hand it to the interface qdisc.
+
+        ``notify=False`` defers the interface kick — the segmentizing loop
+        in :meth:`_flush` emits many packets and notifies ONCE, so the
+        interface drain doesn't re-run per segment."""
         now = self.host.now
         adv_window = self._adv_window()
         header = TCPHeader(self.bound_ip, self.bound_port,
@@ -208,9 +213,10 @@ class TCPSocket(Socket):
         self.out_packets.append(pkt)
         self.out_bytes += pkt.total_size
         pkt.add_status("SND_SOCKET_BUFFERED")
-        iface = self._iface()
-        if iface is not None:
-            iface.wants_send(self)
+        if notify:
+            iface = self._iface()
+            if iface is not None:
+                iface.wants_send(self)
 
     def _sack_blocks(self) -> List[Tuple[int, int]]:
         """Contiguous runs in the reorder buffer, newest-first capped at 4
@@ -395,6 +401,7 @@ class TCPSocket(Socket):
         # (tcp.c:1121-1278) — a 512 B-per-write app still fills 1460 B
         # packets here.
         pending = self.send_pending
+        emitted = False
         while pending:
             n = min(MSS, self._send_capacity())
             if n == 0:
@@ -426,15 +433,21 @@ class TCPSocket(Socket):
                 payload = b"".join(parts)
                 n = size
             self.send_pending_bytes -= n
-            self._emit(TCP_ACK, self.snd_nxt, payload)
+            self._emit(TCP_ACK, self.snd_nxt, payload, notify=False)
             self.snd_nxt += n
+            emitted = True
         # 3. FIN once all data is out
         if self.fin_pending and not self.send_pending \
                 and self.fin_seq is None:
             self.fin_seq = self.snd_nxt
-            self._emit(TCP_FIN | TCP_ACK, self.snd_nxt)
+            self._emit(TCP_FIN | TCP_ACK, self.snd_nxt, notify=False)
             self.snd_nxt += 1
             self.fin_pending = False
+            emitted = True
+        if emitted:
+            iface = self._iface()
+            if iface is not None:
+                iface.wants_send(self)
         # 4. zero-window persist: if the peer closed its window and nothing
         # is in flight (so no RTO is running), probe so a lost window-update
         # ACK cannot deadlock the connection
