@@ -18,6 +18,7 @@ EPOLLIN = 0x001
 EPOLLOUT = 0x004
 EPOLLERR = 0x008
 EPOLLHUP = 0x010
+EPOLLET = 1 << 31
 
 
 class Epoll(Descriptor):
@@ -25,6 +26,10 @@ class Epoll(Descriptor):
         super().__init__(host, handle, "epoll")
         self._watches: Dict[int, Tuple[Descriptor, int, object]] = {}  # fd -> (desc, events, data)
         self._ready: Dict[int, int] = {}  # fd -> revents
+        # edge-trigger bookkeeping (reference epoll.c EWF_EDGETRIGGER,
+        # :275-305): an ET watch reports a condition only when it BECOMES
+        # true; collecting it re-arms the edge
+        self._prev: Dict[int, int] = {}   # fd -> last observed revents
         self._wakeup_callbacks: List = []
 
     # -- control -----------------------------------------------------------
@@ -47,6 +52,7 @@ class Epoll(Descriptor):
         del self._watches[desc.handle]
         desc.remove_listener(self._on_status)
         self._ready.pop(desc.handle, None)
+        self._prev.pop(desc.handle, None)
         self._update_own_status()
 
     # -- status tracking ---------------------------------------------------
@@ -66,7 +72,18 @@ class Epoll(Descriptor):
             return
         _, want, _ = entry
         r = self._revents_for(desc, want)
-        if r:
+        if want & EPOLLET:
+            # edge-triggered: only 0->1 transitions become reportable; the
+            # pending set accumulates until wait() collects (and re-arms)
+            prev = self._prev.get(desc.handle, 0)
+            self._prev[desc.handle] = r
+            edges = r & ~prev
+            if edges:
+                newly = desc.handle not in self._ready
+                self._ready[desc.handle] = self._ready.get(desc.handle, 0) | edges
+                if newly:
+                    self._notify_wakeups()
+        elif r:
             newly = desc.handle not in self._ready
             self._ready[desc.handle] = r
             if newly:
@@ -105,6 +122,11 @@ class Epoll(Descriptor):
         for fd, revents in list(self._ready.items())[:max_events]:
             desc, want, data = self._watches[fd]
             out.append((data if data is not None else fd, revents))
+            if want & EPOLLET:
+                # collected: the edge is consumed until the next transition
+                del self._ready[fd]
+        if out:
+            self._update_own_status()
         return out
 
     def has_ready(self) -> bool:

@@ -218,6 +218,7 @@ static int cmd_tcpclient(const char *host, uint16_t port, int64_t nbytes) {
 }
 
 /* ------------------------------------------------------------------ epoll */
+static int g_epoll_flags_extra = 0;   /* EPOLLET for the etserver twin */
 static int cmd_epollserver(uint16_t port, int nclients) {
   int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   if (lfd < 0) return 1;
@@ -231,7 +232,7 @@ static int cmd_epollserver(uint16_t port, int nclients) {
   int ep = epoll_create1(0);
   if (ep < 0) return 4;
   struct epoll_event ev;
-  ev.events = EPOLLIN;
+  ev.events = EPOLLIN | g_epoll_flags_extra;
   ev.data.fd = lfd;
   if (epoll_ctl(ep, EPOLL_CTL_ADD, lfd, &ev) != 0) return 5;
   int done = 0, active = 0;
@@ -252,7 +253,7 @@ static int cmd_epollserver(uint16_t port, int nclients) {
           int cfd = accept4(lfd, NULL, NULL, SOCK_NONBLOCK);
           if (cfd < 0) break;
           struct epoll_event cev;
-          cev.events = EPOLLIN;
+          cev.events = EPOLLIN | g_epoll_flags_extra;
           cev.data.fd = cfd;
           if (epoll_ctl(ep, EPOLL_CTL_ADD, cfd, &cev) != 0) return 8;
           active++;
@@ -702,6 +703,12 @@ int main(int argc, char **argv) {
     return cmd_tcpclient(argv[2], (uint16_t)atoi(argv[3]), atoll(argv[4]));
   if (!strcmp(cmd, "epollserver") && argc >= 4)
     return cmd_epollserver((uint16_t)atoi(argv[2]), atoi(argv[3]));
+  if (!strcmp(cmd, "etserver") && argc >= 4) {
+    /* same server, edge-triggered: the drain-until-EAGAIN loops above
+     * are exactly the ET contract */
+    g_epoll_flags_extra = EPOLLET;
+    return cmd_epollserver((uint16_t)atoi(argv[2]), atoi(argv[3]));
+  }
   if (!strcmp(cmd, "pollclient") && argc >= 4)
     return cmd_pollclient(argv[2], (uint16_t)atoi(argv[3]));
   if (!strcmp(cmd, "selectclient") && argc >= 4)

@@ -520,3 +520,56 @@ def test_native_connected_udp(native_bin):
     assert rc == 0
     assert exit_codes(ctrl, "server", "client") == \
         {"server": [0], "client": [0]}
+
+
+def test_native_workload_digest_parity_across_policies(native_bin):
+    """A native-binary workload ends in the identical state digest under
+    serial and device-batched scheduling — the event-order parity gate
+    extended to the native plugin plane."""
+    from shadow_tpu.core.checkpoint import state_digest
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="40">
+          <plugin id="app" path="{native_bin}" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="1" arguments="udpserver 8000 4" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="2"
+                     arguments="udpclient server 8000 4 512" />
+          </host>
+        </shadow>
+    """)
+    digests = {}
+    for policy in ("global", "tpu"):
+        rc, ctrl = run_sim(xml, policy=policy)
+        assert rc == 0, policy
+        assert exit_codes(ctrl, "server", "client") == \
+            {"server": [0], "client": [0]}, policy
+        digests[policy] = state_digest(ctrl.engine)
+    assert digests["global"] == digests["tpu"]
+
+
+def test_native_edge_triggered_epoll(native_bin):
+    """EPOLLET server (drain-until-EAGAIN contract) fed by two clients —
+    dual execution (reference epoll.c EWF_EDGETRIGGER, :275-305)."""
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="90">
+          <plugin id="app" path="{native_bin}" />
+          <host id="server">
+            <process plugin="app" starttime="1"
+                     arguments="etserver 8002 2" />
+          </host>
+          <host id="c1">
+            <process plugin="app" starttime="2"
+                     arguments="pollclient server 8002" />
+          </host>
+          <host id="c2">
+            <process plugin="app" starttime="3"
+                     arguments="pollclient server 8002" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "server", "c1", "c2") == \
+        {"server": [0], "c1": [0], "c2": [0]}
