@@ -1,0 +1,95 @@
+"""Router queue managers (host/router.py): CoDel/single/static behavior
+against the reference semantics (router_queue_codel.c / _single.c /
+_static.c; RFC 8289)."""
+
+from shadow_tpu.core import stime
+from shadow_tpu.host.router import CoDelQueue, SingleQueue, StaticQueue
+
+MS = stime.SIM_TIME_MS
+
+
+class _Pkt:
+    def __init__(self, i):
+        self.i = i
+        self.statuses = []
+
+    def add_status(self, s):
+        self.statuses.append(s)
+
+
+def test_single_queue_one_slot():
+    q = SingleQueue()
+    assert q.enqueue(_Pkt(1), 0)
+    assert not q.enqueue(_Pkt(2), 0)   # occupied: drop-tail
+    assert q.dequeue(0).i == 1
+    assert q.enqueue(_Pkt(3), 0)
+
+
+def test_static_queue_capacity():
+    q = StaticQueue(capacity_packets=3)
+    assert all(q.enqueue(_Pkt(i), 0) for i in range(3))
+    assert not q.enqueue(_Pkt(9), 0)
+    assert [q.dequeue(0).i for _ in range(3)] == [0, 1, 2]
+    assert q.dequeue(0) is None
+
+
+def test_codel_no_drops_below_target():
+    """Sojourn below the 10 ms target never drops (RFC 8289 good queue)."""
+    q = CoDelQueue()
+    now = 0
+    for i in range(200):
+        assert q.enqueue(_Pkt(i), now)
+        got = q.dequeue(now + 5 * MS)   # 5 ms sojourn < 10 ms target
+        assert got is not None and got.i == i
+        now += 5 * MS
+    assert q.total_drops == 0
+
+
+def test_codel_drops_under_persistent_overload():
+    """Sojourn persistently above target for more than one interval enters
+    dropping mode; the control law accelerates drops by interval/sqrt(n)."""
+    q = CoDelQueue()
+    # fill a standing queue: 100 packets enqueued at t=0
+    for i in range(100):
+        assert q.enqueue(_Pkt(i), 0)
+    # drain slowly: each dequeue observes a sojourn far above target
+    now = 200 * MS      # every packet has waited 200 ms
+    delivered = 0
+    drops_before = q.total_drops
+    for _ in range(100):
+        p = q.dequeue(now)
+        if p is None:
+            break
+        delivered += 1
+        now += 20 * MS  # slow drain keeps the overload persistent
+    assert q.total_drops > drops_before, "persistent overload never dropped"
+    assert delivered > 0                   # CoDel never starves the queue
+    assert delivered + q.total_drops + len(q) == 100
+
+
+def test_codel_recovers_when_queue_empties():
+    """Dropping state exits when the standing queue dissipates (good-queue
+    recovery), and subsequent fast traffic passes untouched."""
+    q = CoDelQueue()
+    for i in range(50):
+        q.enqueue(_Pkt(i), 0)
+    now = 200 * MS
+    while q.dequeue(now) is not None:
+        now += 15 * MS
+    assert not q.dropping or len(q) == 0
+    drops_after_overload = q.total_drops
+    # fresh well-behaved traffic: no new drops
+    for i in range(100, 150):
+        q.enqueue(_Pkt(i), now)
+        got = q.dequeue(now + MS)
+        assert got is not None
+        now += MS
+    assert q.total_drops == drops_after_overload
+
+
+def test_codel_hard_limit_bounds_memory():
+    q = CoDelQueue()
+    for i in range(CoDelQueue.HARD_LIMIT):
+        assert q.enqueue(_Pkt(i), 0)
+    assert not q.enqueue(_Pkt(-1), 0)
+    assert q.total_drops == 1
