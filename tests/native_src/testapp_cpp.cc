@@ -1,0 +1,57 @@
+// C++ plugin sanity (reference: src/test/cpp): iostream, std::string,
+// exceptions, and a socket round trip through the interposed libc — the
+// C++ runtime (static init, unwinding, locales) must work under the shim.
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <iostream>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+static int resolve4(const std::string &host, uint16_t port,
+                    sockaddr_in *out) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_DGRAM;
+  if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0 || !res)
+    return -1;
+  *out = *reinterpret_cast<sockaddr_in *>(res->ai_addr);
+  out->sin_port = htons(port);
+  freeaddrinfo(res);
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  std::vector<std::string> args(argv + 1, argv + argc);
+  try {
+    if (!args.empty() && args[0] == "throwcheck") {
+      throw std::runtime_error("caught");
+    }
+  } catch (const std::runtime_error &e) {
+    if (std::string(e.what()) != "caught") return 2;
+  }
+  if (args.size() >= 3 && args[0] == "udp") {
+    const std::string host = args[1];
+    const uint16_t port = static_cast<uint16_t>(std::stoi(args[2]));
+    sockaddr_in dst{};
+    if (resolve4(host, port, &dst) != 0) return 3;
+    int fd = socket(AF_INET, SOCK_DGRAM, 0);
+    if (fd < 0) return 4;
+    const std::string msg = "hello-from-cpp";
+    if (sendto(fd, msg.data(), msg.size(), 0,
+               reinterpret_cast<sockaddr *>(&dst), sizeof dst) !=
+        static_cast<ssize_t>(msg.size()))
+      return 5;
+    std::string echo(msg.size(), '\0');
+    if (recv(fd, echo.data(), echo.size(), 0) !=
+        static_cast<ssize_t>(msg.size()))
+      return 6;
+    if (echo != msg) return 7;
+    close(fd);
+  }
+  std::cout << "cppapp OK" << std::endl;
+  return 0;
+}

@@ -573,3 +573,38 @@ def test_native_edge_triggered_epoll(native_bin):
     assert rc == 0
     assert exit_codes(ctrl, "server", "c1", "c2") == \
         {"server": [0], "c1": [0], "c2": [0]}
+
+
+@pytest.fixture(scope="session")
+def native_cpp_bin(tmp_path_factory):
+    out = tmp_path_factory.mktemp("nativecpp") / "cppapp"
+    subprocess.run(["g++", "-O1", "-std=c++17", "-o", str(out),
+                    os.path.join(REPO, "tests", "native_src",
+                                 "testapp_cpp.cc")],
+                   check=True, capture_output=True)
+    return str(out)
+
+
+def test_native_cpp_plugin(native_bin, native_cpp_bin):
+    """A real C++ binary (iostream/string/exceptions) exchanges a datagram
+    with a C-binary echo server inside the simulator (reference:
+    src/test/cpp C++ plugin sanity)."""
+    native = subprocess.run([native_cpp_bin, "throwcheck"], timeout=20)
+    assert native.returncode == 0
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="capp" path="{native_bin}" />
+          <plugin id="cppapp" path="{native_cpp_bin}" />
+          <host id="server">
+            <process plugin="capp" starttime="1" arguments="udpserver 8000 1" />
+          </host>
+          <host id="client">
+            <process plugin="cppapp" starttime="2"
+                     arguments="udp server 8000" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "server", "client") == \
+        {"server": [0], "client": [0]}
