@@ -581,6 +581,47 @@ static int cmd_miscsys(const char *expected_host) {
   return 0;
 }
 
+/* timerfd: periodic expirations under the virtual clock (reference:
+ * src/test/timerfd) */
+#include <sys/timerfd.h>
+
+static int cmd_timercheck(void) {
+  int tfd = timerfd_create(CLOCK_MONOTONIC, 0);
+  if (tfd < 0) return 1;
+  struct itimerspec its;
+  memset(&its, 0, sizeof its);
+  its.it_value.tv_nsec = 50 * 1000 * 1000;      /* first: 50 ms */
+  its.it_interval.tv_nsec = 100 * 1000 * 1000;  /* then: 100 ms */
+  if (timerfd_settime(tfd, 0, &its, NULL) != 0) return 2;
+  int64_t t0 = now_ns();
+  uint64_t expirations = 0;
+  if (read(tfd, &expirations, sizeof expirations) != sizeof expirations)
+    return 3;
+  if (expirations != 1) return 4;
+  int64_t waited = now_ns() - t0;
+  if (under_sim() && waited != 50 * 1000 * 1000LL) {
+    fprintf(stderr, "timercheck: first expiry at %lld ns\n",
+            (long long)waited);
+    return 5;
+  }
+  /* sleep past several periods: the next read reports them batched */
+  usleep(350 * 1000);
+  if (read(tfd, &expirations, sizeof expirations) != sizeof expirations)
+    return 6;
+  if (under_sim() && expirations != 3) {
+    fprintf(stderr, "timercheck: batched expirations %llu != 3\n",
+            (unsigned long long)expirations);
+    return 7;
+  }
+  if (!under_sim() && expirations < 2) return 7;
+  /* poll readiness: not readable right after a read consumed them */
+  struct pollfd p = {tfd, POLLIN, 0};
+  if (poll(&p, 1, 0) != 0) return 8;
+  close(tfd);
+  printf("timercheck OK\n");
+  return 0;
+}
+
 /* connected-UDP client: connect(2) on a datagram socket then plain
  * send/recv (the resolver pattern; reference: src/test/udp) */
 static int cmd_udpconnclient(const char *host, uint16_t port, int count,
@@ -679,6 +720,7 @@ int main(int argc, char **argv) {
   if (!strcmp(cmd, "vtime")) return cmd_vtime();
   if (!strcmp(cmd, "sockmisc")) return cmd_sockmisc();
   if (!strcmp(cmd, "selfpipe")) return cmd_selfpipe();
+  if (!strcmp(cmd, "timercheck")) return cmd_timercheck();
   if (!strcmp(cmd, "spin")) {
     /* pathological plugin: burns CPU forever without any syscall — the
      * simulator's stall watchdog must kill it rather than freeze */

@@ -608,3 +608,22 @@ def test_native_cpp_plugin(native_bin, native_cpp_bin):
     assert rc == 0
     assert exit_codes(ctrl, "server", "client") == \
         {"server": [0], "client": [0]}
+
+
+def test_native_timerfd(native_bin):
+    """timerfd under the virtual clock: exact first expiry, batched
+    periodic expirations, readiness cleared by read — dual execution
+    (reference: src/test/timerfd)."""
+    native = subprocess.run([native_bin, "timercheck"], timeout=30)
+    assert native.returncode == 0
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_bin}" />
+          <host id="node">
+            <process plugin="app" starttime="1" arguments="timercheck" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "node") == {"node": [0]}
