@@ -84,7 +84,18 @@ class Controller:
     def _add_process(self, host: Host, pc) -> None:
         path = self._program_paths.get(pc.plugin, pc.plugin)
         app_main = app_registry.resolve(path)
-        args = pc.arguments.split() if pc.arguments else []
+        # shell-style tokenization: a superset of the reference's bare
+        # strtok-on-spaces (process.c:769) that also supports quoted
+        # arguments, e.g. arguments='-c "import x; run(x)"' for an
+        # interpreter plugin.  Unbalanced quotes fall back to plain split.
+        if pc.arguments:
+            import shlex
+            try:
+                args = shlex.split(pc.arguments)
+            except ValueError:
+                args = pc.arguments.split()
+        else:
+            args = []
         stop_ns = stime.from_seconds(pc.stop_time_sec) if pc.stop_time_sec else 0
         Process(host, f"{host.name}.{pc.plugin}", app_main, args,
                 start_time_ns=stime.from_seconds(pc.start_time_sec),

@@ -702,18 +702,21 @@ def run_native_plugin(api, args: List[str], binary: str,
                         f"{name}: {binary} sent a partial first header and "
                         "stalled; killing it")
             raise OSError("plugin handshake timeout")
-        # stall watchdog for the whole run: a timeout surfaces as EOF (the
-        # plugin is killed in the finally block), with a log line naming it
+        # stall watchdog for the whole run: a TIMEOUT (as opposed to EOF)
+        # means the plugin went silent without exiting — declare it dead
+        # loudly; the finally block kills it
         sim_side.settimeout(STALL_TIMEOUT_SEC)
         first = True
         while True:
             if not first:
-                hdr = _read_exact(sim_side, REQ_HDR.size)
-                if hdr is None and proc.poll() is None:
+                try:
+                    hdr = _read_exact_raising(sim_side, REQ_HDR.size)
+                except TimeoutError:
                     log.warning("native",
                                 f"{name}: no syscall for "
                                 f"{STALL_TIMEOUT_SEC:.0f}s wall (busy spin "
                                 "without syscalls?); killing the plugin")
+                    hdr = None
             first = False
             if hdr is None:
                 break
@@ -850,7 +853,14 @@ def run_pooled_plugin(api, args: List[str], so_path: str):
     sim_side.settimeout(STALL_TIMEOUT_SEC)
     try:
         while True:
-            hdr = _read_exact(sim_side, REQ_HDR.size)
+            try:
+                hdr = _read_exact_raising(sim_side, REQ_HDR.size)
+            except TimeoutError:
+                log.warning("native",
+                            f"{name}: no syscall for "
+                            f"{STALL_TIMEOUT_SEC:.0f}s wall; retiring the "
+                            "pooled instance")
+                hdr = None
             if hdr is None:
                 break
             length, op, a, b, c, d = REQ_HDR.unpack(hdr)

@@ -627,3 +627,32 @@ def test_native_timerfd(native_bin):
     rc, ctrl = run_sim(xml)
     assert rc == 0
     assert exit_codes(ctrl, "node") == {"node": [0]}
+
+
+import sys
+
+
+def test_real_cpython_urllib_through_simulator(native_bin):
+    """The CPython interpreter itself as a plugin: urllib completes an HTTP
+    download through the simulated network (runtime startup getrandom,
+    virtual DNS, blocking sockets, poll — an entire dynamic-language
+    runtime under the interposer)."""
+    code = ("import urllib.request, sys; "
+            "d = urllib.request.urlopen('http://server/f', timeout=30).read(); "
+            "sys.exit(int(len(d) != 50000))")
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="60">
+          <plugin id="web" path="python:httpd" />
+          <plugin id="py" path="exec:{sys.executable}" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="web" starttime="1" arguments="80 50000" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="py" starttime="2"
+                     arguments="-c &quot;{code}&quot;" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "client") == {"client": [0]}
