@@ -644,6 +644,14 @@ def run_native_plugin(api, args: List[str], binary: str,
     # deterministic virtual pid (the reference's plugins see their virtual
     # process id through process_emu_getpid)
     env["SHADOW_TPU_PID"] = str(api.process.pid)
+    # per-host file namespace: the plugin's cwd is its host's data dir
+    # (reference slave.c data-dir layout: each host gets hostDataPath and
+    # plugins run against it), so relative paths isolate per host
+    data_root = getattr(getattr(api.host, "engine", None), "data_directory",
+                        None) or "shadow.data"
+    host_dir = os.path.join(data_root, "hosts", api.host.name)
+    os.makedirs(host_dir, exist_ok=True)
+    env["SHADOW_TPU_DATA_DIR"] = os.path.abspath(host_dir)
     if extra_env:
         env.update(extra_env)
     # stdout/stderr go to per-process files (the reference writes each
@@ -657,7 +665,7 @@ def run_native_plugin(api, args: List[str], binary: str,
         proc = subprocess.Popen([binary] + list(args), env=env,
                                 pass_fds=(child_side.fileno(),),
                                 stdout=out_file, stderr=subprocess.STDOUT,
-                                close_fds=True)
+                                cwd=host_dir, close_fds=True)
     except OSError as e:
         log.warning("native", f"{name}: failed to exec {binary}: {e}")
         child_side.close()

@@ -721,6 +721,21 @@ int main(int argc, char **argv) {
   if (!strcmp(cmd, "sockmisc")) return cmd_sockmisc();
   if (!strcmp(cmd, "selfpipe")) return cmd_selfpipe();
   if (!strcmp(cmd, "timercheck")) return cmd_timercheck();
+  if (!strcmp(cmd, "filewrite") && argc >= 3) {
+    /* per-host file namespace: cwd is this host's data dir, so a relative
+     * path never collides with another host's (reference data-dir layout,
+     * slave.c:201-218) */
+    FILE *f = fopen("state.txt", "w");
+    if (!f) return 1;
+    fprintf(f, "%s", argv[2]);
+    fclose(f);
+    f = fopen("state.txt", "r");
+    if (!f) return 2;
+    char buf[256] = {0};
+    if (!fgets(buf, sizeof buf, f)) { fclose(f); return 3; }
+    fclose(f);
+    return strcmp(buf, argv[2]) == 0 ? 0 : 4;
+  }
   if (!strcmp(cmd, "spin")) {
     /* pathological plugin: burns CPU forever without any syscall — the
      * simulator's stall watchdog must kill it rather than freeze */

@@ -656,3 +656,27 @@ def test_real_cpython_urllib_through_simulator(native_bin):
     rc, ctrl = run_sim(xml)
     assert rc == 0
     assert exit_codes(ctrl, "client") == {"client": [0]}
+
+
+def test_per_host_file_namespace(native_bin, tmp_path, monkeypatch):
+    """Two hosts write the same relative filename; each sees only its own
+    content (plugin cwd = the host's data dir, the reference's per-host
+    data-dir layout)."""
+    monkeypatch.chdir(tmp_path)
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="20">
+          <plugin id="app" path="{native_bin}" />
+          <host id="alpha">
+            <process plugin="app" starttime="1" arguments="filewrite AAA" />
+          </host>
+          <host id="beta">
+            <process plugin="app" starttime="1" arguments="filewrite BBB" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "alpha", "beta") == {"alpha": [0], "beta": [0]}
+    root = tmp_path / "shadow.data" / "hosts"
+    assert (root / "alpha" / "state.txt").read_text() == "AAA"
+    assert (root / "beta" / "state.txt").read_text() == "BBB"
