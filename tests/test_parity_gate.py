@@ -113,8 +113,10 @@ def test_parity_gate_100_host_lossy_star():
     d_global, log_global = _run("global", 0)
     d_steal, _ = _run("steal", 4)
     d_tpu, log_tpu = _run("tpu", 0)
+    d_tpu_mt, _ = _run("tpu", 4)
     d_shard, _ = _run("tpu", 0, tpu_devices=8, tpu_shard_matrix=True)
     assert d_global == d_steal, "steal x4 diverged from serial"
     assert d_global == d_tpu, "tpu policy diverged from serial"
+    assert d_global == d_tpu_mt, "tpu x4 workers diverged from serial"
     assert d_global == d_shard, "matrix-sharded tpu diverged from serial"
     assert log_global == log_tpu, "stripped logs differ global vs tpu"

@@ -58,3 +58,34 @@ def test_phold_population_constant(serial_totals):
     for out_pkts, in_pkts in serial_totals:
         assert out_pkts >= 2, serial_totals
         assert in_pkts >= 2, serial_totals
+
+
+def test_steal_soak_large_phold():
+    """Concurrency soak for the indexed ready-heap + stealing paths: a
+    larger PHOLD (48 hosts, 8 worker threads, many rounds) must match the
+    serial run exactly.  Shakes the publish/consume races the small
+    equivalence fixtures might never hit."""
+    n = 48
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="6">
+          <plugin id="phold" path="python:phold" />
+          <host id="phold" quantity="{n}" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="phold" starttime="1" arguments="{n} 3 9000" />
+          </host>
+        </shadow>
+    """)
+
+    def run(policy, workers):
+        cfg = configuration.parse_xml(xml)
+        ctrl = Controller(Options(scheduler_policy=policy, workers=workers,
+                                  stop_time_sec=cfg.stop_time_sec), cfg)
+        assert ctrl.run() == 0
+        return tuple(
+            (h.tracker.out_remote.packets_data,
+             h.tracker.in_remote.packets_data)
+            for h in (ctrl.engine.host_by_name(f"phold{i + 1}")
+                      for i in range(n)))
+
+    serial = run("global", 0)
+    assert run("steal", 8) == serial
+    assert run("threadXhost", 8) == serial
