@@ -680,3 +680,31 @@ def test_per_host_file_namespace(native_bin, tmp_path, monkeypatch):
     root = tmp_path / "shadow.data" / "hosts"
     assert (root / "alpha" / "state.txt").read_text() == "AAA"
     assert (root / "beta" / "state.txt").read_text() == "BBB"
+
+
+def test_native_tcp_half_close(native_bin):
+    """shutdown(SHUT_WR) half-close: the client sends, FINs its direction,
+    then still receives the server's summary reply — dual execution
+    (reference: src/test/shutdown)."""
+    srv = subprocess.Popen([native_bin, "sumserver", "39483"])
+    time.sleep(0.2)
+    cli = subprocess.run([native_bin, "halfclient", "127.0.0.1", "39483",
+                          "50000"], timeout=20)
+    assert cli.returncode == 0
+    assert srv.wait(timeout=20) == 0
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="60">
+          <plugin id="app" path="{native_bin}" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="1" arguments="sumserver 8003" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240">
+            <process plugin="app" starttime="2"
+                     arguments="halfclient server 8003 50000" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "server", "client") == \
+        {"server": [0], "client": [0]}
