@@ -708,3 +708,31 @@ def test_native_tcp_half_close(native_bin):
     assert rc == 0
     assert exit_codes(ctrl, "server", "client") == \
         {"server": [0], "client": [0]}
+
+
+def test_pooled_plugins_1000_instances(native_so):
+    """Workload-#3 scale for the native plane: 1000 real plugin instances
+    (500 UDP echo pairs) run in ~77 pooled OS processes — the dlmopen
+    namespace model at the scale the reference runs real Tor networks."""
+    n = 500
+    hosts = []
+    for i in range(n):
+        hosts.append(
+            f'<host id="srv{i}" bandwidthdown="10240" bandwidthup="10240">'
+            f'<process plugin="app" starttime="1" '
+            f'arguments="udpserver {8000 + i % 1000} 1" /></host>')
+        hosts.append(
+            f'<host id="cli{i}" bandwidthdown="10240" bandwidthup="10240">'
+            f'<process plugin="app" starttime="2" '
+            f'arguments="udpclient srv{i} {8000 + i % 1000} 1 64" /></host>')
+    xml = (f'<shadow stoptime="30"><plugin id="app" path="{native_so}" />'
+           + "".join(hosts) + '</shadow>')
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    pools = getattr(ctrl.engine, "_native_pools", [])
+    assert len(pools) <= 80, f"{len(pools)} pools for 1000 instances"
+    assert sum(p.count for p in pools) == 1000
+    bad = [i for i in range(n)
+           if exit_codes(ctrl, f"srv{i}", f"cli{i}")
+           != {f"srv{i}": [0], f"cli{i}": [0]}]
+    assert not bad, f"failed pairs: {bad[:5]}"
