@@ -721,6 +721,58 @@ int main(int argc, char **argv) {
   if (!strcmp(cmd, "sockmisc")) return cmd_sockmisc();
   if (!strcmp(cmd, "selfpipe")) return cmd_selfpipe();
   if (!strcmp(cmd, "timercheck")) return cmd_timercheck();
+  if (!strcmp(cmd, "relay") && argc >= 5) {
+    /* TCP relay: accept one connection, dial the next hop, shuttle bytes
+     * both ways until both sides close — a chain of these is the
+     * onion-routing-shaped path real Tor builds (reference workload #3/#4
+     * run chains of real relays the same way) */
+    uint16_t lport = (uint16_t)atoi(argv[2]);
+    const char *nhost = argv[3];
+    uint16_t nport = (uint16_t)atoi(argv[4]);
+    int lfd = socket(AF_INET, SOCK_STREAM, 0);
+    struct sockaddr_in sin;
+    memset(&sin, 0, sizeof sin);
+    sin.sin_family = AF_INET;
+    sin.sin_addr.s_addr = htonl(INADDR_ANY);
+    sin.sin_port = htons(lport);
+    if (bind(lfd, (struct sockaddr *)&sin, sizeof sin) != 0) return 1;
+    if (listen(lfd, 4) != 0) return 2;
+    int cfd = accept(lfd, NULL, NULL);
+    if (cfd < 0) return 3;
+    struct sockaddr_in dst;
+    if (resolve(nhost, nport, &dst) != 0) return 4;
+    int ufd = socket(AF_INET, SOCK_STREAM, 0);
+    if (connect(ufd, (struct sockaddr *)&dst, sizeof dst) != 0) return 5;
+    struct pollfd pf[2] = {{cfd, POLLIN, 0}, {ufd, POLLIN, 0}};
+    char rbuf[16384];
+    int open_dirs = 2;
+    while (open_dirs > 0) {
+      if (poll(pf, 2, 30000) <= 0) return 6;
+      for (int k = 0; k < 2; k++) {
+        if (!(pf[k].revents & (POLLIN | POLLHUP))) continue;
+        int from = pf[k].fd, to = (pf[k].fd == cfd) ? ufd : cfd;
+        ssize_t r = recv(from, rbuf, sizeof rbuf, 0);
+        if (r < 0) return 7;
+        if (r == 0) {
+          shutdown(to, SHUT_WR);
+          pf[k].events = 0;
+          open_dirs--;
+          continue;
+        }
+        ssize_t off = 0;
+        while (off < r) {
+          ssize_t w = send(to, rbuf + off, (size_t)(r - off), 0);
+          if (w <= 0) return 8;
+          off += w;
+        }
+      }
+    }
+    close(cfd);
+    close(ufd);
+    close(lfd);
+    printf("relay OK\n");
+    return 0;
+  }
   if (!strcmp(cmd, "filewrite") && argc >= 3) {
     /* per-host file namespace: cwd is this host's data dir, so a relative
      * path never collides with another host's (reference data-dir layout,

@@ -736,3 +736,42 @@ def test_pooled_plugins_1000_instances(native_so):
            if exit_codes(ctrl, f"srv{i}", f"cli{i}")
            != {f"srv{i}": [0], f"cli{i}": [0]}]
     assert not bad, f"failed pairs: {bad[:5]}"
+
+
+def test_native_relay_chain(native_bin):
+    """Onion-routing-shaped path with REAL binaries: a TCP transfer
+    traverses client -> relay1 -> relay2 -> relay3 -> server, five real
+    processes shuttling bytes under the virtual clock (the traffic shape
+    of the reference's real-Tor workloads #3/#4)."""
+    nbytes = 100_000
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="120">
+          <plugin id="app" path="{native_bin}" />
+          <host id="server" bandwidthdown="20480" bandwidthup="20480">
+            <process plugin="app" starttime="1"
+                     arguments="tcpserver 8000 {nbytes}" />
+          </host>
+          <host id="relay3" bandwidthdown="20480" bandwidthup="20480">
+            <process plugin="app" starttime="2"
+                     arguments="relay 9003 server 8000" />
+          </host>
+          <host id="relay2" bandwidthdown="20480" bandwidthup="20480">
+            <process plugin="app" starttime="2"
+                     arguments="relay 9002 relay3 9003" />
+          </host>
+          <host id="relay1" bandwidthdown="20480" bandwidthup="20480">
+            <process plugin="app" starttime="2"
+                     arguments="relay 9001 relay2 9002" />
+          </host>
+          <host id="client" bandwidthdown="20480" bandwidthup="20480">
+            <process plugin="app" starttime="3"
+                     arguments="tcpclient relay1 9001 {nbytes}" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "server", "relay1", "relay2", "relay3",
+                      "client") == \
+        {"server": [0], "relay1": [0], "relay2": [0], "relay3": [0],
+         "client": [0]}
