@@ -775,3 +775,40 @@ def test_native_relay_chain(native_bin):
                       "client") == \
         {"server": [0], "relay1": [0], "relay2": [0], "relay3": [0],
          "client": [0]}
+
+
+def test_pooled_relay_circuits_mini_tor(native_so):
+    """Mini-Tor of REAL binaries: 25 circuits, each a client pushing 50kB
+    through 3 dedicated relay processes to a checksumming server — 125
+    pooled plugin instances in ~10 OS processes, under the device-batched
+    tpu policy.  The shape of reference workload #3 with unmodified
+    binaries at every hop."""
+    n_circ = 25
+    nbytes = 50_000
+    hosts = []
+    for c in range(n_circ):
+        p = 9000 + c * 10
+        hosts.append(
+            f'<host id="dst{c}" bandwidthdown="20480" bandwidthup="20480">'
+            f'<process plugin="app" starttime="1" '
+            f'arguments="tcpserver {p} {nbytes}" /></host>')
+        for hop, (lp, nh, np_) in enumerate(
+                ((p + 3, f"dst{c}", p), (p + 2, f"r{c}2", p + 3),
+                 (p + 1, f"r{c}1", p + 2))):
+            hosts.append(
+                f'<host id="r{c}{2 - hop}" bandwidthdown="20480" '
+                f'bandwidthup="20480"><process plugin="app" starttime="2" '
+                f'arguments="relay {lp} {nh} {np_}" /></host>')
+        hosts.append(
+            f'<host id="cl{c}" bandwidthdown="20480" bandwidthup="20480">'
+            f'<process plugin="app" starttime="3" '
+            f'arguments="tcpclient r{c}0 {p + 1} {nbytes}" /></host>')
+    xml = (f'<shadow stoptime="120"><plugin id="app" path="{native_so}" />'
+           + "".join(hosts) + "</shadow>")
+    rc, ctrl = run_sim(xml, policy="tpu")
+    assert rc == 0
+    pools = getattr(ctrl.engine, "_native_pools", [])
+    assert len(pools) <= 12
+    for c in range(n_circ):
+        names = (f"dst{c}", f"r{c}0", f"r{c}1", f"r{c}2", f"cl{c}")
+        assert exit_codes(ctrl, *names) == {n: [0] for n in names}, c
