@@ -812,3 +812,25 @@ def test_pooled_relay_circuits_mini_tor(native_so):
     for c in range(n_circ):
         names = (f"dst{c}", f"r{c}0", f"r{c}1", f"r{c}2", f"cl{c}")
         assert exit_codes(ctrl, *names) == {n: [0] for n in names}, c
+
+
+def test_pooled_workload_digest_parity(native_so):
+    """Pooled instances preserve cross-policy determinism: same final
+    state digest under global and tpu scheduling."""
+    from shadow_tpu.core.checkpoint import state_digest
+    hosts = []
+    for i in range(6):
+        hosts.append(
+            f'<host id="s{i}"><process plugin="app" starttime="1" '
+            f'arguments="udpserver {8100 + i} 2" /></host>')
+        hosts.append(
+            f'<host id="c{i}"><process plugin="app" starttime="2" '
+            f'arguments="udpclient s{i} {8100 + i} 2 300" /></host>')
+    xml = (f'<shadow stoptime="30"><plugin id="app" path="{native_so}" />'
+           + "".join(hosts) + "</shadow>")
+    digests = {}
+    for policy in ("global", "tpu"):
+        rc, ctrl = run_sim(xml, policy=policy)
+        assert rc == 0, policy
+        digests[policy] = state_digest(ctrl.engine)
+    assert digests["global"] == digests["tpu"]
