@@ -89,10 +89,14 @@ class Controller:
         # arguments, e.g. arguments='-c "import x; run(x)"' for an
         # interpreter plugin.  Unbalanced quotes fall back to plain split.
         if pc.arguments:
-            import shlex
-            try:
-                args = shlex.split(pc.arguments)
-            except ValueError:
+            if '"' in pc.arguments or "'" in pc.arguments \
+                    or "\\" in pc.arguments:
+                import shlex
+                try:
+                    args = shlex.split(pc.arguments)
+                except ValueError:
+                    args = pc.arguments.split()
+            else:
                 args = pc.arguments.split()
         else:
             args = []

@@ -12,11 +12,25 @@ from typing import Optional
 
 
 def ip_to_int(dotted: str) -> int:
+    # manual parse: ~10x faster than ipaddress.IPv4Address and this runs
+    # several times per host during 10k-host boot; falls back for anything
+    # that isn't plain dotted-quad
+    parts = dotted.split(".")
+    if len(parts) == 4:
+        try:
+            a, b, c, d = (int(p) for p in parts)
+            if 0 <= a <= 255 and 0 <= b <= 255 and 0 <= c <= 255 \
+                    and 0 <= d <= 255 \
+                    and all(p == str(int(p)) for p in parts):
+                return (a << 24) | (b << 16) | (c << 8) | d
+        except ValueError:
+            pass
     return int(ipaddress.IPv4Address(dotted))
 
 
 def int_to_ip(v: int) -> str:
-    return str(ipaddress.IPv4Address(v))
+    return (f"{(v >> 24) & 0xFF}.{(v >> 16) & 0xFF}."
+            f"{(v >> 8) & 0xFF}.{v & 0xFF}")
 
 
 LOCALHOST_IP = ip_to_int("127.0.0.1")

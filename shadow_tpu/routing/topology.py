@@ -182,6 +182,7 @@ class Topology:
         self.path_packet_counts: Optional[np.ndarray] = None
         self._finalized = False
         self._device_cache = None
+        self._attach_cands_cache: Dict[tuple, list] = {}
 
     # -- construction ------------------------------------------------------
     @classmethod
@@ -226,25 +227,36 @@ class Topology:
         """
         if self._finalized:
             raise RuntimeError("cannot attach hosts after finalize()")
-        cands = list(self.vertices)
 
         if ip_hint:
-            exact = [v for v in cands if v.attrs.get("ip") == ip_hint]
+            exact = [v for v in self.vertices if v.attrs.get("ip") == ip_hint]
             if exact:
                 return self._record_attachment(exact[0].index, ip)
 
-        def filt(key: str, want: Optional[str]):
-            nonlocal cands
-            if not want:
-                return
-            kept = [v for v in cands if v.attrs.get(key, "").lower() == want.lower()]
-            if kept:
-                cands = kept
+        # hint filtering is identical for every host with the same hints
+        # (the common case: none) — memoize the candidate list so 10k-host
+        # boots don't rescan the vertex set per host
+        hint_key = (type_hint, city_hint, country_hint, geocode_hint)
+        cached = self._attach_cands_cache.get(hint_key)
+        if cached is None:
+            cands = list(self.vertices)
 
-        filt("type", type_hint)
-        filt("citycode", city_hint)
-        filt("countrycode", country_hint)
-        filt("geocode", geocode_hint)
+            def filt(key: str, want: Optional[str]):
+                nonlocal cands
+                if not want:
+                    return
+                kept = [v for v in cands
+                        if v.attrs.get(key, "").lower() == want.lower()]
+                if kept:
+                    cands = kept
+
+            filt("type", type_hint)
+            filt("citycode", city_hint)
+            filt("countrycode", country_hint)
+            filt("geocode", geocode_hint)
+            self._attach_cands_cache[hint_key] = cands
+        else:
+            cands = cached
 
         if ip_hint and len(cands) > 1:
             want = ip_to_int(ip_hint)
