@@ -59,8 +59,13 @@ class Controller:
                     bw_up_kibps=hc.bandwidth_up_kibps,
                     qdisc=hc.qdisc or opts.interface_qdisc,
                     router_queue=opts.router_queue,
-                    recv_buf_size=hc.socket_recv_buffer or opts.socket_recv_buffer,
-                    send_buf_size=hc.socket_send_buffer or opts.socket_send_buffer,
+                    # 0 means "default start size + autotune", never a
+                    # zero-byte buffer (a 0 advertised window would
+                    # deadlock every transfer at handshake)
+                    recv_buf_size=(hc.socket_recv_buffer
+                                   or opts.socket_recv_buffer or 174760),
+                    send_buf_size=(hc.socket_send_buffer
+                                   or opts.socket_send_buffer or 131072),
                     autotune_recv=opts.socket_autotune and not hc.socket_recv_buffer,
                     autotune_send=opts.socket_autotune and not hc.socket_send_buffer,
                     cpu_frequency_khz=hc.cpu_frequency_khz,

@@ -603,6 +603,27 @@ class TCPSocket(Socket):
                           min(self.srtt_ns + 4 * self.rttvar_ns, RTO_MAX_NS))
         self._autotune(sample_ns)
 
+    def _recv_autotune(self) -> None:
+        """Receiver-side buffer autotuning, ticked from the RECEIVE path
+        (the reference tunes its receive buffer while data arrives,
+        tcp.c:441-521): once per RTT-ish window, grow toward 2x the bytes
+        received in that window.  A pure receiver never processes ACKs, so
+        the sender-path hook alone would never fire for it."""
+        if not self.autotune_recv:
+            return
+        now = self.host.now
+        if self._rtt_window_start == 0:
+            self._rtt_window_start = now
+            return
+        rtt = self.srtt_ns or (200 * stime.SIM_TIME_MS)
+        if now - self._rtt_window_start < rtt:
+            return
+        target = 2 * self._rtt_bytes_in
+        if target > self.recv_buf_size:
+            self.recv_buf_size = min(target, defs.CONFIG_TCP_RMEM_MAX)
+        self._rtt_bytes_in = 0
+        self._rtt_window_start = now
+
     def _autotune(self, rtt_ns: int) -> None:
         """Grow buffers toward 2x the measured bandwidth-delay product
         (reference per-RTT autotuning, tcp.c:441-600)."""
@@ -834,6 +855,7 @@ class TCPSocket(Socket):
             self._schedule_delayed_ack()
         if size > 0:
             self._rtt_bytes_in += size
+            self._recv_autotune()
             self._update_readable()
 
     def _append_read(self, data: bytes) -> None:
