@@ -1,0 +1,119 @@
+"""Round-window sizing (--runahead), the bootstrap grace period, and the
+host CPU-delay model — claimed behaviors previously unasserted.
+
+References: master.c:133-159 (min-jump/lookahead), worker.c:445-453 +
+master.c:261-268 (bootstrap grace: reliable unthrottled links), cpu.c +
+event.c:75-84 (CPU delay defers event execution)."""
+
+import textwrap
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+
+LOSSY = textwrap.dedent("""\
+    <graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+      <key id="lat" for="edge" attr.name="latency" attr.type="double"/>
+      <key id="loss" for="edge" attr.name="packetloss" attr.type="double"/>
+      <key id="nip" for="node" attr.name="ip" attr.type="string"/>
+      <graph edgedefault="undirected">
+        <node id="a"><data key="nip">11.0.0.1</data></node>
+        <node id="b"><data key="nip">11.0.0.2</data></node>
+        <edge source="a" target="b">
+          <data key="lat">20.0</data><data key="loss">0.5</data>
+        </edge>
+        <edge source="a" target="a"><data key="lat">1.0</data></edge>
+        <edge source="b" target="b"><data key="lat">1.0</data></edge>
+      </graph>
+    </graphml>
+""")
+
+
+def _echo_xml(stoptime=10, bootstraptime=0):
+    boot = f' bootstraptime="{bootstraptime}"' if bootstraptime else ""
+    return textwrap.dedent(f"""\
+        <shadow stoptime="{stoptime}"{boot}>
+          <topology><![CDATA[{LOSSY}]]></topology>
+          <plugin id="echo" path="python:echo" />
+          <host id="server" iphint="11.0.0.1">
+            <process plugin="echo" starttime="1" arguments="udp server 9000" />
+          </host>
+          <host id="client" iphint="11.0.0.2">
+            <process plugin="echo" starttime="2"
+                     arguments="udp client server 9000 20 400" />
+          </host>
+        </shadow>
+    """)
+
+
+def _run(xml, **opt_kw):
+    cfg = configuration.parse_xml(xml)
+    opts = Options(scheduler_policy="global", workers=0,
+                   stop_time_sec=cfg.stop_time_sec, **opt_kw)
+    if cfg.bootstrap_end_sec:
+        opts.bootstrap_end_sec = cfg.bootstrap_end_sec
+    ctrl = Controller(opts, cfg)
+    rc = ctrl.run()
+    assert rc == 0
+    return ctrl
+
+
+PHOLD_XML = textwrap.dedent("""\
+    <shadow stoptime="6">
+      <plugin id="phold" path="python:phold" />
+      <host id="phold" quantity="8" bandwidthdown="10240" bandwidthup="10240">
+        <process plugin="phold" starttime="1" arguments="8 2 9000" />
+      </host>
+    </shadow>
+""")
+
+
+def test_runahead_shrinks_round_windows():
+    """--runahead overrides the topology lookahead: a smaller window means
+    more rounds for the same continuously-busy virtual time (PHOLD keeps
+    every window non-empty)."""
+    base = _run(PHOLD_XML)
+    small = _run(PHOLD_XML, runahead_ms=2)
+    assert small.engine.rounds_executed > base.engine.rounds_executed
+
+
+def test_bootstrap_grace_suppresses_loss():
+    """During the bootstrap period links are force-reliable: a 50%-loss
+    link drops nothing while bootstrapping, and drops plenty after."""
+    lossy = _run(_echo_xml(stoptime=10))
+    graceful = _run(_echo_xml(stoptime=10, bootstraptime=10))
+    drops_lossy = lossy.engine.counters._new.get("packet_drop", 0)
+    drops_graceful = graceful.engine.counters._new.get("packet_drop", 0)
+    assert drops_lossy > 0, "50% loss link produced no drops"
+    assert drops_graceful == 0, \
+        f"drops during bootstrap grace: {drops_graceful}"
+
+
+def test_cpu_model_semantics_and_plumbing():
+    """The CPU-delay model (cpu.c:26-47 frequency scaling, blocking above
+    threshold; event.c:75-84 defers blocked hosts).  The wall-measurement
+    input is nondeterministic by design (as in the reference), so the
+    scaling/blocking math is asserted directly; the config path is checked
+    by instantiating a host with cpufrequency set."""
+    from shadow_tpu.host.cpu import CPU
+
+    # a 1.5 GHz simulated host on a 3 GHz machine: delays double
+    cpu = CPU(1_500_000, 3_000_000, threshold_ns=10_000, precision_ns=200)
+    assert cpu.enabled
+    cpu.update_time(1_000_000)
+    cpu.add_delay(6_000)            # measured 6 us -> 12 us virtual
+    assert cpu.get_delay() == 12_000
+    assert cpu.is_blocked()         # 12 us > 10 us threshold
+    cpu.update_time(1_000_000 + 12_000)
+    assert cpu.get_delay() == 0 and not cpu.is_blocked()
+    # precision rounding
+    cpu.add_delay(150)              # 300 ns virtual -> rounds to 200
+    assert cpu.get_delay() == 200
+
+    # config plumbing: cpufrequency on the host enables the model
+    xml = _echo_xml().replace('<host id="server" iphint="11.0.0.1">',
+                              '<host id="server" iphint="11.0.0.1" '
+                              'cpufrequency="2000000">')
+    ctrl = _run(xml)
+    assert ctrl.engine.host_by_name("server").cpu is not None
+    assert ctrl.engine.host_by_name("client").cpu is None
