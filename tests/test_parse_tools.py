@@ -1,0 +1,62 @@
+"""Log-parse tooling (tools/parse_log.py) against a real run's output, and
+the benchmark workload-generator CLI (tools/workloads.py) — the
+parse-shadow.py / generate-config capability row."""
+
+import io
+import textwrap
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.logger import SimLogger, set_logger, get_logger
+from shadow_tpu.core.options import Options
+from shadow_tpu.tools import workloads
+from shadow_tpu.tools.parse_log import parse_log, strip_log
+
+
+def test_parse_log_summarizes_a_real_run():
+    xml = textwrap.dedent("""\
+        <shadow stoptime="130">
+          <plugin id="echo" path="python:echo" />
+          <host id="server" heartbeatfrequency="60">
+            <process plugin="echo" starttime="1" arguments="udp server 9000" />
+          </host>
+          <host id="client" heartbeatfrequency="60">
+            <process plugin="echo" starttime="2"
+                     arguments="udp client server 9000 10 500" />
+          </host>
+        </shadow>
+    """)
+    buf = io.StringIO()
+    set_logger(SimLogger(level="message", stream=buf))
+    try:
+        cfg = configuration.parse_xml(xml)
+        ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                                  stop_time_sec=cfg.stop_time_sec), cfg)
+        assert ctrl.run() == 0
+        get_logger().flush()
+    finally:
+        set_logger(SimLogger())
+    summary = parse_log(buf.getvalue().splitlines())
+    assert summary["num_hosts"] == 2
+    assert summary["total_rx_bytes"] > 0
+    assert summary["run"]["rounds"] == ctrl.engine.rounds_executed
+    assert summary["run"]["events"] == ctrl.engine.events_executed
+    assert summary["sim_seconds"] > 0
+    # heartbeat series carry per-host time points
+    assert all(len(s) >= 2 for s in summary["series"].values())
+    # strip form is stable and wall-free
+    stripped = list(strip_log(buf.getvalue().splitlines()))
+    assert stripped and not any("wall=" in l for l in stripped)
+
+
+def test_workload_generator_configs_parse():
+    """Every named benchmark config the generator emits is loadable by the
+    configuration layer (tor10k only when the reference topology exists)."""
+    import os
+    for name, make in workloads.NAMED.items():
+        if name == "tor10k" and not os.path.exists(
+                "/root/reference/resource/topology.graphml.xml.xz"):
+            continue
+        cfg = configuration.parse_xml(make())
+        assert cfg.hosts, name
+        assert cfg.stop_time_sec > 0, name
