@@ -47,6 +47,9 @@ class Controller:
     def setup(self) -> None:
         """Register programs and hosts (master.c:279-392)."""
         opts = self.options
+        # <shadow environment="K=V;..."> is injected into every native
+        # plugin's environment (reference main.c:474-524)
+        self.engine.plugin_environment = dict(self.config.environment or {})
         for prog in self.config.programs:
             self._program_paths[prog.id] = prog.path
 

@@ -639,6 +639,9 @@ def run_native_plugin(api, args: List[str], binary: str,
     env = dict(os.environ)
     env["LD_PRELOAD"] = (_PRELOAD_LIB + (" " + env["LD_PRELOAD"]
                                          if env.get("LD_PRELOAD") else ""))
+    # config-level environment injection (<shadow environment=...>)
+    env.update(getattr(getattr(api.host, "engine", None),
+                       "plugin_environment", None) or {})
     env["SHADOW_TPU_FD"] = str(child_side.fileno())
     env["SHADOW_TPU_EPOCH_NS"] = str(stime.EMULATED_TIME_OFFSET)
     # deterministic virtual pid (the reference's plugins see their virtual
@@ -791,9 +794,11 @@ _POOL_BIN = os.path.join(os.path.dirname(_PRELOAD_LIB), "shadow_pool")
 class NativePool:
     """One shadow_pool helper process + its control channel."""
 
-    def __init__(self):
+    def __init__(self, extra_env: Optional[dict] = None):
         self.control, child_control = real_socket.socketpair()
         env = dict(os.environ)
+        if extra_env:
+            env.update(extra_env)
         env.pop("SHADOW_TPU_FD", None)  # the pool itself is not interposed
         # every dlmopen namespace carries its own libc/shim static TLS; the
         # default surplus covers ~10 namespaces, so raise it (the reference
@@ -840,7 +845,8 @@ def _pool_for(engine) -> NativePool:
         pools = engine._native_pools = []
     if not pools or pools[-1].count >= POOL_CAPACITY \
             or pools[-1].proc.poll() is not None:
-        pools.append(NativePool())
+        pools.append(NativePool(
+            extra_env=getattr(engine, "plugin_environment", None)))
     return pools[-1]
 
 

@@ -721,6 +721,11 @@ int main(int argc, char **argv) {
   if (!strcmp(cmd, "sockmisc")) return cmd_sockmisc();
   if (!strcmp(cmd, "selfpipe")) return cmd_selfpipe();
   if (!strcmp(cmd, "timercheck")) return cmd_timercheck();
+  if (!strcmp(cmd, "envcheck") && argc >= 4) {
+    /* <shadow environment=...> injection (reference main.c:474-524) */
+    const char *v = getenv(argv[2]);
+    return (v && strcmp(v, argv[3]) == 0) ? 0 : 1;
+  }
   if (!strcmp(cmd, "relay") && argc >= 5) {
     /* TCP relay: accept one connection, dial the next hop, shuttle bytes
      * both ways until both sides close — a chain of these is the

@@ -834,3 +834,25 @@ def test_pooled_workload_digest_parity(native_so):
         assert rc == 0, policy
         digests[policy] = state_digest(ctrl.engine)
     assert digests["global"] == digests["tpu"]
+
+
+def test_environment_injection(native_bin, native_so):
+    """<shadow environment="K=V;..."> reaches native plugins' environments,
+    per-process and pooled (reference main.c:474-524)."""
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="20" environment="SHD_TESTVAR=hello42;OTHER=x">
+          <plugin id="app" path="{native_bin}" />
+          <plugin id="appso" path="{native_so}" />
+          <host id="a">
+            <process plugin="app" starttime="1"
+                     arguments="envcheck SHD_TESTVAR hello42" />
+          </host>
+          <host id="b">
+            <process plugin="appso" starttime="1"
+                     arguments="envcheck SHD_TESTVAR hello42" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "a", "b") == {"a": [0], "b": [0]}
