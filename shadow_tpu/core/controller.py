@@ -48,8 +48,14 @@ class Controller:
         """Register programs and hosts (master.c:279-392)."""
         opts = self.options
         # <shadow environment="K=V;..."> is injected into every native
-        # plugin's environment (reference main.c:474-524)
+        # plugin's environment (reference main.c:474-524); a config-level
+        # preload path rides the same mechanism (main.c scrubs/builds
+        # LD_PRELOAD the same way)
         self.engine.plugin_environment = dict(self.config.environment or {})
+        if self.config.preload:
+            prior = self.engine.plugin_environment.get("LD_PRELOAD", "")
+            self.engine.plugin_environment["LD_PRELOAD"] = (
+                self.config.preload + (" " + prior if prior else ""))
         for prog in self.config.programs:
             self._program_paths[prog.id] = prog.path
 

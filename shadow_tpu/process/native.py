@@ -637,11 +637,13 @@ def run_native_plugin(api, args: List[str], binary: str,
     name = api.process.name
     sim_side, child_side = real_socket.socketpair()
     env = dict(os.environ)
-    env["LD_PRELOAD"] = (_PRELOAD_LIB + (" " + env["LD_PRELOAD"]
-                                         if env.get("LD_PRELOAD") else ""))
-    # config-level environment injection (<shadow environment=...>)
+    # config-level environment injection (<shadow environment=...>) FIRST,
+    # then the shim is prepended so an injected LD_PRELOAD (the config
+    # 'preload' attribute) chains behind it instead of clobbering it
     env.update(getattr(getattr(api.host, "engine", None),
                        "plugin_environment", None) or {})
+    env["LD_PRELOAD"] = (_PRELOAD_LIB + (" " + env["LD_PRELOAD"]
+                                         if env.get("LD_PRELOAD") else ""))
     env["SHADOW_TPU_FD"] = str(child_side.fileno())
     env["SHADOW_TPU_EPOCH_NS"] = str(stime.EMULATED_TIME_OFFSET)
     # deterministic virtual pid (the reference's plugins see their virtual
