@@ -76,6 +76,16 @@ extern "C" void shd_set_pool_hooks(void (*wait_readable)(int fd),
   g_pool_exit = on_exit_fn;
 }
 
+/* let other shim translation units retire a pooled instance instead of
+ * exiting the whole pool process; returns 0 when not pooled */
+extern "C" int shd_pool_exit_hook(int status) {
+  if (g_pool_exit) {
+    g_pool_exit(status);
+    return 1;   /* not reached (the hook never returns), but keep C happy */
+  }
+  return 0;
+}
+
 /* App-visible fds for simulated descriptors are allocated densely from
  * SHADOW_TPU_SIM_FD_BASE so they stay below FD_SETSIZE (select must work);
  * this table maps appfd -> simulator handle (cf. the reference's

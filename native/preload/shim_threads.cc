@@ -31,6 +31,7 @@ extern "C" int64_t shd_transact(uint32_t op, int64_t a, int64_t b, int64_t c,
                                 uint32_t payload_len, void *resp_buf,
                                 uint32_t resp_cap, uint32_t *resp_len);
 extern "C" int64_t shd_vtime_ns(void);
+extern "C" int shd_pool_exit_hook(int status);
 
 #define GT_MAX_THREADS 256
 #define GT_STACK_SIZE (1024 * 1024)
@@ -77,6 +78,7 @@ extern "C" int gt_should_park(void) { return g_engaged && g_alive > 1; }
 static void gt_fatal(const char *msg) {
   ssize_t r = ::write(2, msg, strlen(msg));
   (void)r;
+  shd_pool_exit_hook(70);   /* pooled: retire this instance only */
   _exit(70);
 }
 
@@ -187,7 +189,11 @@ static void gt_scheduler_loop(void) {
       swapcontext(&g_sched_ctx, &next->ctx);
       continue;
     }
-    if (g_alive == 0) _exit(0);
+    if (g_alive == 0) {
+      /* pooled: retire just this instance; standalone: process exit */
+      shd_pool_exit_hook(0);
+      _exit(0);
+    }
     gt_sim_wait();
   }
 }
@@ -408,6 +414,7 @@ extern "C" int pthread_equal(pthread_t a, pthread_t b) { return a == b; }
 extern "C" void pthread_exit(void *retval) {
   if (g_engaged) gt_thread_exit(retval);
   /* no green threads: behave like exit of the only thread */
+  shd_pool_exit_hook(0);
   _exit(0);
 }
 

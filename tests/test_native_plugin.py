@@ -856,3 +856,30 @@ def test_environment_injection(native_bin, native_so):
     rc, ctrl = run_sim(xml)
     assert rc == 0
     assert exit_codes(ctrl, "a", "b") == {"a": [0], "b": [0]}
+
+
+def test_pooled_plugin_with_pthreads(native_so):
+    """The cooperative-pthread layer composes with pooling: a pooled
+    instance runs the 2-pthread + mutex + condvar scenario while sibling
+    instances in the same pool process keep working."""
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_so}" />
+          <host id="threads">
+            <process plugin="app" starttime="1" arguments="threads" />
+          </host>
+          <host id="srv">
+            <process plugin="app" starttime="1" arguments="udpserver 8000 2" />
+          </host>
+          <host id="cli">
+            <process plugin="app" starttime="2"
+                     arguments="udpclient srv 8000 2 128" />
+          </host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "threads", "srv", "cli") == \
+        {"threads": [0], "srv": [0], "cli": [0]}
+    pools = getattr(ctrl.engine, "_native_pools", [])
+    assert len(pools) == 1   # all three shared one pool process
