@@ -87,7 +87,8 @@ class Controller:
                     pcap_dir=hc.pcap_dir or opts.pcap_dir,
                     ip_hint=hc.ip_hint, city_hint=hc.city_hint,
                     country_hint=hc.country_hint, geocode_hint=hc.geocode_hint,
-                    type_hint=hc.type_hint)
+                    type_hint=hc.type_hint,
+                    log_level=hc.log_level)
                 host = Host(self.engine.next_host_id(), params, self.engine.root_key)
                 requested_ip = ip_to_int(hc.ip_hint) if hc.ip_hint else None
                 self.engine.add_host(host, requested_ip)

@@ -40,7 +40,8 @@ class HostParams:
                  heartbeat_interval_sec: int = 0, log_pcap: bool = False,
                  pcap_dir: Optional[str] = None, ip_hint: Optional[str] = None,
                  city_hint: Optional[str] = None, country_hint: Optional[str] = None,
-                 geocode_hint: Optional[str] = None, type_hint: Optional[str] = None):
+                 geocode_hint: Optional[str] = None, type_hint: Optional[str] = None,
+                 log_level: Optional[str] = None):
         self.name = name
         self.bw_down_kibps = bw_down_kibps
         self.bw_up_kibps = bw_up_kibps
@@ -62,6 +63,8 @@ class HostParams:
         self.country_hint = country_hint
         self.geocode_hint = geocode_hint
         self.type_hint = type_hint
+        # per-host log filter (reference per-host loglevel attr)
+        self.log_level = log_level
 
 
 class Host:

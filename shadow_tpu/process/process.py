@@ -593,7 +593,14 @@ class SyscallAPI:
         yield None
 
     # -- logging -----------------------------------------------------------
-    def log(self, text: str) -> None:
-        get_logger().message(f"app/{self.process.name}", text)
+    def log(self, text: str, level: str = "message") -> None:
+        """App log line, honoring the host's per-host loglevel filter
+        (reference per-host ``loglevel`` attribute)."""
+        from ..core.logger import LEVELS
+        host_level = getattr(self.host.params, "log_level", None)
+        if host_level is not None \
+                and LEVELS.get(level, 3) > LEVELS.get(host_level, 3):
+            return
+        get_logger().log(level, f"app/{self.process.name}", text)
 
 

@@ -60,3 +60,33 @@ def test_workload_generator_configs_parse():
         cfg = configuration.parse_xml(make())
         assert cfg.hosts, name
         assert cfg.stop_time_sec > 0, name
+
+
+def test_per_host_loglevel_filters_app_logs():
+    """The per-host loglevel attribute silences that host's app messages
+    without touching other hosts (reference per-host loglevel)."""
+    xml = textwrap.dedent("""\
+        <shadow stoptime="20">
+          <plugin id="echo" path="python:echo" />
+          <host id="quiet" loglevel="warning">
+            <process plugin="echo" starttime="1" arguments="udp server 9000" />
+          </host>
+          <host id="chatty">
+            <process plugin="echo" starttime="2"
+                     arguments="udp client quiet 9000 3 200" />
+          </host>
+        </shadow>
+    """)
+    buf = io.StringIO()
+    set_logger(SimLogger(level="message", stream=buf))
+    try:
+        cfg = configuration.parse_xml(xml)
+        ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                                  stop_time_sec=cfg.stop_time_sec), cfg)
+        assert ctrl.run() == 0
+        get_logger().flush()
+    finally:
+        set_logger(SimLogger())
+    out = buf.getvalue()
+    assert "app/chatty" in out          # unfiltered host logs normally
+    assert "app/quiet" not in out       # warning-level host is silenced
