@@ -88,7 +88,8 @@ class Controller:
                     ip_hint=hc.ip_hint, city_hint=hc.city_hint,
                     country_hint=hc.country_hint, geocode_hint=hc.geocode_hint,
                     type_hint=hc.type_hint,
-                    log_level=hc.log_level)
+                    log_level=hc.log_level,
+                    heartbeat_log_level=hc.heartbeat_log_level)
                 host = Host(self.engine.next_host_id(), params, self.engine.root_key)
                 requested_ip = ip_to_int(hc.ip_hint) if hc.ip_hint else None
                 self.engine.add_host(host, requested_ip)
@@ -118,7 +119,7 @@ class Controller:
         stop_ns = stime.from_seconds(pc.stop_time_sec) if pc.stop_time_sec else 0
         Process(host, f"{host.name}.{pc.plugin}", app_main, args,
                 start_time_ns=stime.from_seconds(pc.start_time_sec),
-                stop_time_ns=stop_ns)
+                stop_time_ns=stop_ns, preload=pc.preload)
 
     def run(self) -> int:
         self.setup()

@@ -41,7 +41,8 @@ class HostParams:
                  pcap_dir: Optional[str] = None, ip_hint: Optional[str] = None,
                  city_hint: Optional[str] = None, country_hint: Optional[str] = None,
                  geocode_hint: Optional[str] = None, type_hint: Optional[str] = None,
-                 log_level: Optional[str] = None):
+                 log_level: Optional[str] = None,
+                 heartbeat_log_level: Optional[str] = None):
         self.name = name
         self.bw_down_kibps = bw_down_kibps
         self.bw_up_kibps = bw_up_kibps
@@ -65,6 +66,7 @@ class HostParams:
         self.type_hint = type_hint
         # per-host log filter (reference per-host loglevel attr)
         self.log_level = log_level
+        self.heartbeat_log_level = heartbeat_log_level
 
 
 class Host:

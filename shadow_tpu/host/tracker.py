@@ -91,7 +91,10 @@ class Tracker:
 
     def heartbeat(self, now: int) -> None:
         r_in, r_out = self.in_remote, self.out_remote
-        get_logger().message(
+        level = getattr(self.host.params, "heartbeat_log_level", None) \
+            or "message"
+        get_logger().log(
+            level,
             "tracker",
             f"[shadow-heartbeat] [{self.host.name}] "
             f"rx={r_in.bytes_total} tx={r_out.bytes_total} "

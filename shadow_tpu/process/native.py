@@ -642,8 +642,15 @@ def run_native_plugin(api, args: List[str], binary: str,
     # 'preload' attribute) chains behind it instead of clobbering it
     env.update(getattr(getattr(api.host, "engine", None),
                        "plugin_environment", None) or {})
-    env["LD_PRELOAD"] = (_PRELOAD_LIB + (" " + env["LD_PRELOAD"]
-                                         if env.get("LD_PRELOAD") else ""))
+    # LD_PRELOAD chain: shim first, then <process preload=...>, then any
+    # config/ambient preloads (reference per-process preload attribute)
+    proc_preload = getattr(api.process, "preload", None)
+    chain = [_PRELOAD_LIB]
+    if proc_preload:
+        chain.append(proc_preload)
+    if env.get("LD_PRELOAD"):
+        chain.append(env["LD_PRELOAD"])
+    env["LD_PRELOAD"] = " ".join(chain)
     env["SHADOW_TPU_FD"] = str(child_side.fileno())
     env["SHADOW_TPU_EPOCH_NS"] = str(stime.EMULATED_TIME_OFFSET)
     # deterministic virtual pid (the reference's plugins see their virtual

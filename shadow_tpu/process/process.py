@@ -81,9 +81,12 @@ class Process:
     """A virtual process on a Host (reference process.c capability)."""
 
     def __init__(self, host, name: str, app_main: Callable, args: List[str],
-                 start_time_ns: int, stop_time_ns: int = 0):
+                 start_time_ns: int, stop_time_ns: int = 0,
+                 preload: Optional[str] = None):
         self.host = host
         self.name = name
+        # per-process extra LD_PRELOAD libs (reference <process preload=...>)
+        self.preload = preload
         self.pid = host.next_process_id()
         self.app_main = app_main
         self.args = args
