@@ -48,6 +48,13 @@ class Engine:
         self._counters_lock = threading.Lock()
         self.plugin_errors = 0
         self.data_directory = options.data_directory
+        # --data-template: seed the data directory from a template tree
+        # (reference slave.c:201-218 copies dataDirTemplatePath)
+        template = getattr(options, "data_template", None)
+        if template and os.path.isdir(template) \
+                and not os.path.exists(self.data_directory):
+            import shutil
+            shutil.copytree(template, self.data_directory)
         self.scheduler = Scheduler(self, options.scheduler_policy,
                                    options.workers, derive(self.root_key, "sched"))
         self._drop_key = derive(self.root_key, "packet_drop")

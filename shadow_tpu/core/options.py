@@ -32,7 +32,7 @@ class Options:
     # TCP
     tcp_congestion_control: str = "reno"  # --tcp-congestion-control
     tcp_ssthresh: int = 0                 # --tcp-ssthresh (0 = unset)
-    tcp_windows: int = 1                  # --tcp-windows
+    tcp_windows: int = 10                 # --tcp-windows: initial send/recv/cwnd in packets (reference default 10, options.c:77)
     # Interface / buffers
     interface_qdisc: str = "fifo"        # --interface-qdisc
     interface_buffer: int = 1024000      # --interface-buffer (bytes)
@@ -80,7 +80,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tcp-congestion-control", choices=TCP_CC_KINDS, default="reno",
                    dest="tcp_congestion_control")
     p.add_argument("--tcp-ssthresh", type=int, default=0, dest="tcp_ssthresh")
-    p.add_argument("--tcp-windows", type=int, default=1, dest="tcp_windows")
+    p.add_argument("--tcp-windows", type=int, default=10, dest="tcp_windows",
+                   help="initial TCP windows in packets (reference options.c:138)")
     p.add_argument("--interface-qdisc", choices=QDISC_KINDS, default="fifo",
                    dest="interface_qdisc")
     p.add_argument("--interface-buffer", type=int, default=1024000, dest="interface_buffer")

@@ -161,7 +161,8 @@ class TCPSocket(Socket):
         opts = self._engine_options()
         kind = getattr(opts, "tcp_congestion_control", "reno") if opts else "reno"
         ssthresh = getattr(opts, "tcp_ssthresh", 0) if opts else 0
-        return make_congestion_control(kind, MSS, ssthresh)
+        init_segments = getattr(opts, "tcp_windows", 10) if opts else 10
+        return make_congestion_control(kind, MSS, ssthresh, init_segments)
 
     def _iface(self):
         return self.host.interface_for_ip(self.bound_ip)

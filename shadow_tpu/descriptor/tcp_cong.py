@@ -19,9 +19,11 @@ class CongestionControl:
 
     name = "base"
 
-    def __init__(self, mss: int, ssthresh: int = 0):
+    def __init__(self, mss: int, ssthresh: int = 0,
+                 init_segments: int = INIT_CWND_SEGMENTS):
         self.mss = mss
-        self.cwnd = INIT_CWND_SEGMENTS * mss
+        # --tcp-windows: initial window in packets (reference tcp.c:2459)
+        self.cwnd = max(1, init_segments) * mss
         # 0 = "infinite" until first loss
         self.ssthresh = ssthresh if ssthresh > 0 else (1 << 30)
         self.in_fast_recovery = False
@@ -103,8 +105,9 @@ class Cubic(CongestionControl):
     C = 0.4          # scaling constant (RFC 9438 §4.1)
     BETA = 0.7       # multiplicative decrease factor
 
-    def __init__(self, mss: int, ssthresh: int = 0):
-        super().__init__(mss, ssthresh)
+    def __init__(self, mss: int, ssthresh: int = 0,
+                 init_segments: int = INIT_CWND_SEGMENTS):
+        super().__init__(mss, ssthresh, init_segments)
         self.w_max = 0.0          # window before last reduction (bytes)
         self.epoch_start_ns = 0
         self.k = 0.0              # time to regrow to w_max (seconds)
@@ -138,11 +141,13 @@ class Cubic(CongestionControl):
             super()._congestion_avoidance(acked_bytes, now_ns)
 
 
-def make_congestion_control(kind: str, mss: int, ssthresh: int = 0) -> CongestionControl:
+def make_congestion_control(kind: str, mss: int, ssthresh: int = 0,
+                            init_segments: int = INIT_CWND_SEGMENTS
+                            ) -> CongestionControl:
     if kind == "reno":
-        return Reno(mss, ssthresh)
+        return Reno(mss, ssthresh, init_segments)
     if kind == "aimd":
-        return AIMD(mss, ssthresh)
+        return AIMD(mss, ssthresh, init_segments)
     if kind == "cubic":
-        return Cubic(mss, ssthresh)
+        return Cubic(mss, ssthresh, init_segments)
     raise ValueError(f"unknown congestion control {kind!r}")

@@ -140,8 +140,20 @@ class TPUPolicy(HostQueuesPolicy):
 
         barrier = engine.scheduler.window_end
         t1 = _walltime.perf_counter_ns()
-        deliver, keep = kernel.step(src_arr, dst_arr, uid_arr, time_arr,
-                                    barrier)
+        # --tpu-max-inflight bounds one device step's padded batch (HBM
+        # safety valve for enormous rounds); lanes are independent, so
+        # chunked steps are exact
+        cap = getattr(engine.options, "tpu_max_inflight", 0) or n
+        if n <= cap:
+            deliver, keep = kernel.step(src_arr, dst_arr, uid_arr, time_arr,
+                                        barrier)
+        else:
+            parts = [kernel.step(src_arr[i:i + cap], dst_arr[i:i + cap],
+                                 uid_arr[i:i + cap], time_arr[i:i + cap],
+                                 barrier)
+                     for i in range(0, n, cap)]
+            deliver = np.concatenate([p[0] for p in parts])
+            keep = np.concatenate([p[1] for p in parts])
         t2 = _walltime.perf_counter_ns()
 
         # per-path packet accounting for the kept lanes, vectorized

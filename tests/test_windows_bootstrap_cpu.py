@@ -117,3 +117,25 @@ def test_cpu_model_semantics_and_plumbing():
     ctrl = _run(xml)
     assert ctrl.engine.host_by_name("server").cpu is not None
     assert ctrl.engine.host_by_name("client").cpu is None
+
+
+def test_tcp_windows_knob_changes_initial_cwnd():
+    """--tcp-windows N sets the initial congestion window in packets
+    (reference tcp.c:2459): a 1-packet window starts slower than the
+    default 10-packet window."""
+    from shadow_tpu.descriptor.tcp_cong import make_congestion_control
+    small = make_congestion_control("reno", 1460, 0, 1)
+    default = make_congestion_control("reno", 1460, 0, 10)
+    assert small.cwnd == 1460
+    assert default.cwnd == 14600
+    # end to end: a 1-packet initial window takes more round trips (more
+    # ACK clock ticks -> more events) to move the same bytes
+    xml = _echo_xml().replace("python:echo", "python:tgen") \
+                     .replace('arguments="udp server 9000"',
+                              'arguments="server 9000"') \
+                     .replace('arguments="udp client server 9000 20 400"',
+                              'arguments="client server 9000 512:65536"') \
+                     .replace('<data key="loss">0.5</data>', '')
+    ev_default = _run(xml).engine.events_executed
+    ev_small = _run(xml, tcp_windows=1).engine.events_executed
+    assert ev_small != ev_default, "--tcp-windows changed nothing"
