@@ -51,10 +51,19 @@ class Engine:
         # --data-template: seed the data directory from a template tree
         # (reference slave.c:201-218 copies dataDirTemplatePath)
         template = getattr(options, "data_template", None)
-        if template and os.path.isdir(template) \
-                and not os.path.exists(self.data_directory):
-            import shutil
-            shutil.copytree(template, self.data_directory)
+        if template:
+            if not os.path.isdir(template):
+                raise FileNotFoundError(
+                    f"--data-template {template!r} is not a directory")
+            if os.path.exists(self.data_directory):
+                get_logger().warning(
+                    "engine",
+                    f"--data-template ignored: data directory "
+                    f"{self.data_directory!r} already exists (delete it to "
+                    "re-seed from the template)")
+            else:
+                import shutil
+                shutil.copytree(template, self.data_directory)
         self.scheduler = Scheduler(self, options.scheduler_policy,
                                    options.workers, derive(self.root_key, "sched"))
         self._drop_key = derive(self.root_key, "packet_drop")

@@ -32,7 +32,11 @@ class Options:
     # TCP
     tcp_congestion_control: str = "reno"  # --tcp-congestion-control
     tcp_ssthresh: int = 0                 # --tcp-ssthresh (0 = unset)
-    tcp_windows: int = 10                 # --tcp-windows: initial send/recv/cwnd in packets (reference default 10, options.c:77)
+    tcp_windows: int = 10                 # --tcp-windows: initial cwnd and
+                                          # pre-handshake send-window seed,
+                                          # in packets (reference default 10,
+                                          # options.c:77; recv window follows
+                                          # the buffer size/autotuning)
     # Interface / buffers
     interface_qdisc: str = "fifo"        # --interface-qdisc
     interface_buffer: int = 1024000      # --interface-buffer (bytes)

@@ -158,10 +158,15 @@ class TCPSocket(Socket):
         return getattr(eng, "options", None)
 
     def _make_cong(self):
+        from .tcp_cong import INIT_CWND_SEGMENTS
         opts = self._engine_options()
         kind = getattr(opts, "tcp_congestion_control", "reno") if opts else "reno"
         ssthresh = getattr(opts, "tcp_ssthresh", 0) if opts else 0
-        init_segments = getattr(opts, "tcp_windows", 10) if opts else 10
+        init_segments = getattr(opts, "tcp_windows", INIT_CWND_SEGMENTS) \
+            if opts else INIT_CWND_SEGMENTS
+        # --tcp-windows also seeds the pre-handshake peer-window assumption
+        # (the real value arrives with the first packet's window field)
+        self.snd_wnd = max(1, init_segments) * MSS
         return make_congestion_control(kind, MSS, ssthresh, init_segments)
 
     def _iface(self):

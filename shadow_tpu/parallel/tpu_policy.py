@@ -143,7 +143,7 @@ class TPUPolicy(HostQueuesPolicy):
         # --tpu-max-inflight bounds one device step's padded batch (HBM
         # safety valve for enormous rounds); lanes are independent, so
         # chunked steps are exact
-        cap = getattr(engine.options, "tpu_max_inflight", 0) or n
+        cap = max(1, getattr(engine.options, "tpu_max_inflight", 0) or n)
         if n <= cap:
             deliver, keep = kernel.step(src_arr, dst_arr, uid_arr, time_arr,
                                         barrier)
