@@ -1,0 +1,86 @@
+"""CLI flag surface (core/options.py): every advertised flag parses into
+the matching Options field — the reference's options.c flag-table parity."""
+
+import pytest
+
+from shadow_tpu.core.options import parse_args
+
+
+def test_every_flag_parses_and_lands():
+    opts = parse_args([
+        "cfg.xml",
+        "--workers", "4",
+        "--scheduler-policy", "tpu",
+        "--seed", "99",
+        "--runahead", "7",
+        "--stop-time", "123",
+        "--bootstrap-end", "30",
+        "--tcp-congestion-control", "cubic",
+        "--tcp-ssthresh", "20000",
+        "--tcp-windows", "4",
+        "--interface-qdisc", "rr",
+        "--interface-buffer", "555000",
+        "--interface-batch", "2",
+        "--router-queue", "static",
+        "--socket-recv-buffer", "111111",
+        "--socket-send-buffer", "222222",
+        "--cpu-threshold", "5000",
+        "--cpu-precision", "100",
+        "--heartbeat-frequency", "15",
+        "--log-level", "info",
+        "--pcap-dir", "/tmp/pcaps",
+        "--data-directory", "mydata",
+        "--data-template", "/tmp/tpl",
+        "--checkpoint-interval", "10",
+        "--checkpoint-dir", "cp",
+        "--tpu-max-inflight", "4096",
+        "--tpu-devices", "8",
+        "--tpu-shard-matrix",
+    ])
+    assert opts.config_path == "cfg.xml"
+    assert opts.workers == 4
+    assert opts.scheduler_policy == "tpu"
+    assert opts.seed == 99
+    assert opts.runahead_ms == 7
+    assert opts.stop_time_sec == 123 and opts.stop_time_explicit
+    assert opts.bootstrap_end_sec == 30
+    assert opts.tcp_congestion_control == "cubic"
+    assert opts.tcp_ssthresh == 20000
+    assert opts.tcp_windows == 4
+    assert opts.interface_qdisc == "rr"
+    assert opts.interface_buffer == 555000
+    assert opts.interface_batch_ms == 2
+    assert opts.router_queue == "static"
+    assert opts.socket_recv_buffer == 111111
+    assert opts.socket_send_buffer == 222222
+    assert opts.cpu_threshold_ns == 5000
+    assert opts.cpu_precision_ns == 100
+    assert opts.heartbeat_interval_sec == 15
+    assert opts.log_level == "info"
+    assert opts.pcap_dir == "/tmp/pcaps"
+    assert opts.data_directory == "mydata"
+    assert opts.data_template == "/tmp/tpl"
+    assert opts.checkpoint_interval_sec == 10
+    assert opts.checkpoint_dir == "cp"
+    assert opts.tpu_max_inflight == 4096
+    assert opts.tpu_devices == 8
+    assert opts.tpu_shard_matrix is True
+
+
+def test_invalid_choices_rejected():
+    for argv in (["--scheduler-policy", "bogus"],
+                 ["--tcp-congestion-control", "bbr"],
+                 ["--interface-qdisc", "cake"],
+                 ["--router-queue", "fq"]):
+        with pytest.raises(SystemExit):
+            parse_args(argv)
+
+
+def test_defaults_match_reference():
+    opts = parse_args([])
+    assert opts.scheduler_policy == "steal"   # options.c:199 default
+    assert opts.tcp_windows == 10             # options.c:77 default
+    assert opts.tcp_congestion_control == "reno"
+    assert opts.interface_qdisc == "fifo"
+    assert opts.heartbeat_interval_sec == 60
+    assert opts.workers == 0
