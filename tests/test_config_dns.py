@@ -75,3 +75,23 @@ def test_dns_requested_ip():
     # duplicate request falls back to auto-assignment
     b = d.register(1, "other", requested_ip=want)
     assert b.ip != want
+
+
+def test_load_dispatches_by_format(tmp_path):
+    """configuration.load() handles .xml, .json, and .yaml files."""
+    xml = ('<shadow stoptime="9"><plugin id="e" path="python:echo" />'
+           '<host id="h"><process plugin="e" starttime="1" '
+           'arguments="udp server 1" /></host></shadow>')
+    d = {"general": {"stop_time": 9},
+         "hosts": {"h": {"processes": [
+             {"path": "python:echo", "args": ["udp", "server", "1"],
+              "start_time": 1}]}}}
+    import json
+    (tmp_path / "c.xml").write_text(xml)
+    (tmp_path / "c.json").write_text(json.dumps(d))
+    import yaml
+    (tmp_path / "c.yaml").write_text(yaml.safe_dump(d))
+    for name in ("c.xml", "c.json", "c.yaml"):
+        cfg = configuration.load(str(tmp_path / name))
+        assert cfg.stop_time_sec == 9, name
+        assert len(cfg.hosts) == 1, name
