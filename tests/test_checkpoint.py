@@ -120,3 +120,19 @@ def test_save_and_resume_digest_roundtrip(tmp_path):
     # a run in a genuinely different state (stopped earlier) must not match
     ctrl2 = run(stop=45)
     assert not resume_digest(snap, ctrl2.engine)
+
+
+def test_checkpoint_parity_across_policies(tmp_path):
+    """Mid-run round-boundary snapshots are policy-independent: the first
+    checkpoint written under global, steal x4, and tpu scheduling carries
+    the identical state digest (event-order parity at an interior virtual
+    time, not just at the end)."""
+    digests = {}
+    for policy, workers in (("global", 0), ("steal", 4), ("tpu", 0)):
+        ckdir = str(tmp_path / f"ck-{policy}{workers}")
+        run(policy=policy, workers=workers,
+            checkpoint_interval_sec=30, checkpoint_dir=ckdir)
+        snaps = sorted(glob.glob(ckdir + "/checkpoint_*.ckpt"))
+        assert snaps, (policy, workers)
+        digests[(policy, workers)] = load_snapshot(snaps[0])["digest"]
+    assert len(set(digests.values())) == 1, digests
