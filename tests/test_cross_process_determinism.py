@@ -1,0 +1,50 @@
+"""Cross-interpreter determinism: two SEPARATE Python processes with
+different PYTHONHASHSEED values produce the identical state digest.
+
+In-process double-run tests can't catch hash-randomization leaks (set
+iteration order, dict-of-set artifacts); the reference's determinism gate
+compares separate invocations, so ours must too."""
+
+import os
+import subprocess
+import sys
+
+SCRIPT = r"""
+import sys
+sys.path.insert(0, {repo!r})
+from shadow_tpu.core import configuration
+from shadow_tpu.core.checkpoint import state_digest
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.logger import SimLogger, set_logger
+from shadow_tpu.core.options import Options
+set_logger(SimLogger(level="warning"))
+xml = '''<shadow stoptime="40">
+  <plugin id="tgen" path="python:tgen" />
+  <plugin id="echo" path="python:echo" />
+  <host id="server"><process plugin="tgen" starttime="1" arguments="server 80" /></host>
+  <host id="c1"><process plugin="tgen" starttime="2" arguments="client server 80 1024:204800" /></host>
+  <host id="u1"><process plugin="echo" starttime="1" arguments="udp server 9000" /></host>
+  <host id="u2"><process plugin="echo" starttime="2" arguments="udp client u1 9000 8 600" /></host>
+</shadow>'''
+cfg = configuration.parse_xml(xml)
+ctrl = Controller(Options(scheduler_policy="global", workers=0, seed=23,
+                          stop_time_sec=cfg.stop_time_sec), cfg)
+assert ctrl.run() == 0
+print(state_digest(ctrl.engine))
+"""
+
+
+def test_identical_digest_across_interpreters():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    digests = []
+    for hashseed in ("1", "424242"):
+        env = dict(os.environ)
+        env["PYTHONHASHSEED"] = hashseed
+        env["JAX_PLATFORMS"] = "cpu"
+        out = subprocess.run(
+            [sys.executable, "-c", SCRIPT.format(repo=repo)],
+            capture_output=True, text=True, timeout=300, env=env)
+        assert out.returncode == 0, out.stderr[-2000:]
+        digests.append(out.stdout.strip().splitlines()[-1])
+    assert digests[0] == digests[1], \
+        f"digests differ across interpreters: {digests}"
