@@ -40,7 +40,11 @@ class Options:
     # Interface / buffers
     interface_qdisc: str = "fifo"        # --interface-qdisc
     interface_buffer: int = 1024000      # --interface-buffer (bytes)
-    interface_batch_ms: int = 1          # --interface-batch (token refill interval)
+    interface_batch_ms: int = 1          # --interface-batch: accepted for
+                                         # flag parity only — the reference
+                                         # parses it and never consumes it
+                                         # (options.c:131); refills are
+                                         # fixed at 1 ms (defs.py)
     router_queue: str = "codel"          # upstream AQM kind (reference host.c:205 default codel)
     socket_recv_buffer: int = 174760     # --socket-recv-buffer (0 = autotune)
     socket_send_buffer: int = 131072     # --socket-send-buffer (0 = autotune)
