@@ -883,3 +883,22 @@ def test_pooled_plugin_with_pthreads(native_so):
         {"threads": [0], "srv": [0], "cli": [0]}
     pools = getattr(ctrl.engine, "_native_pools", [])
     assert len(pools) == 1   # all three shared one pool process
+
+
+def test_mixed_planes_showcase(native_bin, native_so, tmp_path, monkeypatch):
+    """examples/mixed_planes.xml: a Python-plane httpd, a REAL wget in its
+    own interposed process, and a pooled .so pair — three plugin planes,
+    one deterministic virtual network."""
+    if not os.path.exists("/usr/bin/wget"):
+        pytest.skip("system wget not present")
+    monkeypatch.chdir(tmp_path)
+    xml = open(os.path.join(REPO, "examples", "mixed_planes.xml")).read()
+    xml = xml.replace("pool:./testapp.so", native_so)
+    rc, ctrl = run_sim(xml)
+    assert rc == 0
+    assert exit_codes(ctrl, "browser", "peer1", "peer2") == \
+        {"browser": [0], "peer1": [0], "peer2": [0]}
+    # wget's download landed in its host data dir (cwd), byte-exact
+    from shadow_tpu.apps.httpd import _body
+    out = tmp_path / "shadow.data" / "hosts" / "browser" / "download.bin"
+    assert out.read_bytes() == _body(100000)
