@@ -222,6 +222,34 @@ def _run_sim(xml, policy: str, workers: int, stop: int) -> dict:
     }
 
 
+def _run_procs(xml, n_procs: int, stop: int, policy: str = "global") -> dict:
+    """Sharded multi-process run (parallel/procs.py) — the configuration
+    that actually scales with cores (the GIL caps the threaded policies).
+    Wall time includes the children's config/topology boot, honestly."""
+    from shadow_tpu.core import configuration
+    from shadow_tpu.core.logger import SimLogger, set_logger
+    from shadow_tpu.core.options import Options
+    from shadow_tpu.parallel.procs import ProcsController
+
+    set_logger(SimLogger(level="warning"))
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = stop
+    ctrl = ProcsController(Options(scheduler_policy=policy, workers=0,
+                                   stop_time_sec=stop, processes=n_procs,
+                                   log_level="warning"), cfg)
+    t0 = time.perf_counter()
+    rc = ctrl.run()
+    wall = time.perf_counter() - t0
+    assert rc == 0
+    return {
+        "events": ctrl.events_executed,
+        "events_per_sec": round(ctrl.events_executed / wall),
+        "sim_sec_per_wall_sec": round(stop / wall, 4),
+        "wall_sec": round(wall, 2),
+        "processes": n_procs,
+    }
+
+
 def bench_full_sims() -> dict:
     from shadow_tpu.tools import workloads
 
@@ -232,6 +260,10 @@ def bench_full_sims() -> dict:
                                    stream_spec="512:51200")
     out["tor200_serial"] = _run_sim(xml200, "global", 0, TOR200_STOPTIME)
     out["tor200_tpu"] = _run_sim(xml200, "tpu", 0, TOR200_STOPTIME)
+    ncores = multiprocessing.cpu_count()
+    if ncores > 1:
+        out["tor200_procs"] = _run_procs(xml200, min(ncores, 8),
+                                         TOR200_STOPTIME)
 
     # star100: BASELINE config #2 (100-host bulk transfer, single-AS star)
     xml_star = workloads.star_bulk(100, stoptime=30,
@@ -239,7 +271,6 @@ def bench_full_sims() -> dict:
     out["star100_serial"] = _run_sim(xml_star, "global", 0, 30)
 
     # tor10k: workload #4 on the reference's Internet GraphML
-    ncores = multiprocessing.cpu_count()
     topo_path = "/root/reference/resource/topology.graphml.xml.xz"
     if os.path.exists(topo_path):
         xml10k = workloads.tor_network(10000, stoptime=TOR10K_STOPTIME,
@@ -248,10 +279,18 @@ def bench_full_sims() -> dict:
             _run_sim(xml10k, "steal", ncores, TOR10K_STOPTIME),
             workers=ncores)
         out["tor10k_tpu"] = _run_sim(xml10k, "tpu", 0, TOR10K_STOPTIME)
+        if ncores > 1:
+            out["tor10k_procs_all_cores"] = _run_procs(
+                xml10k, ncores, TOR10K_STOPTIME)
         steal_rate = out["tor10k_steal_all_cores"]["sim_sec_per_wall_sec"]
         tpu_rate = out["tor10k_tpu"]["sim_sec_per_wall_sec"]
         out["tor10k_tpu_vs_own_steal"] = round(tpu_rate / steal_rate, 3) \
             if steal_rate else None
+        procs_rate = out.get("tor10k_procs_all_cores",
+                             {}).get("sim_sec_per_wall_sec")
+        if procs_rate and steal_rate:
+            out["tor10k_procs_vs_own_steal"] = round(procs_rate / steal_rate,
+                                                     3)
     else:
         out["tor10k"] = "skipped: reference topology not present"
     return out

@@ -27,6 +27,7 @@ cheaply, in three pieces:
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 import pickle
 from typing import Dict, Optional
@@ -68,36 +69,69 @@ def _host_state(host) -> Dict:
     }
 
 
+def assemble_state(sim_time_ns: int, rounds: int, host_states: Dict,
+                   pending_events) -> Dict:
+    """Build the canonical digestible state dict.  Single construction point
+    so a sharded run (parallel/procs.py) that gathers ``_host_state`` maps
+    from its shard engines produces byte-identical pickles — and therefore
+    identical digests — to a single-process run."""
+    return {
+        "sim_time_ns": sim_time_ns,
+        "rounds": rounds,
+        "hosts": {hid: host_states[hid] for hid in sorted(host_states)},
+        "pending_events": pending_events,
+    }
+
+
 def collect_state(engine) -> Dict:
     """The digestible snapshot of everything the simulation has computed."""
-    return {
-        "sim_time_ns": engine.scheduler.window_start,
-        "rounds": engine.rounds_executed,
-        "hosts": {hid: _host_state(h) for hid, h in sorted(engine.hosts.items())},
-        "pending_events": engine.scheduler.policy.pending_count()
+    return assemble_state(
+        engine.scheduler.window_start,
+        engine.rounds_executed,
+        {hid: _host_state(h) for hid, h in engine.hosts.items()
+         if engine.owns_host(h)},
+        engine.scheduler.policy.pending_count()
         if hasattr(engine.scheduler.policy, "pending_count") else None,
-    }
+    )
+
+
+def digest_of_state(state: Dict) -> str:
+    """Digest over a canonical JSON rendering, NOT the pickle bytes: pickle
+    memoizes repeated objects by identity, so two structurally equal states
+    can pickle differently depending on which strings happen to be shared
+    in-process (a sharded run's states cross a pipe and lose sharing).
+    JSON with sorted keys is identity-blind; tuples/lists and int/str dict
+    keys normalize uniformly.  No ``default=`` fallback on purpose: a
+    non-canonical value (set, object) in a future state field would hash by
+    repr — i.e. by address/hash order — and silently reintroduce the
+    problem, so it raises instead."""
+    blob = json.dumps(state, sort_keys=True,
+                      separators=(",", ":")).encode()
+    return hashlib.sha256(blob).hexdigest()
 
 
 def state_digest(engine) -> str:
     """Deterministic hex digest of the current simulation state."""
-    blob = pickle.dumps(collect_state(engine), protocol=4)
-    return hashlib.sha256(blob).hexdigest()
+    return digest_of_state(collect_state(engine))
+
+
+def save_state(state: Dict, path: str, options_info: Dict) -> str:
+    """Stamp ``state`` with its digest + run options and pickle it to disk
+    (shared by the engine-side writer and the procs parent)."""
+    state["digest"] = digest_of_state(state)
+    state["options"] = options_info
+    with open(path, "wb") as f:
+        pickle.dump(state, f, protocol=4)
+    return state["digest"]
 
 
 def save_snapshot(engine, path: str) -> str:
-    state = collect_state(engine)
-    state["digest"] = hashlib.sha256(
-        pickle.dumps(state, protocol=4)).hexdigest()
-    state["options"] = {
+    return save_state(collect_state(engine), path, {
         "seed": engine.options.seed,
         "scheduler_policy": engine.options.scheduler_policy,
         "workers": engine.options.workers,
         "stop_time_sec": engine.options.stop_time_sec,
-    }
-    with open(path, "wb") as f:
-        pickle.dump(state, f, protocol=4)
-    return state["digest"]
+    })
 
 
 def load_snapshot(path: str) -> Dict:
@@ -108,9 +142,7 @@ def load_snapshot(path: str) -> Dict:
 def resume_digest(snapshot: Dict, engine) -> bool:
     """True iff a replayed engine has reached exactly the snapshot's state
     (call after running the same config+seed to snapshot['sim_time_ns'])."""
-    current = collect_state(engine)
-    blob = pickle.dumps(current, protocol=4)
-    return hashlib.sha256(blob).hexdigest() == snapshot["digest"]
+    return digest_of_state(collect_state(engine)) == snapshot["digest"]
 
 
 class CheckpointWriter:

@@ -127,5 +127,9 @@ class Controller:
 
 
 def run_simulation(options: Options, config: Configuration) -> int:
-    """One-call entry used by the CLI and tests."""
+    """One-call entry used by the CLI and tests.  ``--processes N`` (N >= 2)
+    routes to the sharded multi-process coordinator."""
+    if getattr(options, "processes", 0) >= 2:
+        from ..parallel.procs import run_sharded
+        return run_sharded(options, config)
     return Controller(options, config).run()

@@ -67,6 +67,14 @@ class Engine:
         self.scheduler = Scheduler(self, options.scheduler_policy,
                                    options.workers, derive(self.root_key, "sched"))
         self._drop_key = derive(self.root_key, "packet_drop")
+        # Process-parallel sharding (parallel/procs.py): this engine OWNS
+        # hosts with (id-1) % shard_count == shard_id and executes only their
+        # events; packets bound for other shards are appended to per-shard
+        # outboxes drained at the round barrier.  shard_count == 1 (the
+        # default) means everything below is inert.
+        self.shard_id = int(getattr(options, "shard_id", 0) or 0)
+        self.shard_count = max(1, int(getattr(options, "shard_count", 1) or 1))
+        self.shard_outboxes: List[list] = [[] for _ in range(self.shard_count)]
         self._global_seq = 0
         self._running = True
         self._host_id_counter = 0
@@ -90,6 +98,10 @@ class Engine:
     def add_host(self, host, requested_ip: Optional[int] = None) -> None:
         """Register + set up a host (slave_addNewVirtualHost :296)."""
         addr = self.dns.register(host.id, host.name, requested_ip)
+        if not self.owns_host(host):
+            # replica on another shard's engine: opening its pcap file here
+            # would truncate the owner's capture (N processes, same path)
+            host.params.log_pcap = False
         host.setup(self, addr)
         vidx = self.topology.attach_host(
             addr.ip, ip_hint=host.params.ip_hint, city_hint=host.params.city_hint,
@@ -115,7 +127,8 @@ class Engine:
         self.hosts_by_ip[addr.ip] = host
         self.hosts_by_name[host.name] = host
         self.scheduler.add_host(host)
-        self.counters.count_new("host")
+        if self.owns_host(host):
+            self.counters.count_new("host")
 
     def next_host_id(self) -> int:
         self._host_id_counter += 1
@@ -123,6 +136,21 @@ class Engine:
 
     def host_by_ip(self, ip: int):
         return self.hosts_by_ip.get(ip)
+
+    def shard_of(self, host) -> int:
+        """The single definition of the host partition (round-robin by id);
+        owns_host and every outbox index derive from it."""
+        return (host.id - 1) % self.shard_count
+
+    def owns_host(self, host) -> bool:
+        """True iff this engine executes ``host``'s events (every host in a
+        single-process run; the shard's partition under --processes N)."""
+        return self.shard_count == 1 or self.shard_of(host) == self.shard_id
+
+    def drain_outboxes(self) -> List[list]:
+        out = self.shard_outboxes
+        self.shard_outboxes = [[] for _ in range(self.shard_count)]
+        return out
 
     def host_by_name(self, name: str):
         return self.hosts_by_name.get(name)
@@ -169,6 +197,11 @@ class Engine:
         try:
             for hid in sorted(self.hosts):
                 host = self.hosts[hid]
+                if not self.owns_host(host):
+                    # replica of a host another shard executes: it exists so
+                    # DNS/topology/addressing resolve identically, but it
+                    # boots (and runs) only on its owner
+                    continue
                 boot_worker.set_active_host(host)
                 host.boot()
                 for proc in host.processes:

@@ -23,6 +23,14 @@ TCP_CC_KINDS = ("reno", "aimd", "cubic")
 class Options:
     # Core (reference options.c flags)
     workers: int = 0                     # --workers (0 = serial, nWorkers=0 mode)
+    processes: int = 0                   # --processes: shard the simulation
+                                         # across N OS processes with a
+                                         # conservative round barrier
+                                         # (parallel/procs.py) — real
+                                         # multicore scaling where the GIL
+                                         # caps the threaded policies
+    shard_id: int = 0                    # internal: this engine's shard
+    shard_count: int = 1                 # internal: total shard engines
     scheduler_policy: str = "steal"      # --scheduler-policy (default steal, options.c:199)
     seed: int = 1                        # --seed
     runahead_ms: int = 0                 # --runahead (0 = derive from topology; floor 10ms)
@@ -78,6 +86,9 @@ def build_parser() -> argparse.ArgumentParser:
                     "(capabilities of Shadow 1.14.0, re-architected for JAX/XLA).")
     p.add_argument("config_path", nargs="?", help="simulation config (.xml, .yaml, .json)")
     p.add_argument("--workers", type=int, default=0)
+    p.add_argument("--processes", type=int, default=0,
+                   help="shard hosts across N OS processes exchanging "
+                        "packets at round barriers (0 = single process)")
     p.add_argument("--scheduler-policy", choices=SCHEDULER_POLICIES, default="steal",
                    dest="scheduler_policy")
     p.add_argument("--seed", type=int, default=1)

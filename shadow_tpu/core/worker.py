@@ -118,9 +118,26 @@ class Worker:
                 return
         latency = topo.latency_ns_ip(src_ip, dst_ip)
         packet.add_status("INET_SENT")
-        dst_host = self.engine.host_by_ip(dst_ip)
+        engine = self.engine
+        dst_host = engine.host_by_ip(dst_ip)
         if dst_host is None:
             packet.add_status("INET_DROPPED")
+            return
+        if not engine.owns_host(dst_host):
+            # --processes shard boundary: claim the source-host sequence id
+            # exactly where the local path would (inside schedule_task), then
+            # ship the finished hop to the owner shard; it pushes the
+            # delivery event with the identical (time, dst, src, seq) tuple.
+            t = self.now + latency
+            if t >= engine.end_time:
+                return
+            src_host = self.active_host
+            if src_host is None:
+                raise RuntimeError("cross-shard send without an active host")
+            seq = src_host.next_event_sequence()
+            self.counters.count_new("event")
+            engine.shard_outboxes[engine.shard_of(dst_host)].append(
+                (t, dst_host.id, src_host.id, seq, packet.to_wire()))
             return
         task = Task(_deliver_packet_task, dst_host, packet, name="deliver_packet")
         self.schedule_task(task, latency, dst_host=dst_host)

@@ -1,0 +1,349 @@
+"""Process-parallel scale-out: shard engines + a conservative round barrier.
+
+``--processes N`` partitions the hosts round-robin across N OS processes.
+Each child builds the COMPLETE simulation skeleton (hosts, DNS, topology —
+so addressing, bandwidth resolution, and RNG derivations are bitwise
+identical to a single-process run) but boots and executes events only for
+its owned partition.  The only cross-host coupling in the whole simulator is
+the packet hop (core/worker.py ``send_packet``), so the shard boundary is a
+packet boundary: hops whose destination lives on another shard are finished
+locally (reliability draw + latency lookup — both keyed by packet uid /
+topology, identical everywhere) and shipped to the owner at the round
+barrier, which pushes the delivery event with the identical
+(time, dst, src, seq) order tuple.
+
+Why this is exact, not approximate: every scheduler policy already clamps
+cross-host deliveries to the current window end (core/scheduler.py ``push``),
+and the window size never exceeds the minimum topology latency — so no
+packet sent during round R can be delivered inside round R.  Exchanging
+packets at the barrier therefore reproduces the serial event timeline
+bit-for-bit; the parity tests assert equal state digests against a
+single-process run.
+
+This is the analog of the reference's master/slave split taken across
+process boundaries (the reference kept all workers in one process and
+scaled with pthreads, core/scheduler.c:266-333; a C simulator can — for
+CPython the GIL makes threads useless for compute, so real multicore
+scaling needs processes).  The round protocol is the classic conservative
+PDES exchange (null-message-free, barrier-synchronized), the same shape an
+MPI/NCCL allreduce-per-round backend would have on a multi-host deployment:
+``out``-boxes are the all-to-all, the min-next-time gather is the allreduce.
+
+Per round, parent <-> children exchange:
+
+    parent -> all : ("run", window_start, window_end)
+    child  -> par : ("out", [outbox per shard])      after draining the round
+    parent -> all : ("in", inbox)                     routed all-to-all
+    child  -> par : ("min", next_event_time, pending) after ingesting inbox
+
+plus ("collect" -> "hosts") for assembled checkpoints and
+("stop" -> "final") at the end.  Checkpoints taken by the parent merge the
+shards' per-host states through the same ``assemble_state`` the serial
+writer uses, so snapshot digests are comparable across process counts.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import time as _walltime
+from typing import Dict, List, Optional
+
+from ..core import stime
+from ..core.logger import SimLogger, get_logger, set_logger
+
+
+# ---------------------------------------------------------------------------
+# child (shard) side
+# ---------------------------------------------------------------------------
+
+def _shard_main(conn, options, config) -> None:
+    """Entry point of one shard process (spawned; top-level for pickling)."""
+    try:
+        set_logger(SimLogger(level=options.log_level))
+        _shard_body(conn, options, config)
+    except BaseException as e:  # noqa: BLE001 - surfaced to the parent
+        import traceback
+        try:
+            conn.send(("error", f"{e!r}\n{traceback.format_exc()}"))
+        except Exception:
+            pass
+        raise
+
+
+def _shard_body(conn, options, config) -> None:
+    from ..core.checkpoint import _host_state
+    from ..core.controller import Controller
+    from ..core.event import Event
+    from ..core.task import Task
+    from ..core.worker import Worker, set_current_worker, \
+        _deliver_packet_task
+    from ..routing.packet import Packet
+
+    ctrl = Controller(options, config)
+    ctrl.setup()
+    engine = ctrl.engine
+    log = get_logger()
+
+    engine.sim_start_wall = _walltime.monotonic()
+    engine.schedule_boot()
+    worker = Worker(0, engine)
+    set_current_worker(worker)
+
+    import gc
+    gc_was_enabled = gc.isenabled()
+    if gc_was_enabled:
+        gc.collect()
+        gc.freeze()
+        gc.disable()
+
+    hosts_by_id = engine.hosts
+    scheduler = engine.scheduler
+    try:
+        conn.send(("ready", engine.lookahead_ns, engine.end_time,
+                   len(engine.hosts)))
+        conn.send(("min", scheduler.next_event_time(),
+                   scheduler.policy.pending_count()))
+        while True:
+            msg = conn.recv()
+            kind = msg[0]
+            if kind == "stop":
+                break
+            if kind == "collect":
+                conn.send(("hosts", {hid: _host_state(h)
+                                     for hid, h in hosts_by_id.items()
+                                     if engine.owns_host(h)}))
+                continue
+            ws, we = msg[1], msg[2]
+            scheduler.window_start = ws
+            scheduler.window_end = we
+            worker.round_end = we
+            worker.run_round()
+            engine._flush_round()
+            conn.send(("out", engine.drain_outboxes()))
+            inbox = conn.recv()[1]
+            for t, dst_id, src_id, seq, wire in inbox:
+                dst_host = hosts_by_id[dst_id]
+                src_host = hosts_by_id[src_id]
+                pkt = Packet.from_wire(wire)
+                ev = Event(Task(_deliver_packet_task, dst_host, pkt,
+                                name="deliver_packet"),
+                           t, dst_host, src_host, seq)
+                # the push clamp (still at this round's window end) matches
+                # what the serial run applied when the hop was scheduled
+                scheduler.push(ev, worker)
+            engine.rounds_executed += 1
+            engine._heartbeat()
+            log.flush()
+            conn.send(("min", scheduler.next_event_time(),
+                       scheduler.policy.pending_count()))
+    finally:
+        if gc_was_enabled:
+            gc.enable()
+            gc.unfreeze()
+            gc.collect()
+        set_current_worker(None)
+
+    events = worker.counters._free.get("event", 0)
+    worker.finish()
+    host_states = {hid: _host_state(h) for hid, h in hosts_by_id.items()
+                   if engine.owns_host(h)}
+    for host in engine.hosts.values():
+        for iface in set(host.interfaces.values()):
+            if iface.pcap is not None:
+                iface.pcap.close()
+        if engine.owns_host(host):
+            engine.counters.count_free("host")
+    log.flush()
+    conn.send(("final", {
+        "events": events,
+        "rounds": engine.rounds_executed,
+        "plugin_errors": engine.plugin_errors,
+        "pending": scheduler.policy.pending_count(),
+        "host_states": host_states,
+        "counters_new": dict(engine.counters._new),
+        "counters_free": dict(engine.counters._free),
+        "wall": _walltime.monotonic() - engine.sim_start_wall,
+    }))
+
+
+# ---------------------------------------------------------------------------
+# parent (coordinator) side
+# ---------------------------------------------------------------------------
+
+class ProcsController:
+    """Coordinator for ``--processes N``: spawns the shard engines, drives
+    the window/exchange protocol, assembles checkpoints and the final state
+    digest.  Mirrors the reference Master's role (core/master.c) across
+    process boundaries."""
+
+    def __init__(self, options, config):
+        if options.processes < 2:
+            raise ValueError("--processes needs N >= 2 (use the regular "
+                             "engine for a single process)")
+        self.options = options
+        self.config = config
+        self.n_shards = int(options.processes)
+        self.rounds_executed = 0
+        self.events_executed = 0
+        self.final_state: Optional[Dict] = None
+        self.digest: Optional[str] = None
+        self.checkpoints: List[str] = []
+
+    def _child_options(self, shard_id: int):
+        import dataclasses
+        opt = dataclasses.replace(self.options)
+        opt.processes = 0
+        opt.shard_id = shard_id
+        opt.shard_count = self.n_shards
+        # each shard drains its partition with the single serial worker; a
+        # threaded scheduler inside a shard would strand events on worker>0
+        # heaps that _shard_body's lone Worker(0) never pops
+        opt.workers = 0
+        # checkpoints are assembled by the parent from shard host-states;
+        # per-shard snapshot files would be partial and misleading
+        opt.checkpoint_interval_sec = 0
+        # the parent seeds the data directory from the template ONCE before
+        # spawning (N children racing shutil.copytree would collide)
+        opt.data_template = None
+        return opt
+
+    def run(self) -> int:
+        from ..core.checkpoint import assemble_state, digest_of_state
+
+        log = get_logger()
+        n = self.n_shards
+        template = getattr(self.options, "data_template", None)
+        if template and not os.path.exists(self.options.data_directory):
+            import shutil
+            shutil.copytree(template, self.options.data_directory)
+        ctx = mp.get_context("spawn")
+        conns = []
+        procs = []
+        t_start = _walltime.monotonic()
+        for sid in range(n):
+            parent_conn, child_conn = ctx.Pipe()
+            p = ctx.Process(target=_shard_main,
+                            args=(child_conn, self._child_options(sid),
+                                  self.config),
+                            daemon=True, name=f"shard-{sid}")
+            p.start()
+            child_conn.close()
+            conns.append(parent_conn)
+            procs.append(p)
+
+        def recv(c):
+            msg = c.recv()
+            if msg[0] == "error":
+                raise RuntimeError(f"shard failed:\n{msg[1]}")
+            return msg
+
+        try:
+            readies = [recv(c) for c in conns]
+            lookahead = readies[0][1]
+            end_time = readies[0][2]
+            assert all(r[1] == lookahead and r[2] == end_time
+                       for r in readies), "shards disagree on lookahead/end"
+            mins = [recv(c) for c in conns]
+            log.message(
+                "procs",
+                f"starting sharded simulation: {readies[0][3]} hosts over "
+                f"{n} processes, lookahead={lookahead / 1e6:.3f} ms, "
+                f"end={end_time / 1e9:.1f} s")
+
+            ckpt_interval = self.options.checkpoint_interval_sec \
+                * stime.SIM_TIME_SEC
+            ckpt_next = ckpt_interval if ckpt_interval > 0 else None
+            last_ws = 0
+            while True:
+                nxt = min(m[1] for m in mins)
+                if nxt >= end_time or nxt >= stime.SIM_TIME_MAX:
+                    break
+                ws, we = nxt, min(nxt + lookahead, end_time)
+                for c in conns:
+                    c.send(("run", ws, we))
+                outs = [recv(c)[1] for c in conns]
+                for sid, c in enumerate(conns):
+                    inbox = []
+                    for o in outs:
+                        inbox.extend(o[sid])
+                    c.send(("in", inbox))
+                mins = [recv(c) for c in conns]
+                last_ws = ws
+                # parent-assembled checkpoint at the same boundaries the
+                # serial CheckpointWriter uses (window_start >= next_at,
+                # BEFORE the round counter increments)
+                if ckpt_next is not None and ws >= ckpt_next:
+                    self._write_checkpoint(conns, recv, ws,
+                                           sum(m[2] for m in mins))
+                    while ckpt_next <= ws:
+                        ckpt_next += ckpt_interval
+                self.rounds_executed += 1
+
+            for c in conns:
+                c.send(("stop",))
+            finals = [recv(c)[1] for c in conns]
+        finally:
+            # closing the pipes first unblocks any shard still parked in
+            # conn.recv() (EOFError -> exit), so a mid-run failure tears
+            # down immediately instead of waiting out join timeouts
+            for c in conns:
+                c.close()
+            for p in procs:
+                p.join(timeout=60)
+                if p.is_alive():
+                    p.terminate()
+
+        host_states: Dict = {}
+        for f in finals:
+            host_states.update(f["host_states"])
+        self.events_executed = sum(f["events"] for f in finals)
+        assert all(f["rounds"] == self.rounds_executed for f in finals)
+        state = assemble_state(last_ws, self.rounds_executed, host_states,
+                               sum(f["pending"] for f in finals))
+        self.final_state = state
+        self.digest = digest_of_state(state)
+        plugin_errors = sum(f["plugin_errors"] for f in finals)
+
+        from ..core.counters import ObjectCounter
+        totals = ObjectCounter()
+        for f in finals:
+            for k, v in f["counters_new"].items():
+                totals.count_new(k, v)
+            for k, v in f["counters_free"].items():
+                totals.count_free(k, v)
+        log.message(
+            "procs",
+            f"sharded simulation finished: {self.rounds_executed} rounds, "
+            f"{self.events_executed} events, {n} processes, "
+            f"{_walltime.monotonic() - t_start:.3f}s wall")
+        if totals.leaks():
+            log.message("procs", totals.report())
+        log.flush()
+        return 1 if plugin_errors else 0
+
+    def _write_checkpoint(self, conns, recv, ws: int, pending: int) -> None:
+        from ..core.checkpoint import assemble_state, save_state
+        for c in conns:
+            c.send(("collect",))
+        host_states: Dict = {}
+        for c in conns:
+            host_states.update(recv(c)[1])
+        state = assemble_state(ws, self.rounds_executed, host_states, pending)
+        os.makedirs(self.options.checkpoint_dir, exist_ok=True)
+        sim_sec = ws // stime.SIM_TIME_SEC
+        path = os.path.join(self.options.checkpoint_dir,
+                            f"checkpoint_{sim_sec:08d}.ckpt")
+        save_state(state, path, {
+            "seed": self.options.seed,
+            "scheduler_policy": self.options.scheduler_policy,
+            "workers": self.options.workers,
+            "stop_time_sec": self.options.stop_time_sec,
+            "processes": self.n_shards,
+        })
+        self.checkpoints.append(path)
+        get_logger().message("procs", f"checkpoint written: {path}")
+
+
+def run_sharded(options, config) -> int:
+    return ProcsController(options, config).run()

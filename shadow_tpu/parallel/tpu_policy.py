@@ -169,6 +169,10 @@ class TPUPolicy(HostQueuesPolicy):
         count_drop = engine.count_packet_drop
         push = super().push
         counters = engine.counters
+        sharded = engine.shard_count > 1
+        owns = engine.owns_host
+        outboxes = engine.shard_outboxes
+        shard_of = engine.shard_of
         for i in range(n):
             pkt = pkts[i]
             if not keep_list[i]:
@@ -180,9 +184,17 @@ class TPUPolicy(HostQueuesPolicy):
             if t >= end_time:
                 continue
             pkt.add_status("INET_SENT")
-            task = Task(_deliver_packet_task, dst_hosts[i], pkt,
+            dst = dst_hosts[i]
+            if sharded and not owns(dst):
+                # --processes: hand the finished hop to the owner shard (the
+                # seq was claimed at offer time, so the event tuple matches)
+                outboxes[shard_of(dst)].append(
+                    (t, dst.id, src_hosts[i].id, seqs[i], pkt.to_wire()))
+                delivered += 1
+                continue
+            task = Task(_deliver_packet_task, dst, pkt,
                         name="deliver_packet")
-            ev = Event(task, t, dst_hosts[i], src_hosts[i], seqs[i])
+            ev = Event(task, t, dst, src_hosts[i], seqs[i])
             push(ev, 0, barrier)
             delivered += 1
         counters.count_new("event", delivered)

@@ -132,6 +132,39 @@ class Packet:
         p.retransmit = self.retransmit
         return p
 
+    # -- cross-process wire format (parallel/procs.py) ---------------------
+    def to_wire(self) -> tuple:
+        """Flatten to plain ints/bytes for shipping to another shard engine
+        (the procs scale-out exchanges packets at round barriers the way the
+        reference's master/slave split would over MPI).  Exact round-trip:
+        ``from_wire(p.to_wire())`` reconstructs every field the receiving
+        host's protocol stack and the state digest can observe."""
+        h = self.header
+        if isinstance(h, TCPHeader):
+            hdr = ("t", h.src_ip, h.src_port, h.dst_ip, h.dst_port, h.flags,
+                   h.sequence, h.acknowledgment, h.window,
+                   tuple(h.sel_acks), h.timestamp, h.timestamp_echo)
+        else:
+            hdr = ("u", h.src_ip, h.src_port, h.dst_ip, h.dst_port)
+        return (self.uid, self.priority, hdr, self.payload, self.retransmit,
+                tuple(self.statuses))
+
+    @classmethod
+    def from_wire(cls, wire: tuple) -> "Packet":
+        uid, priority, hdr, payload, retransmit, statuses = wire
+        if hdr[0] == "t":
+            header = TCPHeader(hdr[1], hdr[2], hdr[3], hdr[4], hdr[5], hdr[6],
+                               hdr[7], hdr[8], [tuple(b) for b in hdr[9]],
+                               hdr[10], hdr[11])
+            hsize = defs.CONFIG_HEADER_SIZE_TCPIPETH
+        else:
+            header = UDPHeader(hdr[1], hdr[2], hdr[3], hdr[4])
+            hsize = defs.CONFIG_HEADER_SIZE_UDPIPETH
+        p = cls(uid, header, payload, priority, hsize)
+        p.retransmit = retransmit
+        p.statuses = list(statuses)
+        return p
+
     # -- accessors ---------------------------------------------------------
     def is_tcp(self) -> bool:
         return isinstance(self.header, TCPHeader)
