@@ -109,7 +109,8 @@ static void instance_tramp(unsigned int hi, unsigned int lo) {
 }
 
 static int start_instance(long vpid, int proto_fd, char *argv_buf,
-                          size_t buf_len) {
+                          size_t buf_len, size_t argv_off,
+                          const char *data_dir) {
   if (g_ninst >= MAX_INSTANCES) {
     fprintf(stderr, "shadow_pool: namespace capacity exceeded\n");
     return -1;
@@ -120,7 +121,7 @@ static int start_instance(long vpid, int proto_fd, char *argv_buf,
   in->vpid = vpid;
   in->argv_buf = argv_buf;
   /* split NUL-separated argv */
-  size_t off = 0;
+  size_t off = argv_off;
   while (off < buf_len && in->argc < 63) {
     in->argv[in->argc++] = argv_buf + off;
     off += strlen(argv_buf + off) + 1;
@@ -136,6 +137,11 @@ static int start_instance(long vpid, int proto_fd, char *argv_buf,
   snprintf(pidbuf, sizeof pidbuf, "%ld", vpid);
   setenv("SHADOW_TPU_FD", fdbuf, 1);
   setenv("SHADOW_TPU_PID", pidbuf, 1);
+  /* per-instance host data dir for shim_files.cc path virtualization */
+  if (data_dir && data_dir[0])
+    setenv("SHADOW_TPU_DATA_DIR", data_dir, 1);
+  else
+    unsetenv("SHADOW_TPU_DATA_DIR");
 
   in->handle = dlmopen(LM_ID_NEWLM, in->argv[0], RTLD_NOW | RTLD_LOCAL);
   if (!in->handle) {
@@ -227,8 +233,16 @@ static void handle_control(void) {
     return;
   }
   payload[plen] = '\0';
-  if (op == 1 && proto_fd >= 0) {
-    if (start_instance(vpid, proto_fd, payload, plen) != 0) {
+  if ((op == 1 || op == 2) && proto_fd >= 0) {
+    /* op 2: payload leads with the instance's host data dir, then argv */
+    size_t argv_off = 0;
+    const char *data_dir = NULL;
+    if (op == 2) {
+      data_dir = payload;
+      argv_off = strlen(payload) + 1;
+    }
+    if (start_instance(vpid, proto_fd, payload, plen, argv_off,
+                       data_dir) != 0) {
       close(proto_fd);   /* sim sees EOF = instance failed to start */
       free(payload);
     }

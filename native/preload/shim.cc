@@ -341,6 +341,9 @@ extern "C" int64_t shd_vtime_ns(void) { return g_vtime_ns; }
 extern "C" int64_t shd_epoch_ns(void) { return g_epoch_ns; }
 extern "C" int shd_active(void) { return g_active; }
 extern "C" long shd_virtual_pid(void) { return g_virtual_pid; }
+/* pooled instances share one process cwd, so shim_files.cc must rewrite
+ * even relative paths for them */
+extern "C" int shd_pooled(void) { return g_pool_exit != NULL; }
 
 /* --------------------------------------------------------------- helpers -- */
 
@@ -1315,6 +1318,10 @@ extern "C" int shd_open_random_fd(void) {
   return fd;
 }
 
+/* per-host absolute-path virtualization (shim_files.cc) */
+extern "C" const char *shd_resolve_path(const char *path, char *buf,
+                                        size_t cap, int creating);
+
 extern "C" int open(const char *path, int flags, ...) {
   va_list ap;
   va_start(ap, flags);
@@ -1322,7 +1329,10 @@ extern "C" int open(const char *path, int flags, ...) {
   va_end(ap);
   resolve_reals();
   if (g_active && is_random_path(path)) return shd_open_random_fd();
-  return REAL(open)(path, flags, mode);
+  char rbuf[4096];
+  return REAL(open)(shd_resolve_path(path, rbuf, sizeof rbuf,
+                                     flags & O_CREAT),
+                    flags, mode);
 }
 
 extern "C" int open64(const char *path, int flags, ...) {
@@ -1332,7 +1342,10 @@ extern "C" int open64(const char *path, int flags, ...) {
   va_end(ap);
   resolve_reals();
   if (g_active && is_random_path(path)) return open(path, flags);
-  return REAL(open64)(path, flags, mode);
+  char rbuf[4096];
+  return REAL(open64)(shd_resolve_path(path, rbuf, sizeof rbuf,
+                                       flags & O_CREAT),
+                      flags, mode);
 }
 
 extern "C" int openat(int dirfd, const char *path, int flags, ...) {
@@ -1342,6 +1355,15 @@ extern "C" int openat(int dirfd, const char *path, int flags, ...) {
   va_end(ap);
   resolve_reals();
   if (g_active && is_random_path(path)) return open(path, flags);
+  if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
+    /* AT_FDCWD-or-absolute resolves against the namespace; paths relative
+     * to an already-open dirfd are inside it by construction */
+    char rbuf[4096];
+    return REAL(openat)(dirfd,
+                        shd_resolve_path(path, rbuf, sizeof rbuf,
+                                         flags & O_CREAT),
+                        flags, mode);
+  }
   return REAL(openat)(dirfd, path, flags, mode);
 }
 

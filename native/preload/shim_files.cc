@@ -1,0 +1,398 @@
+/* Per-host file namespaces for absolute paths.
+ *
+ * The cwd model (process/native.py: each plugin runs with cwd = its host's
+ * data dir) already isolates relative paths per host.  This unit extends
+ * the namespace to ABSOLUTE paths, the remaining piece of the reference's
+ * per-host file story (process.c's fopen/open/unlink/... emulations keep
+ * each virtual process inside its host data layout, SURVEY.md §2.7): an
+ * app writing /var/lib/app/state lands in
+ * <host-data-dir>/vfs/var/lib/app/state, so two hosts running the same
+ * binary never share or clobber state, and a run's file effects live
+ * entirely under the simulation's data directory.
+ *
+ * Rules (shd_resolve_path):
+ *   - inactive shim, no data dir, or relative path outside pool mode:
+ *     passthrough (cwd already isolates; natively-run binaries see the
+ *     real fs — the dual-execution property);
+ *   - pooled instances share one cwd, so THEIR relative paths rewrite to
+ *     the instance's data dir;
+ *   - absolute paths under system prefixes (/proc /sys /dev /etc /usr
+ *     /lib* /bin /sbin /opt /run) pass through — read-only program inputs
+ *     (ld.so, locales, python stdlib) are not host state;
+ *   - anything else absolute (including /tmp, /var, /home) maps to
+ *     <data-dir>/vfs<path>; parent directories are created on demand for
+ *     creating opens, so apps that assume /var/x exists just work.
+ *
+ * This is a namespace, not a sandbox: ".." traversal is not policed (the
+ * reference's interposer never policed paths either — determinism, not
+ * security, is the goal).
+ *
+ * File CONTENT operations stay real libc against the resolved path: the
+ * per-host layout plus the virtual clock (time interposition) keeps them
+ * deterministic, exactly like the existing cwd-relative model.
+ */
+
+#define _GNU_SOURCE 1
+#include <dirent.h>
+#include <dlfcn.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <stdarg.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+extern "C" int shd_active(void);
+extern "C" int shd_pooled(void);
+
+static char g_vroot[3072];
+static size_t g_vroot_len = 0;
+/* pooled instances share the pool process's real cwd, so each namespace's
+ * shim copy tracks its own virtual cwd (a REAL path under the vroot);
+ * empty = the host data dir itself */
+static char g_vcwd[4096];
+
+static const char *pooled_cwd(void) {
+  return g_vcwd[0] ? g_vcwd : g_vroot;
+}
+
+__attribute__((constructor)) static void shd_files_init(void) {
+  /* cached at namespace-init time: pooled instances share the process
+   * environment, so a live getenv would read a sibling's value */
+  const char *d = getenv("SHADOW_TPU_DATA_DIR");
+  if (d && d[0] == '/' && strlen(d) < sizeof g_vroot - 8) {
+    strcpy(g_vroot, d);
+    g_vroot_len = strlen(d);
+  }
+}
+
+static const char *const k_passthrough[] = {
+    "/proc", "/sys", "/dev", "/etc", "/usr", "/lib", "/lib32", "/lib64",
+    "/libx32", "/bin", "/sbin", "/opt", "/run", NULL};
+
+static int prefix_match(const char *path, const char *prefix) {
+  size_t n = strlen(prefix);
+  return strncmp(path, prefix, n) == 0 &&
+         (path[n] == '/' || path[n] == '\0');
+}
+
+static int real_mkdir_(const char *p, mode_t m) {
+  static int (*real_mkdir)(const char *, mode_t);
+  if (!real_mkdir) *(void **)(&real_mkdir) = dlsym(RTLD_NEXT, "mkdir");
+  return real_mkdir(p, m);
+}
+
+/* create every parent directory of a resolved (in-vroot) path */
+static void ensure_parents(char *resolved) {
+  char *last = strrchr(resolved, '/');
+  if (!last || last == resolved) return;
+  for (char *p = resolved + g_vroot_len + 1; p <= last; p++) {
+    if (*p == '/') {
+      *p = '\0';
+      real_mkdir_(resolved, 0755);
+      *p = '/';
+    }
+  }
+}
+
+/* Resolve ``path`` into ``buf`` (cap >= 4096) when it must be virtualized;
+ * returns the pointer to use (``path`` itself when passing through).  When
+ * ``creating`` and the path was virtualized, parent dirs are made. */
+extern "C" const char *shd_resolve_path(const char *path, char *buf,
+                                        size_t cap, int creating) {
+  if (!path || !g_vroot_len || !shd_active()) return path;
+  int n;
+  if (path[0] != '/') {
+    if (!shd_pooled()) return path;   /* real cwd is inside the namespace */
+    n = snprintf(buf, cap, "%s/%s", pooled_cwd(), path);
+  } else {
+    if (strncmp(path, g_vroot, g_vroot_len) == 0 &&
+        (path[g_vroot_len] == '/' || path[g_vroot_len] == '\0'))
+      return path;                    /* already inside the namespace */
+    for (int i = 0; k_passthrough[i]; i++)
+      if (prefix_match(path, k_passthrough[i])) return path;
+    n = snprintf(buf, cap, "%s/vfs%s", g_vroot, path);
+  }
+  if (n <= 0 || (size_t)n >= cap) return path;  /* overlong: passthrough */
+  if (creating) ensure_parents(buf);
+  return buf;
+}
+
+#define RESOLVE(path, creating) \
+  char _rbuf[4096];             \
+  const char *rpath = shd_resolve_path((path), _rbuf, sizeof _rbuf, (creating))
+
+#define REALF(ret, name, ...)                             \
+  static ret (*real_##name)(__VA_ARGS__);                 \
+  if (!real_##name)                                       \
+    *(void **)(&real_##name) = dlsym(RTLD_NEXT, #name)
+
+/* open/open64/openat live in shim.cc (they also serve the /dev/*random
+ * family); they call shd_resolve_path for everything else. */
+
+extern "C" int creat(const char *path, mode_t mode) {
+  REALF(int, creat, const char *, mode_t);
+  RESOLVE(path, 1);
+  return real_creat(rpath, mode);
+}
+
+/* ------------------------------------------------------------ stat etc -- */
+
+extern "C" int stat(const char *path, struct stat *st) {
+  REALF(int, stat, const char *, struct stat *);
+  RESOLVE(path, 0);
+  return real_stat(rpath, st);
+}
+
+extern "C" int lstat(const char *path, struct stat *st) {
+  REALF(int, lstat, const char *, struct stat *);
+  RESOLVE(path, 0);
+  return real_lstat(rpath, st);
+}
+
+extern "C" int fstatat(int dirfd, const char *path, struct stat *st,
+                       int flags) {
+  REALF(int, fstatat, int, const char *, struct stat *, int);
+  if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
+    RESOLVE(path, 0);
+    return real_fstatat(dirfd, rpath, st, flags);
+  }
+  return real_fstatat(dirfd, path, st, flags);
+}
+
+extern "C" int access(const char *path, int mode) {
+  REALF(int, access, const char *, int);
+  RESOLVE(path, 0);
+  return real_access(rpath, mode);
+}
+
+extern "C" int faccessat(int dirfd, const char *path, int mode, int flags) {
+  REALF(int, faccessat, int, const char *, int, int);
+  if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
+    RESOLVE(path, 0);
+    return real_faccessat(dirfd, rpath, mode, flags);
+  }
+  return real_faccessat(dirfd, path, mode, flags);
+}
+
+extern "C" int truncate(const char *path, off_t len) {
+  REALF(int, truncate, const char *, off_t);
+  RESOLVE(path, 0);
+  return real_truncate(rpath, len);
+}
+
+extern "C" int chmod(const char *path, mode_t mode) {
+  REALF(int, chmod, const char *, mode_t);
+  RESOLVE(path, 0);
+  return real_chmod(rpath, mode);
+}
+
+/* -------------------------------------------------- namespace mutation -- */
+
+extern "C" int mkdir(const char *path, mode_t mode) {
+  REALF(int, mkdir, const char *, mode_t);
+  RESOLVE(path, 1);   /* parents created; mkdir itself makes the leaf */
+  return real_mkdir(rpath, mode);
+}
+
+extern "C" int mkdirat(int dirfd, const char *path, mode_t mode) {
+  REALF(int, mkdirat, int, const char *, mode_t);
+  if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
+    RESOLVE(path, 1);
+    return real_mkdirat(dirfd, rpath, mode);
+  }
+  return real_mkdirat(dirfd, path, mode);
+}
+
+extern "C" int rmdir(const char *path) {
+  REALF(int, rmdir, const char *);
+  RESOLVE(path, 0);
+  return real_rmdir(rpath);
+}
+
+extern "C" int unlink(const char *path) {
+  REALF(int, unlink, const char *);
+  RESOLVE(path, 0);
+  return real_unlink(rpath);
+}
+
+extern "C" int unlinkat(int dirfd, const char *path, int flags) {
+  REALF(int, unlinkat, int, const char *, int);
+  if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
+    RESOLVE(path, 0);
+    return real_unlinkat(dirfd, rpath, flags);
+  }
+  return real_unlinkat(dirfd, path, flags);
+}
+
+extern "C" int remove(const char *path) {
+  REALF(int, remove, const char *);
+  RESOLVE(path, 0);
+  return real_remove(rpath);
+}
+
+extern "C" int rename(const char *oldp, const char *newp) {
+  REALF(int, rename, const char *, const char *);
+  char ob[4096], nb[4096];
+  const char *ro = shd_resolve_path(oldp, ob, sizeof ob, 0);
+  const char *rn = shd_resolve_path(newp, nb, sizeof nb, 1);
+  return real_rename(ro, rn);
+}
+
+extern "C" int renameat(int ofd, const char *oldp, int nfd,
+                        const char *newp) {
+  REALF(int, renameat, int, const char *, int, const char *);
+  char ob[4096], nb[4096];
+  const char *ro = (ofd == AT_FDCWD || (oldp && oldp[0] == '/'))
+                       ? shd_resolve_path(oldp, ob, sizeof ob, 0) : oldp;
+  const char *rn = (nfd == AT_FDCWD || (newp && newp[0] == '/'))
+                       ? shd_resolve_path(newp, nb, sizeof nb, 1) : newp;
+  return real_renameat(ofd, ro, nfd, rn);
+}
+
+/* --------------------------------------------------------------- dirs -- */
+
+extern "C" DIR *opendir(const char *path) {
+  REALF(DIR *, opendir, const char *);
+  RESOLVE(path, 0);
+  return real_opendir(rpath);
+}
+
+extern "C" int chdir(const char *path) {
+  REALF(int, chdir, const char *);
+  /* Resolving chdir through the namespace keeps subsequent relative paths
+   * consistent: after chdir("/var/lib/app") the cwd is inside the vfs
+   * tree, so relative opens still land per-host.  Standard directories an
+   * app expects to exist (/tmp, /var/...) are created on demand — a fresh
+   * namespace is empty, the real OS guarantees them.  Pooled instances
+   * must NOT move the shared pool process's real cwd; they track a
+   * per-namespace virtual cwd instead (relative resolution + getcwd use
+   * it). */
+  char rbuf[4096];
+  const char *rpath = shd_resolve_path(path, rbuf, sizeof rbuf, 1);
+  if (rpath == rbuf) real_mkdir_(rbuf, 0755);  /* leaf too; EEXIST is fine */
+  if (g_vroot_len && shd_active() && shd_pooled()) {
+    struct stat st;
+    REALF(int, stat, const char *, struct stat *);
+    if (real_stat(rpath, &st) != 0) return -1;          /* sets errno */
+    if (!S_ISDIR(st.st_mode)) { errno = ENOTDIR; return -1; }
+    if (strlen(rpath) >= sizeof g_vcwd) { errno = ENAMETOOLONG; return -1; }
+    strcpy(g_vcwd, rpath);
+    return 0;
+  }
+  return real_chdir(rpath);
+}
+
+extern "C" char *getcwd(char *buf, size_t size) {
+  REALF(char *, getcwd, char *, size_t);
+  /* Pooled instances report their virtual cwd (a real path under the
+   * vroot), so getcwd()+"/x" and plain "x" resolve to the SAME file. */
+  if (!g_vroot_len || !shd_active() || !shd_pooled())
+    return real_getcwd(buf, size);
+  const char *cur = pooled_cwd();
+  size_t need = strlen(cur) + 1;
+  if (buf == NULL) {
+    if (size == 0) size = need;
+    if (size < need) { errno = ERANGE; return NULL; }
+    buf = (char *)malloc(size);
+    if (!buf) return NULL;
+  } else if (size < need) {
+    errno = ERANGE;
+    return NULL;
+  }
+  memcpy(buf, cur, need);
+  return buf;
+}
+
+/* ------------------------------------- LFS + pre-2.33 compat aliases ----
+ * glibc exports stat64/openat64/... as distinct symbols, and binaries
+ * built against glibc < 2.33 reach stat through __xstat/__lxstat/
+ * __fxstatat; all of them must virtualize identically or the namespace is
+ * half-applied (write through open64 lands in vfs, stat64 misses it). */
+
+extern "C" int stat64(const char *path, struct stat64 *st) {
+  REALF(int, stat64, const char *, struct stat64 *);
+  RESOLVE(path, 0);
+  return real_stat64(rpath, st);
+}
+
+extern "C" int lstat64(const char *path, struct stat64 *st) {
+  REALF(int, lstat64, const char *, struct stat64 *);
+  RESOLVE(path, 0);
+  return real_lstat64(rpath, st);
+}
+
+extern "C" int fstatat64(int dirfd, const char *path, struct stat64 *st,
+                         int flags) {
+  REALF(int, fstatat64, int, const char *, struct stat64 *, int);
+  if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
+    RESOLVE(path, 0);
+    return real_fstatat64(dirfd, rpath, st, flags);
+  }
+  return real_fstatat64(dirfd, path, st, flags);
+}
+
+extern "C" int openat64(int dirfd, const char *path, int flags, ...) {
+  REALF(int, openat64, int, const char *, int, ...);
+  va_list ap;
+  va_start(ap, flags);
+  mode_t mode = (mode_t)va_arg(ap, unsigned);
+  va_end(ap);
+  if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
+    RESOLVE(path, flags & O_CREAT);
+    return real_openat64(dirfd, rpath, flags, mode);
+  }
+  return real_openat64(dirfd, path, flags, mode);
+}
+
+extern "C" int creat64(const char *path, mode_t mode) {
+  REALF(int, creat64, const char *, mode_t);
+  RESOLVE(path, 1);
+  return real_creat64(rpath, mode);
+}
+
+extern "C" int truncate64(const char *path, off64_t len) {
+  REALF(int, truncate64, const char *, off64_t);
+  RESOLVE(path, 0);
+  return real_truncate64(rpath, len);
+}
+
+/* On current glibc the __xstat family are versioned COMPAT symbols, so
+ * dlsym(RTLD_NEXT) may return NULL; fall back to the plain syscalls the
+ * modern wrappers use (the version argument only selects struct layout,
+ * and layout _STAT_VER matches the modern struct on x86-64). */
+
+extern "C" int __xstat(int ver, const char *path, struct stat *st) {
+  REALF(int, __xstat, int, const char *, struct stat *);
+  RESOLVE(path, 0);
+  if (real___xstat) return real___xstat(ver, rpath, st);
+  return stat(rpath, st);
+}
+
+extern "C" int __lxstat(int ver, const char *path, struct stat *st) {
+  REALF(int, __lxstat, int, const char *, struct stat *);
+  RESOLVE(path, 0);
+  if (real___lxstat) return real___lxstat(ver, rpath, st);
+  return lstat(rpath, st);
+}
+
+extern "C" int __xstat64(int ver, const char *path, struct stat64 *st) {
+  REALF(int, __xstat64, int, const char *, struct stat64 *);
+  RESOLVE(path, 0);
+  if (real___xstat64) return real___xstat64(ver, rpath, st);
+  return stat64(rpath, st);
+}
+
+extern "C" int __fxstatat(int ver, int dirfd, const char *path,
+                          struct stat *st, int flags) {
+  REALF(int, __fxstatat, int, int, const char *, struct stat *, int);
+  const char *p = path;
+  char rbuf[4096];
+  if (dirfd == AT_FDCWD || (path && path[0] == '/'))
+    p = shd_resolve_path(path, rbuf, sizeof rbuf, 0);
+  if (real___fxstatat) return real___fxstatat(ver, dirfd, p, st, flags);
+  return fstatat(dirfd, p, st, flags);
+}

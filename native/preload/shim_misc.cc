@@ -308,10 +308,23 @@ static struct shd_file *as_shd_file(FILE *f) {
   return (s && s->magic == SHD_FILE_MAGIC) ? s : NULL;
 }
 
+/* per-host path virtualization (shim_files.cc) */
+extern "C" const char *shd_resolve_path(const char *path, char *buf,
+                                        size_t cap, int creating);
+
+static int fopen_mode_creates(const char *mode) {
+  return mode && (strchr(mode, 'w') || strchr(mode, 'a'));
+}
+
 extern "C" FILE *fopen(const char *path, const char *mode) {
   static FILE *(*real_fopen)(const char *, const char *);
   if (!real_fopen) *(void **)(&real_fopen) = dlsym(RTLD_NEXT, "fopen");
-  if (!shd_active() || !is_random_path2(path)) return real_fopen(path, mode);
+  if (!shd_active()) return real_fopen(path, mode);
+  if (!is_random_path2(path)) {
+    char rbuf[4096];
+    return real_fopen(shd_resolve_path(path, rbuf, sizeof rbuf,
+                                       fopen_mode_creates(mode)), mode);
+  }
   int fd = shd_open_random_fd();
   if (fd < 0) return NULL;
   struct shd_file *s = (struct shd_file *)calloc(1, sizeof *s);
@@ -323,7 +336,12 @@ extern "C" FILE *fopen(const char *path, const char *mode) {
 extern "C" FILE *fopen64(const char *path, const char *mode) {
   static FILE *(*real_fopen64)(const char *, const char *);
   if (!real_fopen64) *(void **)(&real_fopen64) = dlsym(RTLD_NEXT, "fopen64");
-  if (!shd_active() || !is_random_path2(path)) return real_fopen64(path, mode);
+  if (!shd_active()) return real_fopen64(path, mode);
+  if (!is_random_path2(path)) {
+    char rbuf[4096];
+    return real_fopen64(shd_resolve_path(path, rbuf, sizeof rbuf,
+                                         fopen_mode_creates(mode)), mode);
+  }
   return fopen(path, mode);
 }
 

@@ -19,7 +19,8 @@ from typing import Any, Optional, Tuple
 
 
 class Event:
-    __slots__ = ("task", "time", "dst_host", "src_host", "sequence")
+    __slots__ = ("task", "time", "dst_host", "src_host", "sequence",
+                 "pq_entry")
 
     def __init__(self, task, time: int, dst_host, src_host, sequence: int):
         self.task = task
@@ -27,6 +28,7 @@ class Event:
         self.dst_host = dst_host      # Host object (owns execution context)
         self.src_host = src_host      # Host that scheduled it
         self.sequence = sequence      # per-src-host monotonic event id
+        self.pq_entry = None          # intrusive heap slot (utils/pqueue.py)
 
     def order_key(self) -> Tuple[int, int, int, int]:
         return (self.time,
@@ -56,12 +58,14 @@ class Event:
                     return False
             host.now = self.time
             worker.active_host = host
+            t = self.task
             try:
-                self.task.execute()
+                t.callback(t.obj, t.arg)   # Task.execute, inlined (hot)
             finally:
                 worker.active_host = None
         else:
-            self.task.execute()
+            t = self.task
+            t.callback(t.obj, t.arg)
         return True
 
     def __repr__(self) -> str:  # pragma: no cover - debug aid

@@ -98,16 +98,9 @@ class GlobalSinglePolicy(SchedulerPolicy):
         if worker_id != 0:
             return None
         if self.serial:
-            q = self.queue
-            key = q.peek_key()
-            if key is None or key[0] >= window_end:
-                return None
-            return q.pop()
+            return self.queue.pop_before(window_end)
         with self._lock:
-            key = self.queue.peek_key()
-            if key is None or key[0] >= window_end:
-                return None
-            return self.queue.pop()
+            return self.queue.pop_before(window_end)
 
     def next_time(self) -> int:
         with self._lock:

@@ -829,12 +829,17 @@ class NativePool:
         _live_children.append(self.proc)
         self.count = 0
 
-    def add_instance(self, so_path: str, args: List[str], vpid: int):
-        """Returns the simulator-side protocol socket for the new instance."""
+    def add_instance(self, so_path: str, args: List[str], vpid: int,
+                     data_dir: str = ""):
+        """Returns the simulator-side protocol socket for the new instance.
+        ``data_dir`` is the instance's host data dir (op 2 payload leads
+        with it), cached by the namespace's shim for per-host absolute-path
+        virtualization (shim_files.cc)."""
         sim_side, inst_side = real_socket.socketpair()
         argv = [so_path] + list(args)
-        payload = b"".join(a.encode() + b"\0" for a in argv)
-        hdr = struct.pack("<IIq", 16 + len(payload), 1, int(vpid))
+        payload = data_dir.encode() + b"\0" \
+            + b"".join(a.encode() + b"\0" for a in argv)
+        hdr = struct.pack("<IIq", 16 + len(payload), 2, int(vpid))
         real_socket.send_fds(self.control, [hdr + payload],
                              [inst_side.fileno()])
         inst_side.close()
@@ -867,8 +872,12 @@ def run_pooled_plugin(api, args: List[str], so_path: str):
     name = api.process.name
     engine = api.host.engine
     pool = _pool_for(engine)
+    data_root = getattr(engine, "data_directory", None) or "shadow.data"
+    host_dir = os.path.join(data_root, "hosts", api.host.name)
+    os.makedirs(host_dir, exist_ok=True)
     try:
-        sim_side = pool.add_instance(so_path, args, api.process.pid)
+        sim_side = pool.add_instance(so_path, args, api.process.pid,
+                                     os.path.abspath(host_dir))
     except OSError as e:
         log.warning("native", f"{name}: pool add_instance failed: {e}")
         return 127

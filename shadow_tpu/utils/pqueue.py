@@ -4,62 +4,73 @@ The reference wraps GLib heaps with a membership hash for O(1) find/remove
 (utility/priority_queue.c) and a mutexed variant
 (utility/async_priority_queue.c).  We build on ``heapq`` with lazy deletion —
 removal marks the entry dead; dead entries are skipped on pop.
+
+Hot-path design: the membership hash is replaced by an intrusive slot on the
+item itself (``item.pq_entry`` — Event reserves it).  A push that reschedules
+an already-queued item invalidates its live entry through the slot instead of
+a dict lookup; pops clear the entry's live bit as it leaves the heap.  One
+item is in at most one queue at a time (the scheduler policies' invariant —
+steal migration pops before re-pushing), which is what makes the single slot
+sufficient.
 """
 
 from __future__ import annotations
 
 import heapq
 import threading
-from typing import Any, Generic, List, Optional, Tuple, TypeVar
+from typing import Any, Generic, List, Optional, TypeVar
 
 T = TypeVar("T")
 
 
 class PriorityQueue(Generic[T]):
-    """Min-heap keyed by item.order_key() (or the item itself), with lazy
-    removal."""
+    """Min-heap keyed by item.order_key() (or an explicit key), with lazy
+    removal.  Items must expose a writable ``pq_entry`` attribute."""
 
-    __slots__ = ("_heap", "_entries", "_count")
+    __slots__ = ("_heap", "_count", "_len")
 
     def __init__(self):
-        self._heap: List[Tuple[Any, int, list]] = []
-        self._entries = {}  # id(item) -> entry
-        self._count = 0     # insertion tiebreak for identical keys
+        self._heap: List[list] = []   # [key, tiebreak, item, live]
+        self._count = 0               # insertion tiebreak for identical keys
+        self._len = 0                 # live entries
 
     def __len__(self) -> int:
-        return len(self._entries)
+        return self._len
 
     def push(self, item: T, key=None) -> None:
         if key is None:
             key = item.order_key()
-        if id(item) in self._entries:
-            # Re-push = reschedule: drop the stale heap entry so one item
-            # never has two live entries (the membership hash the reference's
-            # priority_queue.c maintains for the same reason).  Calls the
-            # unlocked helper so AsyncPriorityQueue.push doesn't self-deadlock.
-            self._remove_impl(item)
+        old = item.pq_entry
+        if old is not None and old[3]:
+            # re-push = reschedule: kill the stale live entry so one item
+            # never has two live entries (the membership hash the
+            # reference's priority_queue.c maintains for the same reason)
+            old[3] = False
+            old[2] = None
+            self._len -= 1
         entry = [key, self._count, item, True]
         self._count += 1
-        self._entries[id(item)] = entry
+        item.pq_entry = entry
         heapq.heappush(self._heap, entry)
+        self._len += 1
 
-    def _remove_impl(self, item: T) -> bool:
-        entry = self._entries.pop(id(item), None)
-        if entry is None:
+    def remove(self, item: T) -> bool:
+        entry = getattr(item, "pq_entry", None)
+        if entry is None or not entry[3]:
             return False
         entry[3] = False
         entry[2] = None
+        self._len -= 1
         return True
 
-    def remove(self, item: T) -> bool:
-        return self._remove_impl(item)
-
     def __contains__(self, item: T) -> bool:
-        return id(item) in self._entries
+        entry = getattr(item, "pq_entry", None)
+        return entry is not None and entry[3]
 
     def _prune(self) -> None:
-        while self._heap and not self._heap[0][3]:
-            heapq.heappop(self._heap)
+        heap = self._heap
+        while heap and not heap[0][3]:
+            heapq.heappop(heap)
 
     def peek(self) -> Optional[T]:
         self._prune()
@@ -70,12 +81,31 @@ class PriorityQueue(Generic[T]):
         return self._heap[0][0] if self._heap else None
 
     def pop(self) -> Optional[T]:
-        self._prune()
-        if not self._heap:
-            return None
-        entry = heapq.heappop(self._heap)
-        del self._entries[id(entry[2])]
-        return entry[2]
+        heap = self._heap
+        while heap:
+            entry = heapq.heappop(heap)
+            if entry[3]:
+                entry[3] = False
+                self._len -= 1
+                return entry[2]
+        return None
+
+    def pop_before(self, time_limit) -> Optional[T]:
+        """Pop the min item iff its key's time field (key[0]) < time_limit —
+        the scheduler's window-bounded pop in ONE heap pass."""
+        heap = self._heap
+        while heap:
+            entry = heap[0]
+            if not entry[3]:
+                heapq.heappop(heap)
+                continue
+            if entry[0][0] >= time_limit:
+                return None
+            heapq.heappop(heap)
+            entry[3] = False
+            self._len -= 1
+            return entry[2]
+        return None
 
 
 class AsyncPriorityQueue(PriorityQueue[T]):
@@ -97,7 +127,7 @@ class AsyncPriorityQueue(PriorityQueue[T]):
 
     def remove(self, item: T) -> bool:
         with self._lock:
-            return self._remove_impl(item)
+            return super().remove(item)
 
     def peek(self) -> Optional[T]:
         with self._lock:
@@ -110,3 +140,7 @@ class AsyncPriorityQueue(PriorityQueue[T]):
     def pop(self) -> Optional[T]:
         with self._lock:
             return super().pop()
+
+    def pop_before(self, time_limit) -> Optional[T]:
+        with self._lock:
+            return super().pop_before(time_limit)

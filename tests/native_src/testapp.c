@@ -714,9 +714,76 @@ static int cmd_sockmisc(void) {
   return 0;
 }
 
+/* ------------------------------------------------------------- files ----
+ * Absolute-path per-host file namespace (shim_files.cc): mkdir chain,
+ * fopen-write, stat, rename, open-read-back, access.  Under the simulator
+ * every absolute path below lands in <host-data-dir>/vfs/...; natively it
+ * uses the real fs (both must succeed — the dual-execution property). */
+#include <sys/stat.h>
+static int cmd_files(const char *tag) {
+  if (mkdir("/var", 0755) != 0 && errno != EEXIST) return 1;
+  if (mkdir("/var/tmp", 0755) != 0 && errno != EEXIST) return 2;
+  if (mkdir("/var/tmp/shadowfiles", 0755) != 0 && errno != EEXIST) return 3;
+  char path[256], path2[256], want[160];
+  snprintf(path, sizeof path, "/var/tmp/shadowfiles/%s.tmp", tag);
+  snprintf(path2, sizeof path2, "/var/tmp/shadowfiles/%s.dat", tag);
+  snprintf(want, sizeof want, "hello-%s", tag);
+  FILE *f = fopen(path, "w");
+  if (!f) return 4;
+  if (fputs(want, f) < 0) return 5;
+  fclose(f);
+  struct stat st;
+  if (stat(path, &st) != 0) return 6;
+  if (st.st_size != (off_t)strlen(want)) return 7;
+  if (rename(path, path2) != 0) return 8;
+  if (access(path, F_OK) == 0) return 9;      /* old name must be gone */
+  int fd = open(path2, O_RDONLY);
+  if (fd < 0) return 10;
+  char buf[160];
+  ssize_t n = read(fd, buf, sizeof buf - 1);
+  close(fd);
+  if (n != (ssize_t)strlen(want)) return 11;
+  buf[n] = '\0';
+  if (strcmp(buf, want) != 0) return 12;
+  /* chdir through the namespace, then a RELATIVE write must land in the
+   * same directory an absolute path names (cwd/namespace consistency) */
+  if (chdir("/var/tmp/shadowfiles") != 0) return 15;
+  char relname[160], absname[320];
+  snprintf(relname, sizeof relname, "%s.rel", tag);
+  snprintf(absname, sizeof absname, "/var/tmp/shadowfiles/%s.rel", tag);
+  FILE *rf = fopen(relname, "w");
+  if (!rf) return 16;
+  fputs(tag, rf);
+  fclose(rf);
+  if (stat(absname, &st) != 0) return 17;     /* absolute sees relative */
+  if (st.st_size != (off_t)strlen(tag)) return 18;
+  /* getcwd must compose consistently with the namespace */
+  char cwd[1024];
+  if (!getcwd(cwd, sizeof cwd)) return 19;
+  char composed[1400];
+  snprintf(composed, sizeof composed, "%s/%s", cwd, relname);
+  if (access(composed, F_OK) != 0) return 20;
+  if (under_sim()) {
+    /* deep creating open: the namespace makes parent dirs on demand */
+    char deep[256];
+    snprintf(deep, sizeof deep, "/srv/%s/a/b/deep.txt", tag);
+    int dfd = open(deep, O_CREAT | O_WRONLY, 0644);
+    if (dfd < 0) return 13;
+    if (write(dfd, tag, strlen(tag)) != (ssize_t)strlen(tag)) return 14;
+    close(dfd);
+  } else {
+    /* native run: clean up the real fs */
+    unlink(absname);
+    unlink(path2);
+  }
+  printf("files OK tag=%s\n", tag);
+  return 0;
+}
+
 int main(int argc, char **argv) {
   if (argc < 2) return 64;
   const char *cmd = argv[1];
+  if (!strcmp(cmd, "files") && argc >= 3) return cmd_files(argv[2]);
   if (!strcmp(cmd, "vtime")) return cmd_vtime();
   if (!strcmp(cmd, "sockmisc")) return cmd_sockmisc();
   if (!strcmp(cmd, "selfpipe")) return cmd_selfpipe();

@@ -35,14 +35,23 @@ def native_bin(tmp_path_factory):
     return str(out)
 
 
-def run_sim(xml, stop=120, policy="global", workers=0):
+def run_sim(xml, stop=120, policy="global", workers=0, data_directory=None):
     cfg = configuration.parse_xml(xml)
     cfg.stop_time_sec = stop
     opts = Options(scheduler_policy=policy, workers=workers,
                    stop_time_sec=stop)
+    if data_directory:
+        opts.data_directory = str(data_directory)
     ctrl = Controller(opts, cfg)
     rc = ctrl.run()
     return rc, ctrl
+
+
+def vfs_path(data_dir, host, abs_path):
+    """Where an in-sim absolute path lands on the real fs: the host's
+    virtualized namespace (shim_files.cc)."""
+    return os.path.join(str(data_dir), "hosts", host, "vfs",
+                        str(abs_path).lstrip("/"))
 
 
 def exit_codes(ctrl, *hosts):
@@ -323,10 +332,13 @@ def test_real_wget_downloads_through_simulator(tmp_path, native_bin):
           </host>
         </shadow>
     """)
-    rc, ctrl = run_sim(xml)
+    rc, ctrl = run_sim(xml, data_directory=tmp_path / "data")
     assert rc == 0
     assert exit_codes(ctrl, "client") == {"client": [0]}
-    data = out.read_bytes()
+    # the absolute -O path lands in the client host's file namespace
+    import pathlib
+    data = pathlib.Path(vfs_path(tmp_path / "data", "client",
+                                 out)).read_bytes()
     assert len(data) == nbytes
     # content oracle: the deterministic pattern the httpd app serves
     from shadow_tpu.apps.httpd import _body
@@ -355,11 +367,13 @@ def test_real_curl_downloads_through_simulator(tmp_path, native_bin):
           </host>
         </shadow>
     """)
-    rc, ctrl = run_sim(xml)
+    rc, ctrl = run_sim(xml, data_directory=tmp_path / "data")
     assert rc == 0
     assert exit_codes(ctrl, "client") == {"client": [0]}
+    import pathlib
     from shadow_tpu.apps.httpd import _body
-    assert out.read_bytes() == _body(nbytes)
+    assert pathlib.Path(vfs_path(tmp_path / "data", "client",
+                                 out)).read_bytes() == _body(nbytes)
 
 
 @pytest.fixture(scope="session")
@@ -407,6 +421,44 @@ def test_pooled_plugins_100_hosts_few_processes(native_bin, native_so):
     for i in range(50):
         assert exit_codes(ctrl, f"srv{i}", f"cli{i}") == \
             {f"srv{i}": [0], f"cli{i}": [0]}
+
+
+def test_native_file_namespace(native_bin, native_so, tmp_path):
+    """Per-host ABSOLUTE-path file namespaces (shim_files.cc): the same
+    binary writes /var/tmp/... on three hosts (one pooled); each host's
+    files land isolated under <data>/hosts/<host>/vfs/..., deep creating
+    opens make parents on demand, and the binary's own stat/rename/access/
+    read-back checks pass both natively and simulated (dual execution)."""
+    native = subprocess.run([native_bin, "files", "native"], timeout=30)
+    assert native.returncode == 0
+
+    data = tmp_path / "data"
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_bin}" />
+          <plugin id="pooled" path="{native_so}" />
+          <host id="h1"><process plugin="app" starttime="1" arguments="files h1" /></host>
+          <host id="h2"><process plugin="app" starttime="1" arguments="files h2" /></host>
+          <host id="h3"><process plugin="pooled" starttime="1" arguments="files h3" /></host>
+        </shadow>
+    """)
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = 30
+    ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                              stop_time_sec=30, data_directory=str(data)),
+                      cfg)
+    assert ctrl.run() == 0
+    assert exit_codes(ctrl, "h1", "h2", "h3") == \
+        {"h1": [0], "h2": [0], "h3": [0]}
+    for h in ("h1", "h2", "h3"):
+        vfs = data / "hosts" / h / "vfs"
+        dat = vfs / "var" / "tmp" / "shadowfiles" / f"{h}.dat"
+        assert dat.read_bytes() == f"hello-{h}".encode()
+        deep = vfs / "srv" / h / "a" / "b" / "deep.txt"
+        assert deep.read_bytes() == h.encode()
+        other = "h2" if h == "h1" else "h1"
+        assert not (vfs / "var" / "tmp" / "shadowfiles"
+                    / f"{other}.dat").exists(), "namespace leaked"
 
 
 def test_native_sockmisc(native_bin):
