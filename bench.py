@@ -184,6 +184,25 @@ def bench_phold() -> dict:
     out["phold_device_hops_per_sec"] = round(hops / dt)
     out["phold_device_sim_sec_per_wall_sec"] = round(30.0 / dt, 1)
 
+    # north-star bandwidth composition: token-bucket pacing + drop-tail +
+    # refill lifetime fused on device (ops/saturate_device.py), all state
+    # in HBM — 4096 interfaces stepped through 30k 1 ms ticks
+    from shadow_tpu.ops.saturate_device import DeviceSaturate
+
+    rng = np.random.default_rng(17)
+    n_if = 4096
+    sat = DeviceSaturate(rng.integers(200, 4000, size=n_if))
+    first = np.zeros(n_if, dtype=np.int64)
+    npk = np.full(n_if, 20_000, dtype=np.int64)
+    sat.run_device(first, npk, 100)          # compile
+    t0 = time.perf_counter()
+    delivered, dropped, _q, _t = sat.run_device(first, npk, 30_000)
+    dt = time.perf_counter() - t0
+    out["saturate_device_interfaces"] = n_if
+    out["saturate_device_if_ticks_per_sec"] = round(n_if * 30_000 / dt)
+    out["saturate_device_delivered_pkts"] = int(delivered.sum())
+    out["saturate_device_dropped_pkts"] = int(dropped.sum())
+
     # engine twin (small instance; the full pipeline costs more per event)
     n = 64
     xml = (f'<shadow stoptime="30"><plugin id="phold" path="python:phold" />'

@@ -116,7 +116,13 @@ extern "C" const char *shd_resolve_path(const char *path, char *buf,
       if (prefix_match(path, k_passthrough[i])) return path;
     n = snprintf(buf, cap, "%s/vfs%s", g_vroot, path);
   }
-  if (n <= 0 || (size_t)n >= cap) return path;  /* overlong: passthrough */
+  if (n <= 0 || (size_t)n >= cap) {
+    /* overlong: NEVER fall back to the real path (that would silently
+     * escape the namespace); substitute a path whose parent cannot exist
+     * so the operation fails cleanly with ENOENT */
+    snprintf(buf, cap, "%s/.vfs-enametoolong/x", g_vroot);
+    return buf;
+  }
   if (creating) ensure_parents(buf);
   return buf;
 }
@@ -358,6 +364,56 @@ extern "C" int truncate64(const char *path, off64_t len) {
   REALF(int, truncate64, const char *, off64_t);
   RESOLVE(path, 0);
   return real_truncate64(rpath, len);
+}
+
+extern "C" int statx(int dirfd, const char *path, int flags,
+                     unsigned mask, struct statx *st) {
+  REALF(int, statx, int, const char *, int, unsigned, struct statx *);
+  /* modern coreutils/wget stat through statx directly */
+  if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
+    RESOLVE(path, 0);
+    return real_statx(dirfd, rpath, flags, mask, st);
+  }
+  return real_statx(dirfd, path, flags, mask, st);
+}
+
+extern "C" ssize_t readlink(const char *path, char *buf, size_t bufsiz) {
+  REALF(ssize_t, readlink, const char *, char *, size_t);
+  RESOLVE(path, 0);
+  return real_readlink(rpath, buf, bufsiz);
+}
+
+extern "C" int symlink(const char *target, const char *linkpath) {
+  REALF(int, symlink, const char *, const char *);
+  /* the link NAME is namespace state; the target string is stored as-is
+   * (relative targets resolve inside the vfs tree on traversal) */
+  RESOLVE(linkpath, 1);
+  return real_symlink(target, rpath);
+}
+
+extern "C" int link(const char *oldp, const char *newp) {
+  REALF(int, link, const char *, const char *);
+  char ob[4096], nb[4096];
+  const char *ro = shd_resolve_path(oldp, ob, sizeof ob, 0);
+  const char *rn = shd_resolve_path(newp, nb, sizeof nb, 1);
+  return real_link(ro, rn);
+}
+
+extern "C" int utimensat(int dirfd, const char *path,
+                         const struct timespec times[2], int flags) {
+  REALF(int, utimensat, int, const char *, const struct timespec[2], int);
+  /* wget -N and friends restore mtimes after download */
+  if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
+    RESOLVE(path, 0);
+    return real_utimensat(dirfd, rpath, times, flags);
+  }
+  return real_utimensat(dirfd, path, times, flags);
+}
+
+extern "C" int chown(const char *path, uid_t owner, gid_t group) {
+  REALF(int, chown, const char *, uid_t, gid_t);
+  RESOLVE(path, 0);
+  return real_chown(rpath, owner, group);
 }
 
 /* On current glibc the __xstat family are versioned COMPAT symbols, so

@@ -16,6 +16,7 @@ per round (the reference uses five; ours fold the start/prepare pairs).
 from __future__ import annotations
 
 import os
+import resource
 import threading
 import time as _walltime
 from typing import Dict, List, Optional
@@ -305,13 +306,17 @@ class Engine:
                      f" last_batch={policy.last_batch}"
                      f" device_calls={kern.device_calls}"
                      f" recompiles={len(kern.buckets_seen)}")
+        # resource usage line, reference slave.c:390-411 heartbeat getrusage
+        ru = resource.getrusage(resource.RUSAGE_SELF)
         get_logger().message(
             "engine",
             f"[engine-heartbeat] rounds={self.rounds_executed}"
             f" simtime={self.scheduler.window_start / 1e9:.3f}s"
             f" wall={now_wall - self.sim_start_wall:.1f}s"
             f" host_exec_ms={self.host_exec_ns / 1e6:.1f}"
-            f" flush_ms={self.flush_ns / 1e6:.1f}{extra}",
+            f" flush_ms={self.flush_ns / 1e6:.1f}"
+            f" cpu_user_s={ru.ru_utime:.1f} cpu_sys_s={ru.ru_stime:.1f}"
+            f" maxrss_mb={ru.ru_maxrss / 1024:.0f}{extra}",
             sim_time=self.scheduler.window_start)
 
     def _run_serial(self, lookahead: int) -> None:

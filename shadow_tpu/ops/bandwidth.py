@@ -21,10 +21,22 @@ compile once.
 
 Exactness is asserted bit-for-bit against the event-driven host
 implementation (the TokenBucket class the CPU policies use) by
-tests/test_bandwidth_ops.py.  Wiring this into the tpu policy's flush —
-so bandwidth-delayed delivery times are decided on device — is the staged
-remaining north-star integration; upstream queue admission (drop-tail /
-CoDel sojourn AQM) stays host-side with the router model.
+tests/test_bandwidth_ops.py.
+
+Why this kernel is NOT wired into the tpu policy's flush as a replacement
+for the event-driven interface drain: the exactness boundary is the
+interface's self-suspending refill task (network_interface.c:121-183).
+One task refills BOTH the send and receive buckets each tick and stays
+scheduled only while any work is pending — so receive-side pacing decided
+ahead-of-time on device would still have to reproduce the task's side
+effects on the *send* bucket (and its scheduling lifetime) to keep state
+digests identical to the CPU policies, which means running the event
+machinery anyway.  Batch pacing is therefore exact only for the isolated
+FIFO-bucket regime this kernel models (what the parity test pins down);
+the full composition — hop latency + bucket pacing + drop-tail overflow
+fused on device — is demonstrated where it is architecturally honest: the
+fully device-resident model in ops/saturate_device.py, where ALL interface
+state lives in HBM and there is no host twin to stay bit-equal with.
 """
 
 from __future__ import annotations

@@ -1,0 +1,161 @@
+"""Fully device-resident bandwidth saturation: the north-star composition.
+
+BASELINE.json's north star names three terms to fuse into the device step:
+topology latency (ops/round_step.py, fused), the interface token-bucket
+bandwidth term (ops/bandwidth.py, exact twin), and queue admission.  This
+module composes bucket pacing + drop-tail queue admission + the interface
+refill-task lifetime into ONE device program with all state in HBM — the
+same architectural end-state ops/phold_device.py demonstrates for the
+scheduler, here for the bandwidth pipeline (reference hot path:
+network_interface.c:421-455 receive loop, :121-183 self-suspending refill,
+router_queue_static.c drop-tail).
+
+The model is an EXACT twin of the engine's interface dynamics for
+constant-bit-rate inbound flows (one packet of fixed size per 1 ms tick per
+source), including the subtle parts:
+
+* the refill task refills only while it is alive, and it stays alive
+  exactly while the queue is non-empty after the tick's final drain
+  (network_interface.py _has_pending_work / _ensure_refill_scheduled);
+* a tick's arrival drains with PRE-refill tokens when the refill event
+  shares its timestamp (the event order tuple puts the arrival first when
+  the sender's host id is lower);
+* whole-packet token spending (TokenBucket.try_consume) and drop-tail
+  admission against a packet-capacity queue (StaticQueue).
+
+tests/test_saturate_device.py pins this down three ways: bit-identical
+device vs numpy twins, closed-form saturation rates, and — the strong one —
+exact delivered/dropped counts against the REAL engine running a blast
+source/sink pair through the full interface/router/socket stack.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import defs
+from .bandwidth import bucket_params
+
+
+@jax.jit
+def saturate_run(first_tick: jnp.ndarray,   # int64 [H] first arrival tick
+                 n_pkts: jnp.ndarray,       # int64 [H] packets per flow
+                 size: jnp.ndarray,         # int64 scalar: packet bytes
+                 refill: jnp.ndarray,       # int64 [H] bytes per tick
+                 capacity: jnp.ndarray,     # int64 [H] bucket cap bytes
+                 qcap_pkts: jnp.ndarray,    # int64 scalar: queue capacity
+                 ticks: jnp.ndarray,        # int64 scalar: tick count
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
+                            jnp.ndarray]:
+    """Run the saturation model for ``ticks`` 1 ms ticks entirely on device.
+
+    Per tick and host: one packet arrives while the flow is active
+    (first_tick <= t < first_tick + n_pkts); drop-tail admission; drain
+    with pre-refill tokens; if the refill task is alive, refill then drain
+    again; the task stays alive iff the queue is non-empty afterwards.
+
+    Returns (delivered, dropped, queue, tokens) per host.
+    """
+    h = first_tick.shape[0]
+
+    def tick_body(t, state):
+        tokens, queue, alive, delivered, dropped = state
+        arr = ((t >= first_tick) & (t < first_tick + n_pkts)) \
+            .astype(jnp.int64)
+        # drop-tail admission (StaticQueue.enqueue).  ``queue`` here is the
+        # TOTAL backlog; whenever it is non-empty the interface keeps one
+        # peeked packet staged OUTSIDE the router queue
+        # (router.py peek_deliverable), so the drop check sees queue-1 and
+        # the effective capacity is qcap + 1.
+        space = qcap_pkts + 1 - queue
+        admit = jnp.minimum(arr, jnp.maximum(space, 0))
+        dropped = dropped + (arr - admit)
+        queue = queue + admit
+        # arrival-triggered drain: pre-refill tokens (arrival orders before
+        # the tick's refill event)
+        n1 = jnp.minimum(queue, tokens // size)
+        queue = queue - n1
+        tokens = tokens - n1 * size
+        delivered = delivered + n1
+        # refill task fires only while alive; drains again after refilling
+        tok_ref = jnp.minimum(capacity, tokens + refill)
+        tokens = jnp.where(alive, tok_ref, tokens)
+        n2 = jnp.where(alive, jnp.minimum(queue, tokens // size),
+                       jnp.int64(0))
+        queue = queue - n2
+        tokens = tokens - n2 * size
+        delivered = delivered + n2
+        alive = queue > 0
+        return tokens, queue, alive, delivered, dropped
+
+    zeros = jnp.zeros(h, dtype=jnp.int64)
+    tokens0 = capacity.astype(jnp.int64)
+    state = (tokens0, zeros, jnp.zeros(h, dtype=bool), zeros, zeros)
+    tokens, queue, _alive, delivered, dropped = jax.lax.fori_loop(
+        jnp.int64(0), ticks, tick_body, state)
+    return delivered, dropped, queue, tokens
+
+
+def saturate_run_numpy(first_tick: np.ndarray, n_pkts: np.ndarray,
+                       size: int, refill: np.ndarray, capacity: np.ndarray,
+                       qcap_pkts: int, ticks: int):
+    """Bit-identical host twin — the parity oracle for the device loop."""
+    h = len(first_tick)
+    tokens = capacity.astype(np.int64).copy()
+    queue = np.zeros(h, dtype=np.int64)
+    alive = np.zeros(h, dtype=bool)
+    delivered = np.zeros(h, dtype=np.int64)
+    dropped = np.zeros(h, dtype=np.int64)
+    for t in range(ticks):
+        arr = ((t >= first_tick) & (t < first_tick + n_pkts)) \
+            .astype(np.int64)
+        admit = np.minimum(arr, np.maximum(qcap_pkts + 1 - queue, 0))
+        dropped += arr - admit
+        queue += admit
+        n1 = np.minimum(queue, tokens // size)
+        queue -= n1
+        tokens -= n1 * size
+        delivered += n1
+        tok_ref = np.minimum(capacity, tokens + refill)
+        tokens = np.where(alive, tok_ref, tokens)
+        n2 = np.where(alive, np.minimum(queue, tokens // size), 0)
+        queue -= n2
+        tokens -= n2 * size
+        delivered += n2
+        alive = queue > 0
+    return delivered, dropped, queue, tokens
+
+
+class DeviceSaturate:
+    """Convenience wrapper: H independent CBR flows into H throttled
+    receivers, parameterized the way the engine is (KiB/s bandwidths)."""
+
+    def __init__(self, bw_down_kibps: np.ndarray, payload_bytes: int = 958,
+                 qcap_pkts: int = 1024):
+        refill, capacity = bucket_params(np.asarray(bw_down_kibps))
+        self.refill = refill.astype(np.int64)
+        self.capacity = capacity.astype(np.int64)
+        self.size = payload_bytes + defs.CONFIG_HEADER_SIZE_UDPIPETH
+        self.qcap_pkts = qcap_pkts
+
+    def run_device(self, first_tick: np.ndarray, n_pkts: np.ndarray,
+                   ticks: int):
+        out = saturate_run(jnp.asarray(first_tick, dtype=jnp.int64),
+                           jnp.asarray(n_pkts, dtype=jnp.int64),
+                           jnp.int64(self.size),
+                           jnp.asarray(self.refill),
+                           jnp.asarray(self.capacity),
+                           jnp.int64(self.qcap_pkts), jnp.int64(ticks))
+        jax.block_until_ready(out)
+        return tuple(np.asarray(o) for o in out)
+
+    def run_numpy(self, first_tick: np.ndarray, n_pkts: np.ndarray,
+                  ticks: int):
+        return saturate_run_numpy(first_tick, n_pkts, self.size,
+                                  self.refill, self.capacity,
+                                  self.qcap_pkts, ticks)

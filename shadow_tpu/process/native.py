@@ -121,6 +121,17 @@ def preload_lib_path() -> str:
     return _PRELOAD_LIB
 
 
+def host_data_dir(host) -> str:
+    """The single definition of the per-host data layout
+    (<data-directory>/hosts/<name>, reference slave.c hostDataPath);
+    created on first use."""
+    data_root = getattr(getattr(host, "engine", None), "data_directory",
+                        None) or "shadow.data"
+    host_dir = os.path.join(data_root, "hosts", host.name)
+    os.makedirs(host_dir, exist_ok=True)
+    return host_dir
+
+
 def _errno_of(exc: OSError) -> int:
     """Map our virtual-kernel OSError style ('EADDRINUSE: detail') to a
     numeric errno."""
@@ -659,10 +670,7 @@ def run_native_plugin(api, args: List[str], binary: str,
     # per-host file namespace: the plugin's cwd is its host's data dir
     # (reference slave.c data-dir layout: each host gets hostDataPath and
     # plugins run against it), so relative paths isolate per host
-    data_root = getattr(getattr(api.host, "engine", None), "data_directory",
-                        None) or "shadow.data"
-    host_dir = os.path.join(data_root, "hosts", api.host.name)
-    os.makedirs(host_dir, exist_ok=True)
+    host_dir = host_data_dir(api.host)
     env["SHADOW_TPU_DATA_DIR"] = os.path.abspath(host_dir)
     if extra_env:
         env.update(extra_env)
@@ -872,9 +880,7 @@ def run_pooled_plugin(api, args: List[str], so_path: str):
     name = api.process.name
     engine = api.host.engine
     pool = _pool_for(engine)
-    data_root = getattr(engine, "data_directory", None) or "shadow.data"
-    host_dir = os.path.join(data_root, "hosts", api.host.name)
-    os.makedirs(host_dir, exist_ok=True)
+    host_dir = host_data_dir(api.host)
     try:
         sim_side = pool.add_instance(so_path, args, api.process.pid,
                                      os.path.abspath(host_dir))

@@ -30,7 +30,7 @@ class PriorityQueue(Generic[T]):
     __slots__ = ("_heap", "_count", "_len")
 
     def __init__(self):
-        self._heap: List[list] = []   # [key, tiebreak, item, live]
+        self._heap: List[list] = []   # [key, tiebreak, item, live, owner]
         self._count = 0               # insertion tiebreak for identical keys
         self._len = 0                 # live entries
 
@@ -44,11 +44,14 @@ class PriorityQueue(Generic[T]):
         if old is not None and old[3]:
             # re-push = reschedule: kill the stale live entry so one item
             # never has two live entries (the membership hash the
-            # reference's priority_queue.c maintains for the same reason)
+            # reference's priority_queue.c maintains for the same reason).
+            # A live entry in ANOTHER queue would mean the one-queue-at-a-
+            # time invariant broke upstream — keep that queue's _len honest
+            # by decrementing the owner, not self.
             old[3] = False
             old[2] = None
-            self._len -= 1
-        entry = [key, self._count, item, True]
+            old[4]._len -= 1
+        entry = [key, self._count, item, True, self]
         self._count += 1
         item.pq_entry = entry
         heapq.heappush(self._heap, entry)
@@ -56,7 +59,7 @@ class PriorityQueue(Generic[T]):
 
     def remove(self, item: T) -> bool:
         entry = getattr(item, "pq_entry", None)
-        if entry is None or not entry[3]:
+        if entry is None or not entry[3] or entry[4] is not self:
             return False
         entry[3] = False
         entry[2] = None
@@ -65,7 +68,7 @@ class PriorityQueue(Generic[T]):
 
     def __contains__(self, item: T) -> bool:
         entry = getattr(item, "pq_entry", None)
-        return entry is not None and entry[3]
+        return entry is not None and entry[3] and entry[4] is self
 
     def _prune(self) -> None:
         heap = self._heap

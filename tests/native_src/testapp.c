@@ -721,12 +721,20 @@ static int cmd_sockmisc(void) {
  * uses the real fs (both must succeed — the dual-execution property). */
 #include <sys/stat.h>
 static int cmd_files(const char *tag) {
+  /* under the simulator the directory is fixed (tests assert the vfs
+   * layout); natively it is keyed by pid so concurrent runs on a shared
+   * machine never race on the same real /var/tmp names */
+  char dir[128];
+  if (under_sim())
+    snprintf(dir, sizeof dir, "/var/tmp/shadowfiles");
+  else
+    snprintf(dir, sizeof dir, "/var/tmp/shadowfiles.%ld", (long)getpid());
   if (mkdir("/var", 0755) != 0 && errno != EEXIST) return 1;
   if (mkdir("/var/tmp", 0755) != 0 && errno != EEXIST) return 2;
-  if (mkdir("/var/tmp/shadowfiles", 0755) != 0 && errno != EEXIST) return 3;
+  if (mkdir(dir, 0755) != 0 && errno != EEXIST) return 3;
   char path[256], path2[256], want[160];
-  snprintf(path, sizeof path, "/var/tmp/shadowfiles/%s.tmp", tag);
-  snprintf(path2, sizeof path2, "/var/tmp/shadowfiles/%s.dat", tag);
+  snprintf(path, sizeof path, "%s/%s.tmp", dir, tag);
+  snprintf(path2, sizeof path2, "%s/%s.dat", dir, tag);
   snprintf(want, sizeof want, "hello-%s", tag);
   FILE *f = fopen(path, "w");
   if (!f) return 4;
@@ -747,10 +755,10 @@ static int cmd_files(const char *tag) {
   if (strcmp(buf, want) != 0) return 12;
   /* chdir through the namespace, then a RELATIVE write must land in the
    * same directory an absolute path names (cwd/namespace consistency) */
-  if (chdir("/var/tmp/shadowfiles") != 0) return 15;
+  if (chdir(dir) != 0) return 15;
   char relname[160], absname[320];
   snprintf(relname, sizeof relname, "%s.rel", tag);
-  snprintf(absname, sizeof absname, "/var/tmp/shadowfiles/%s.rel", tag);
+  snprintf(absname, sizeof absname, "%s/%s.rel", dir, tag);
   FILE *rf = fopen(relname, "w");
   if (!rf) return 16;
   fputs(tag, rf);
@@ -775,6 +783,7 @@ static int cmd_files(const char *tag) {
     /* native run: clean up the real fs */
     unlink(absname);
     unlink(path2);
+    rmdir(dir);
   }
   printf("files OK tag=%s\n", tag);
   return 0;

@@ -109,14 +109,32 @@ def _run(policy: str, workers: int, **opt_kw):
     return state_digest(ctrl.engine), "\n".join(lines)
 
 
+def _run_procs(n: int):
+    from shadow_tpu.parallel.procs import ProcsController
+
+    cfg = configuration.parse_xml(_XML)
+    set_logger(SimLogger(level="warning"))
+    try:
+        ctrl = ProcsController(
+            Options(scheduler_policy="global", workers=0, seed=13,
+                    stop_time_sec=cfg.stop_time_sec, processes=n), cfg)
+        rc = ctrl.run()
+    finally:
+        set_logger(SimLogger())
+    assert rc == 0
+    return ctrl.digest
+
+
 def test_parity_gate_100_host_lossy_star():
     d_global, log_global = _run("global", 0)
     d_steal, _ = _run("steal", 4)
     d_tpu, log_tpu = _run("tpu", 0)
     d_tpu_mt, _ = _run("tpu", 4)
     d_shard, _ = _run("tpu", 0, tpu_devices=8, tpu_shard_matrix=True)
+    d_procs = _run_procs(3)
     assert d_global == d_steal, "steal x4 diverged from serial"
     assert d_global == d_tpu, "tpu policy diverged from serial"
     assert d_global == d_tpu_mt, "tpu x4 workers diverged from serial"
     assert d_global == d_shard, "matrix-sharded tpu diverged from serial"
+    assert d_global == d_procs, "3-process sharded run diverged from serial"
     assert log_global == log_tpu, "stripped logs differ global vs tpu"
