@@ -203,6 +203,22 @@ def bench_phold() -> dict:
     out["saturate_device_delivered_pkts"] = int(delivered.sum())
     out["saturate_device_dropped_pkts"] = int(dropped.sum())
 
+    # flagship-workload shape, device-resident: 2000 circuits over 200
+    # relays (the tor200 scale), bulk cells with shared-relay bandwidth
+    # contention (ops/torcells_device.py)
+    from shadow_tpu.ops.torcells_device import DeviceTorCells
+
+    tc = DeviceTorCells(n_relays=200, n_circuits=2000, seed=23,
+                        relay_bw_kibps=4096)
+    tc.run_device(2, 10_000)                 # compile
+    t0 = time.perf_counter()
+    _d, ticks, fwd = tc.run_device(200, 500_000)
+    dt = time.perf_counter() - t0
+    out["torcells_device_circuits"] = 2000
+    out["torcells_device_cell_forwards"] = fwd
+    out["torcells_device_forwards_per_sec"] = round(fwd / dt)
+    out["torcells_device_sim_sec_per_wall_sec"] = round(ticks / 1000 / dt, 1)
+
     # engine twin (small instance; the full pipeline costs more per event)
     n = 64
     xml = (f'<shadow stoptime="30"><plugin id="phold" path="python:phold" />'

@@ -385,10 +385,16 @@ extern "C" ssize_t readlink(const char *path, char *buf, size_t bufsiz) {
 
 extern "C" int symlink(const char *target, const char *linkpath) {
   REALF(int, symlink, const char *, const char *);
-  /* the link NAME is namespace state; the target string is stored as-is
-   * (relative targets resolve inside the vfs tree on traversal) */
+  /* BOTH strings are namespace state: the link name is created inside the
+   * vfs tree, and an ABSOLUTE target must be stored resolved — otherwise
+   * traversing the link would follow the raw path to the real fs, the
+   * exact escape open("/same/path") maps away.  Relative targets resolve
+   * inside the vfs tree on traversal and pass through untouched. */
+  char tbuf[4096];
+  const char *rtarget = (target && target[0] == '/')
+      ? shd_resolve_path(target, tbuf, sizeof tbuf, 0) : target;
   RESOLVE(linkpath, 1);
-  return real_symlink(target, rpath);
+  return real_symlink(rtarget, rpath);
 }
 
 extern "C" int link(const char *oldp, const char *newp) {

@@ -1,0 +1,252 @@
+"""Device-resident onion-relay cell forwarding: the flagship workload's
+traffic pattern with ALL state in HBM.
+
+apps/tor.py models Tor's network behavior through the full engine (cells,
+circuits, streams over the userspace TCP stack).  This module is the
+device-resident counterpart for the dominant traffic term — bulk cell
+delivery server→exit→middle→guard→client across circuits that CONTEND for
+shared relay bandwidth — composing the three north-star kernels in one
+``lax.while_loop`` program:
+
+* per-edge latency (cells in flight live in a [L, F] ring buffer indexed
+  by arrival tick — the device analog of the delivery event queue);
+* per-node token buckets (1 ms refill ticks, byte capacities from the same
+  ``bucket_params`` the engine's interfaces use);
+* bandwidth allocation across circuits sharing a relay: exact greedy in
+  circuit-id order via STATIC segment cumsums — flows are grouped by
+  receiving node at build time, so the per-tick allocation is one cumsum +
+  two gathers, no sorting and no data-dependent shapes.
+
+Like ops/phold_device.py and ops/saturate_device.py, the numbers this
+produces are honest about what they are: a model workload (no TCP control
+loop, no cell crypto) showing the architecture's throughput when the host
+is out of the per-event path.  Correctness gates: a bit-identical numpy
+twin and cell conservation (every injected cell is delivered exactly once)
+in tests/test_torcells_device.py.
+
+Shapes: C circuits × 5 stages = F flows.  Stage s of circuit c is paced by
+node route[c, s] (route = [server, exit, middle, guard, client]); a cell
+leaving stage s<4 arrives at stage s+1 after latency_ticks[node_s,
+node_{s+1}]; leaving stage 4 means delivered.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core import defs
+from .bandwidth import bucket_params
+
+CELL_WIRE_BYTES = 512 + defs.CONFIG_HEADER_SIZE_TCPIPETH
+
+
+def build_flows(route: np.ndarray,          # int32 [C, 5] node per stage
+                latency_ticks: np.ndarray,  # int64 [H, H]
+                ) -> dict:
+    """Precompute the static flow layout: flows sorted by (paced node,
+    circuit id), segment offsets per node, and each flow's onward hop
+    latency.  Pure numpy; runs once at model build."""
+    c, stages = route.shape
+    flow_circ = np.repeat(np.arange(c, dtype=np.int64), stages)
+    flow_stage = np.tile(np.arange(stages, dtype=np.int64), c)
+    flow_node = route[flow_circ, flow_stage].astype(np.int64)
+    # greedy allocation order: by paced node, then circuit id (a node never
+    # paces two stages of the same circuit: servers/relays/clients occupy
+    # disjoint node ranges and relay picks are distinct).  Onward latencies
+    # are >= 1 tick, so a cell can never traverse two stages in one tick —
+    # matching the engine, where a forwarded cell is a new arrival event.
+    order = np.lexsort((flow_stage, flow_circ, flow_node))
+    flow_circ, flow_stage, flow_node = (flow_circ[order], flow_stage[order],
+                                        flow_node[order])
+    # onward latency: stage s -> s+1 edge; last stage delivers (0)
+    nxt = np.where(flow_stage < stages - 1,
+                   route[flow_circ, np.minimum(flow_stage + 1, stages - 1)],
+                   route[flow_circ, flow_stage])
+    lat = latency_ticks[flow_node, nxt].astype(np.int64)
+    lat = np.where(flow_stage < stages - 1, np.maximum(lat, 1), 0)
+    # successor flow index (same circuit, next stage) in sorted space
+    flat_id = flow_circ * stages + flow_stage
+    pos_of = np.empty(c * stages, dtype=np.int64)
+    pos_of[flat_id] = np.arange(c * stages)
+    succ = np.where(flow_stage < stages - 1,
+                    pos_of[np.minimum(flat_id + 1, c * stages - 1)], -1)
+    # segment start offset of each flow's node group (for the cumsum trick)
+    seg_start_of_flow = np.zeros(c * stages, dtype=np.int64)
+    starts = np.flatnonzero(np.r_[True, flow_node[1:] != flow_node[:-1]])
+    seg_id = np.cumsum(np.r_[0, (flow_node[1:] != flow_node[:-1])
+                             .astype(np.int64)])
+    seg_start_of_flow = starts[seg_id]
+    return {
+        "flow_circ": flow_circ, "flow_stage": flow_stage,
+        "flow_node": flow_node, "flow_lat": lat, "flow_succ": succ,
+        "seg_start": seg_start_of_flow,
+    }
+
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("ring_len",))
+def torcells_run(queued0: jnp.ndarray,     # int64 [F] initial cells/flow
+                 flow_node: jnp.ndarray,   # int64 [F] paced node
+                 flow_lat: jnp.ndarray,    # int64 [F] onward latency ticks
+                 flow_succ: jnp.ndarray,   # int64 [F] successor flow or -1
+                 seg_start: jnp.ndarray,   # int64 [F] node-segment start
+                 refill: jnp.ndarray,      # int64 [H] bytes per tick
+                 capacity: jnp.ndarray,    # int64 [H] bucket cap bytes
+                 ring_len: int,            # static: max latency + 1
+                 max_ticks: jnp.ndarray,   # int64 scalar
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Run until every cell is delivered (or max_ticks).  Returns
+    (delivered[F] on last-stage flows, ticks_run, total_forwards)."""
+    f = queued0.shape[0]
+    h = refill.shape[0]
+    size = jnp.int64(CELL_WIRE_BYTES)
+    is_last = flow_succ < 0
+
+    def body(state):
+        t, queued, ring, tokens, delivered, forwards = state
+        # arrivals scheduled for this tick
+        arr = ring[jnp.mod(t, ring_len)]
+        ring = ring.at[jnp.mod(t, ring_len)].set(jnp.zeros(f, jnp.int64))
+        queued = queued + arr
+        # refill buckets
+        tokens = jnp.minimum(capacity, tokens + refill)
+        cap_cells = tokens[flow_node] // size
+        # greedy allocation in static flow order within each node segment:
+        # served = clip(capacity_at_segment - cells_before_me, 0, queued)
+        csum = jnp.cumsum(queued)
+        before = csum - queued - jnp.where(
+            seg_start > 0, csum[jnp.maximum(seg_start - 1, 0)],
+            jnp.int64(0)) * (seg_start > 0)
+        served = jnp.clip(cap_cells - before, 0, queued)
+        queued = queued - served
+        spent = jax.ops.segment_sum(served * size, flow_node,
+                                    num_segments=h)
+        tokens = tokens - spent
+        # departures: last stage delivers, others arrive at successor after
+        # their edge latency
+        delivered = delivered + jnp.where(is_last, served, 0)
+        slot = jnp.mod(t + flow_lat, ring_len)
+        fwd = jnp.where(is_last, jnp.int64(0), served)
+        ring = ring.at[slot, jnp.maximum(flow_succ, 0)].add(fwd)
+        forwards = forwards + jnp.sum(served)
+        return t + 1, queued, ring, tokens, delivered, forwards
+
+    total = jnp.sum(queued0)
+
+    def cond(state):
+        t, _queued, _ring, _tok, delivered, _f = state
+        # delivered-vs-total instead of summing the [L, F] ring each tick
+        return (jnp.sum(delivered) < total) & (t < max_ticks)
+
+    ring0 = jnp.zeros((ring_len, f), dtype=jnp.int64)
+    state = (jnp.int64(0), queued0, ring0, capacity.astype(jnp.int64),
+             jnp.zeros(f, dtype=jnp.int64), jnp.int64(0))
+    t, _q, _r, _tok, delivered, forwards = jax.lax.while_loop(
+        cond, body, state)
+    return delivered, t, forwards
+
+
+def torcells_run_numpy(queued0, flow_node, flow_lat, flow_succ, seg_start,
+                       refill, capacity, ring_len: int, max_ticks: int):
+    """Bit-identical host twin (same allocation rule, same ring)."""
+    f = len(queued0)
+    h = len(refill)
+    size = CELL_WIRE_BYTES
+    is_last = flow_succ < 0
+    queued = queued0.astype(np.int64).copy()
+    ring = np.zeros((ring_len, f), dtype=np.int64)
+    tokens = capacity.astype(np.int64).copy()
+    delivered = np.zeros(f, dtype=np.int64)
+    forwards = 0
+    t = 0
+    total = int(queued0.sum())
+    while delivered.sum() < total and t < max_ticks:
+        arr = ring[t % ring_len].copy()
+        ring[t % ring_len] = 0
+        queued += arr
+        tokens = np.minimum(capacity, tokens + refill)
+        cap_cells = tokens[flow_node] // size
+        csum = np.cumsum(queued)
+        seg_base = np.where(seg_start > 0, csum[np.maximum(seg_start - 1, 0)],
+                            0) * (seg_start > 0)
+        before = csum - queued - seg_base
+        served = np.clip(cap_cells - before, 0, queued)
+        queued -= served
+        spent = np.bincount(flow_node, weights=served * size,
+                            minlength=h).astype(np.int64)
+        tokens -= spent
+        delivered += np.where(is_last, served, 0)
+        slot = (t + flow_lat) % ring_len
+        fwd = np.where(is_last, 0, served)
+        np.add.at(ring, (slot, np.maximum(flow_succ, 0)), fwd)
+        forwards += int(served.sum())
+        t += 1
+    return delivered, t, forwards
+
+
+class DeviceTorCells:
+    """Build a circuits-over-relays instance and run it device-resident."""
+
+    def __init__(self, n_relays: int, n_circuits: int, seed: int = 7,
+                 relay_bw_kibps: int = 2048, edge_bw_kibps: int = 1 << 20,
+                 max_latency_ms: int = 120):
+        rng = np.random.default_rng(seed)
+        # nodes: [clients | relays | servers] — clients/servers effectively
+        # unthrottled, relays are the contended resource
+        n_clients = n_circuits
+        n_servers = max(1, n_circuits // 50)
+        h = n_clients + n_relays + n_servers
+        lat = rng.integers(2, max_latency_ms, size=(h, h)).astype(np.int64)
+        np.fill_diagonal(lat, 1)
+        bw = np.full(h, edge_bw_kibps, dtype=np.int64)
+        bw[n_clients:n_clients + n_relays] = relay_bw_kibps
+        refill, cap = bucket_params(bw)
+        self.refill = refill.astype(np.int64)
+        self.capacity = cap.astype(np.int64)
+        # routes: distinct guard/middle/exit per circuit
+        route = np.empty((n_circuits, 5), dtype=np.int64)
+        route[:, 4] = np.arange(n_circuits)                       # client
+        route[:, 0] = n_clients + n_relays + rng.integers(
+            0, n_servers, size=n_circuits)                        # server
+        picks = rng.random((n_circuits, n_relays)).argsort(axis=1)[:, :3]
+        route[:, 1:4] = n_clients + picks                         # e, m, g
+        self.flows = build_flows(route, lat)
+        self.ring_len = int(max_latency_ms) + 2
+        self.n_flows = n_circuits * 5
+        self.route = route
+
+    def _args(self, cells_per_circuit: int):
+        fl = self.flows
+        queued0 = np.where(fl["flow_stage"] == 0, cells_per_circuit, 0) \
+            .astype(np.int64)
+        return queued0, fl
+
+    def run_device(self, cells_per_circuit: int, max_ticks: int):
+        queued0, fl = self._args(cells_per_circuit)
+        out = torcells_run(jnp.asarray(queued0),
+                           jnp.asarray(fl["flow_node"]),
+                           jnp.asarray(fl["flow_lat"]),
+                           jnp.asarray(fl["flow_succ"]),
+                           jnp.asarray(fl["seg_start"]),
+                           jnp.asarray(self.refill),
+                           jnp.asarray(self.capacity),
+                           self.ring_len, jnp.int64(max_ticks))
+        jax.block_until_ready(out)
+        delivered, ticks, forwards = (np.asarray(o) for o in out)
+        return delivered, int(ticks), int(forwards)
+
+    def run_numpy(self, cells_per_circuit: int, max_ticks: int):
+        queued0, fl = self._args(cells_per_circuit)
+        d, t, fw = torcells_run_numpy(queued0, fl["flow_node"],
+                                      fl["flow_lat"], fl["flow_succ"],
+                                      fl["seg_start"], self.refill,
+                                      self.capacity, self.ring_len,
+                                      max_ticks)
+        return d, t, fw

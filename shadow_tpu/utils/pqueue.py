@@ -46,11 +46,12 @@ class PriorityQueue(Generic[T]):
             # never has two live entries (the membership hash the
             # reference's priority_queue.c maintains for the same reason).
             # A live entry in ANOTHER queue would mean the one-queue-at-a-
-            # time invariant broke upstream — keep that queue's _len honest
-            # by decrementing the owner, not self.
+            # time invariant broke upstream; mutating that queue from here
+            # would race its lock, so fail loudly instead.
+            assert old[4] is self, "item is live in another queue"
             old[3] = False
             old[2] = None
-            old[4]._len -= 1
+            self._len -= 1
         entry = [key, self._count, item, True, self]
         self._count += 1
         item.pq_entry = entry
