@@ -67,7 +67,14 @@ def recv_cell(api, fd):
 def main(api, args):
     role = args[0] if args else "relay"
     if role == "relay":
-        yield from relay_main(api, int(args[1]) if len(args) > 1 else 9001)
+        # relay <orport> [<dirauth_host:port> <bw_weight>]: with a dirauth,
+        # the relay publishes its descriptor after opening the ORPort (real
+        # Tor also listens before uploading its descriptor, so the
+        # consensus never advertises a closed port)
+        orport = int(args[1]) if len(args) > 1 else 9001
+        dirspec = args[2] if len(args) > 2 else None
+        bw = int(args[3]) if len(args) > 3 else 100
+        yield from relay_main(api, orport, dirspec, bw)
         return 0
     if role == "server":
         yield from server_main(api, int(args[1]) if len(args) > 1 else 80)
@@ -75,7 +82,109 @@ def main(api, args):
     if role == "client":
         ok = yield from client_main(api, args[1:])
         return 0 if ok else 1
+    if role == "dirauth":
+        yield from dirauth_main(api, int(args[1]) if len(args) > 1 else 9030)
+        return 0
     raise ValueError(f"tor: unknown role {role!r}")
+
+
+# ---------------------------------------------------------------------------
+# directory authority (v3 dirauth network behavior: relays upload
+# descriptors, clients fetch the consensus and weight their path selection
+# by advertised bandwidth — the bootstrap phase real Tor networks start
+# with; the crypto/voting among authorities is out of model scope)
+# ---------------------------------------------------------------------------
+
+def publish_descriptor(api, dirspec, orport, bw_weight):
+    host, _, port = dirspec.partition(":")
+    fd = api.socket("tcp")
+    yield from api.connect(fd, (host, int(port or 9030)))
+    line = f"r {api.host.name} {orport} {bw_weight}\n".encode()
+    yield from api.send(fd, line)
+    api.close(fd)
+
+
+def dirauth_main(api, port):
+    relays = {}          # name -> (orport, bw)
+    api.process.app_state = relays
+    lfd = api.socket("tcp")
+    api.bind(lfd, ("0.0.0.0", port))
+    api.listen(lfd, 64)
+    api.log(f"tor dirauth on :{port}")
+    while True:
+        cfd, _ = yield from api.accept(lfd)
+        api.spawn(_dirauth_conn, api, relays, cfd)
+
+
+def _dirauth_conn(api, relays, fd):
+    buf = b""
+    while b"\n" not in buf:
+        data = yield from api.recv(fd, 4096)
+        if not data:
+            api.close(fd)
+            return
+        buf += data
+    line = buf.split(b"\n", 1)[0].decode()
+    if line.startswith("r "):
+        _, name, orport, bw = line.split()
+        relays[name] = (int(orport), int(bw))
+    elif line.startswith("GETCONS"):
+        # deterministic consensus: sorted by relay name
+        doc = "".join(f"r {n} {p} {w}\n"
+                      for n, (p, w) in sorted(relays.items()))
+        yield from api.send(fd, doc.encode() + b".\n")
+    api.close(fd)
+
+
+def fetch_consensus(api, dirspec):
+    """Client-side bootstrap: fetch and parse the consensus."""
+    host, _, port = dirspec.partition(":")
+    fd = api.socket("tcp")
+    yield from api.connect(fd, (host, int(port or 9030)))
+    yield from api.send(fd, b"GETCONS\n")
+    buf = b""
+    complete = False
+    while True:
+        if buf.endswith(b".\n"):
+            complete = True
+            break
+        data = yield from api.recv(fd, 65536)
+        if not data:
+            break
+        buf += data
+    api.close(fd)
+    if not complete:
+        # truncated document (authority died mid-send): fail the bootstrap
+        # loudly rather than route over a silently partial consensus
+        return []
+    relays = []
+    for line in buf.decode().splitlines():
+        parts = line.split()
+        if len(parts) == 4 and parts[0] == "r":
+            relays.append((parts[1], int(parts[2]), int(parts[3])))
+    return relays
+
+
+def pick_path(api, relays, n_hops=3):
+    """Bandwidth-weighted path selection without replacement, drawn from
+    the HOST's deterministic RNG (per-host stream: identical across
+    scheduler policies, so digests stay parity-comparable)."""
+    pool = list(relays)
+    path = []
+    for _ in range(min(n_hops, len(pool))):
+        total = sum(w for _n, _p, w in pool)
+        draw = api.host.random.next_int(max(total, 1))
+        acc = 0
+        for i, (name, orport, w) in enumerate(pool):
+            acc += w
+            if draw < acc:
+                path.append((name, orport))
+                pool.pop(i)
+                break
+        else:
+            path.append(pool[-1][:2])
+            pool.pop()
+    return path
 
 
 # ---------------------------------------------------------------------------
@@ -95,13 +204,15 @@ class _RelayState:
         self.cells_relayed = 0
 
 
-def relay_main(api, orport):
+def relay_main(api, orport, dirspec=None, bw_weight=100):
     st = _RelayState()
     api.process.app_state = st
     lfd = api.socket("tcp")
     api.bind(lfd, ("0.0.0.0", orport))
     api.listen(lfd, 64)
     api.log(f"tor relay on :{orport}")
+    if dirspec:
+        yield from publish_descriptor(api, dirspec, orport, bw_weight)
     while True:
         cfd, _ = yield from api.accept(lfd)
         api.spawn(_relay_conn, api, st, cfd)
@@ -257,9 +368,22 @@ class _ClientStats:
 def client_main(api, args):
     # args: <socksport> <path> <dest> <destport> <nstreams> <spec...>
     # path entries are "relayhost" or "relayhost:orport" (default 9001,
-    # matching the relay role's default)
-    path = [(h.partition(":")[0], int(h.partition(":")[2] or 9001))
-            for h in args[1].split(",")]
+    # matching the relay role's default), OR "auto:<dirhost>:<dirport>" to
+    # bootstrap like real Tor: fetch the consensus from the directory
+    # authority and pick a bandwidth-weighted 3-hop path
+    if args[1].startswith("auto:"):
+        # "auto:<dirhost>" or "auto:<dirhost>:<dirport>" (default 9030,
+        # same optional-port convention as relay specs)
+        consensus = yield from fetch_consensus(api, args[1][len("auto:"):])
+        if not consensus:
+            api.log("tor client: empty consensus")
+            return False
+        path = pick_path(api, consensus)
+        api.log(f"tor client: consensus has {len(consensus)} relays, "
+                f"picked {'->'.join(h for h, _ in path)}")
+    else:
+        path = [(h.partition(":")[0], int(h.partition(":")[2] or 9001))
+                for h in args[1].split(",")]
     dest, destport = args[2], int(args[3])
     nstreams = int(args[4]) if len(args) > 4 else 1
     specs = args[5:] if len(args) > 5 else ["100:10000"]

@@ -61,11 +61,17 @@ def star_bulk(n_clients: int = 100, stoptime: int = 600,
 def tor_network(n_relays: int = 1000, n_clients: Optional[int] = None,
                 n_servers: Optional[int] = None, stoptime: int = 600,
                 streams_per_client: int = 3, stream_spec: str = "512:51200",
-                topology_path: Optional[str] = None, seed: int = 42) -> str:
+                topology_path: Optional[str] = None, seed: int = 42,
+                dirauth: bool = False) -> str:
     """Tor overlay: relays + clients with random 3-hop paths + destinations.
 
     Mirrors the shape of the reference's Tor experiments (shadow-plugin-tor
-    topologies: ~10% exits/guards, ~1 client per relay, few fat servers)."""
+    topologies: ~10% exits/guards, ~1 client per relay, few fat servers).
+
+    ``dirauth=True`` adds the directory bootstrap phase: a directory
+    authority host, relays publishing bandwidth-weighted descriptors, and
+    clients fetching the consensus and picking their own weighted paths
+    (instead of config-assigned ones) — real Tor's startup behavior."""
     rng = np.random.default_rng(seed)
     n_clients = n_clients if n_clients is not None else max(1, n_relays)
     n_servers = n_servers if n_servers is not None else max(1, n_relays // 20)
@@ -73,10 +79,20 @@ def tor_network(n_relays: int = 1000, n_clients: Optional[int] = None,
     if topology_path:
         lines.append(f'  <topology path="{topology_path}" />')
     lines.append('  <plugin id="tor" path="python:tor" />')
+    if dirauth:
+        lines.append(
+            '  <host id="dirauth" bandwidthdown="1048576" bandwidthup="1048576">\n'
+            '    <process plugin="tor" starttime="1" arguments="dirauth 9030" />\n'
+            '  </host>')
     for i in range(n_relays):
+        relay_args, relay_start = "relay 9001", 1
+        if dirauth:
+            bw = int(rng.integers(50, 1000))
+            relay_args, relay_start = f"relay 9001 dirauth:9030 {bw}", 2
         lines.append(
             f'  <host id="relay{i}" bandwidthdown="102400" bandwidthup="102400">\n'
-            f'    <process plugin="tor" starttime="1" arguments="relay 9001" />\n'
+            f'    <process plugin="tor" starttime="{relay_start}" '
+            f'arguments="{relay_args}" />\n'
             '  </host>')
     for i in range(n_servers):
         lines.append(
@@ -84,8 +100,11 @@ def tor_network(n_relays: int = 1000, n_clients: Optional[int] = None,
             f'    <process plugin="tor" starttime="1" arguments="server 80" />\n'
             '  </host>')
     for i in range(n_clients):
-        path = rng.choice(n_relays, size=min(3, n_relays), replace=False)
-        path_s = ",".join(f"relay{int(r)}" for r in path)
+        if dirauth:
+            path_s = "auto:dirauth:9030"
+        else:
+            path = rng.choice(n_relays, size=min(3, n_relays), replace=False)
+            path_s = ",".join(f"relay{int(r)}" for r in path)
         dest = int(rng.integers(0, n_servers))
         start = 5 + int(rng.integers(0, 30))
         lines.append(

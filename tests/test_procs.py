@@ -116,3 +116,15 @@ def test_tpu_policy_shards_match_serial():
 def test_procs_requires_two():
     with pytest.raises(ValueError):
         ProcsController(Options(processes=1), _cfg())
+
+
+def test_cli_dispatch(tmp_path):
+    """The user-facing path: `shadow-tpu config.xml --processes 2` routes
+    through run_simulation to the sharded coordinator and exits 0."""
+    from shadow_tpu.cli import main
+
+    cfg_path = tmp_path / "cfg.xml"
+    cfg_path.write_text(XML)
+    rc = main([str(cfg_path), "--processes", "2", "--stop-time", "30",
+               "--log-level", "warning"])
+    assert rc == 0

@@ -61,6 +61,33 @@ def test_tor_deterministic():
            (rc2, c2.engine.events_executed, c2.engine.rounds_executed)
 
 
+def test_tor_directory_bootstrap():
+    """Real Tor's startup behavior: relays publish bandwidth-weighted
+    descriptors to a directory authority, clients fetch the consensus and
+    pick their own weighted 3-hop paths — and the whole phase is
+    deterministic (digest-equal across runs AND across scheduler
+    policies, because path draws come from per-host RNG streams)."""
+    from shadow_tpu.core.checkpoint import state_digest
+    from shadow_tpu.tools.workloads import tor_network
+
+    xml = tor_network(n_relays=8, n_clients=4, n_servers=1, stoptime=120,
+                      streams_per_client=1, stream_spec="256:8192",
+                      dirauth=True, seed=9)
+    rc, ctrl = run_sim(xml, stop=120)
+    assert rc == 0
+    auth = ctrl.engine.host_by_name("dirauth").processes[0].app_state
+    assert len(auth) == 8, "not every relay published a descriptor"
+    for i in range(4):
+        proc = ctrl.engine.host_by_name(f"torclient{i}").processes[0]
+        assert proc.exit_code == 0, f"torclient{i} failed"
+        assert proc.app_state.streams_ok == 1
+    d1 = state_digest(ctrl.engine)
+    rc2, ctrl2 = run_sim(xml, stop=120, policy="tpu")
+    assert rc2 == 0
+    assert state_digest(ctrl2.engine) == d1, \
+        "directory bootstrap diverged across scheduler policies"
+
+
 BITCOIN_XML = textwrap.dedent("""\
     <shadow stoptime="600">
       <plugin id="btc" path="python:bitcoin" />
