@@ -10,12 +10,19 @@ headline metric for this workload family.
 
 Role:
     node <peer1,peer2,...|-> [mine <interval_sec> <block_bytes> <count>]
+                             [txgen <interval_sec> <tx_bytes> <count>]
         Connects out to the listed peers (``-`` = none; inbound only) on
         port 8333 and serves inbound connections.  With ``mine``, creates
-        <count> blocks every <interval_sec> seconds and announces them.
+        <count> blocks every <interval_sec> seconds and announces them;
+        with ``txgen``, originates <count> transactions the same way into
+        every mempool.  The keywords combine: a node can both mine and
+        originate transactions.
 
 Wire format: length-prefixed messages ``u32 len | u8 type | payload``.
-Types: INV (u64 block id), GETDATA (u64 block id), BLOCK (u64 id + bytes).
+Types: INV (u64 block id), GETDATA (u64 block id), BLOCK (u64 id + bytes),
+and the transaction-relay triple TXINV/GETTX/TX — the tx gossip that
+dominates message counts on the real network (every node relays every
+transaction into its peers' mempools the same epidemic way blocks travel).
 
 ``process.app_state`` exposes per-node stats (blocks known, bytes relayed,
 per-block first-seen virtual time) for tests and benchmark reporting.
@@ -32,6 +39,9 @@ MSG_HDR = struct.Struct(">IB")
 INV = 1
 GETDATA = 2
 BLOCK = 3
+TXINV = 4
+GETTX = 5
+TX = 6
 
 
 class NodeState:
@@ -43,6 +53,11 @@ class NodeState:
         self.peers = []             # connected peer fds
         self.bytes_relayed = 0
         self.mined = 0
+        # transaction relay (mempool)
+        self.mempool = {}           # tx_id -> size
+        self.tx_requested = set()
+        self.tx_first_seen_ns = {}
+        self.txs_originated = 0
 
 
 def _pack(msg_type: int, payload: bytes) -> bytes:
@@ -68,10 +83,22 @@ def main(api, args):
     api.process.app_state = st
     peers = [] if not args or args[0] in ("-", "") else args[0].split(",")
     mine_every = mine_size = mine_count = 0
-    if len(args) >= 4 and args[1] == "mine":
-        mine_every = float(args[2])
-        mine_size = int(args[3])
-        mine_count = int(args[4]) if len(args) > 4 else 1
+    tx_every = tx_size = tx_count = 0
+    rest = list(args[1:])
+    while rest:
+        kw = rest.pop(0)
+        if kw in ("mine", "txgen"):
+            if len(rest) < 2:
+                raise ValueError(f"bitcoin: {kw} needs <interval> <bytes>")
+            every = float(rest.pop(0))
+            size = int(rest.pop(0))
+            count = int(rest.pop(0)) if rest and rest[0].isdigit() else 1
+            if kw == "mine":
+                mine_every, mine_size, mine_count = every, size, count
+            else:
+                tx_every, tx_size, tx_count = every, size, count
+        else:
+            raise ValueError(f"bitcoin: unknown argument {kw!r}")
 
     lfd = api.socket("tcp")
     api.bind(lfd, ("0.0.0.0", PORT))
@@ -83,6 +110,8 @@ def main(api, args):
 
     if mine_every > 0:
         api.spawn(_miner, api, st, mine_every, mine_size, mine_count)
+    if tx_every > 0:
+        api.spawn(_txgen, api, st, tx_every, tx_size, tx_count)
 
     # the node runs until the simulation stops it
     while True:
@@ -97,10 +126,12 @@ def _accept_loop(api, st, lfd):
 
 
 def _inbound_peer(api, st, fd):
-    # block exchange must be two-way: a late joiner's inbound link is its
-    # only path to blocks mined before the link formed
+    # exchange must be two-way: a late joiner's inbound link is its only
+    # path to blocks/txs known before the link formed
     for block_id in list(st.blocks):
         yield from api.send(fd, _pack(INV, struct.pack(">Q", block_id)))
+    for tx_id in list(st.mempool):
+        yield from api.send(fd, _pack(TXINV, struct.pack(">Q", tx_id)))
     yield from _peer_loop(api, st, fd)
 
 
@@ -122,14 +153,17 @@ def _dial(api, st, peer):
         api.log(f"bitcoin: dial {peer} failed permanently")
         return
     st.peers.append(fd)
-    # announce everything we already know (block exchange on connect)
+    # announce everything we already know (block + tx exchange on connect)
     for block_id in list(st.blocks):
         yield from api.send(fd, _pack(INV, struct.pack(">Q", block_id)))
+    for tx_id in list(st.mempool):
+        yield from api.send(fd, _pack(TXINV, struct.pack(">Q", tx_id)))
     yield from _peer_loop(api, st, fd)
 
 
 def _peer_loop(api, st, fd):
     inflight = set()  # getdata sent on THIS connection, block not yet seen
+    tx_inflight = set()
     while True:
         msg = yield from recv_msg(api, fd)
         if msg is None:
@@ -155,11 +189,35 @@ def _peer_loop(api, st, fd):
             if block_id not in st.blocks:
                 _learn_block(api, st, block_id, len(payload) - 8)
                 yield from _announce(api, st, block_id, exclude=fd)
-    # a dead peer's undelivered getdata must not black-hole those blocks:
-    # clear them so another peer's inv re-triggers the request
+        elif msg_type == TXINV:
+            (tx_id,) = struct.unpack(">Q", payload)
+            if tx_id not in st.mempool and tx_id not in st.tx_requested:
+                st.tx_requested.add(tx_id)
+                tx_inflight.add(tx_id)
+                yield from api.send(fd, _pack(GETTX, payload))
+        elif msg_type == GETTX:
+            (tx_id,) = struct.unpack(">Q", payload)
+            size = st.mempool.get(tx_id)
+            if size is not None:
+                body = struct.pack(">Q", tx_id) + b"\0" * size
+                st.bytes_relayed += len(body)
+                yield from api.send(fd, _pack(TX, body))
+        elif msg_type == TX:
+            (tx_id,) = struct.unpack(">Q", payload[:8])
+            st.tx_requested.discard(tx_id)
+            tx_inflight.discard(tx_id)
+            if tx_id not in st.mempool:
+                st.mempool[tx_id] = len(payload) - 8
+                st.tx_first_seen_ns[tx_id] = api.now_ns()
+                yield from _announce_tx(api, st, tx_id, exclude=fd)
+    # a dead peer's undelivered getdata/gettx must not black-hole those
+    # items: clear them so another peer's inv re-triggers the request
     for block_id in inflight:
         if block_id not in st.blocks:
             st.requested.discard(block_id)
+    for tx_id in tx_inflight:
+        if tx_id not in st.mempool:
+            st.tx_requested.discard(tx_id)
     if fd in st.peers:
         st.peers.remove(fd)
     api.close(fd)
@@ -170,15 +228,39 @@ def _learn_block(api, st, block_id, size):
     st.first_seen_ns[block_id] = api.now_ns()
 
 
-def _announce(api, st, block_id, exclude=None):
-    inv = _pack(INV, struct.pack(">Q", block_id))
+def _broadcast(api, st, msg, exclude=None):
+    """Send an announcement to every live peer but the one it came from
+    (send failures mean the peer loop is tearing that fd down)."""
     for peer_fd in list(st.peers):
         if peer_fd == exclude:
             continue
         try:
-            yield from api.send(peer_fd, inv)
+            yield from api.send(peer_fd, msg)
         except OSError:
             pass
+
+
+def _announce(api, st, block_id, exclude=None):
+    yield from _broadcast(api, st, _pack(INV, struct.pack(">Q", block_id)),
+                          exclude)
+
+
+def _announce_tx(api, st, tx_id, exclude=None):
+    yield from _broadcast(api, st, _pack(TXINV, struct.pack(">Q", tx_id)),
+                          exclude)
+
+
+def _txgen(api, st, every_sec, tx_size, count):
+    """Originates transactions with globally-unique ids in a disjoint id
+    space from blocks: (1 << 56) | (host_id << 20) | seq."""
+    host_id = api.host.id
+    for seq in range(count):
+        yield from api.sleep(every_sec)
+        tx_id = (1 << 56) | (host_id << 20) | seq
+        st.mempool[tx_id] = tx_size
+        st.tx_first_seen_ns[tx_id] = api.now_ns()
+        st.txs_originated += 1
+        yield from _announce_tx(api, st, tx_id)
 
 
 def _miner(api, st, every_sec, block_size, count):

@@ -118,6 +118,20 @@ def test_procs_requires_two():
         ProcsController(Options(processes=1), _cfg())
 
 
+def test_shard_failure_surfaces_not_hangs():
+    """A shard that dies during setup (unknown plugin) must surface as a
+    RuntimeError in the parent promptly — not deadlock the barrier
+    protocol or leave orphan children."""
+    bad = XML.replace('path="python:tgen"', 'path="python:nosuchapp"')
+    cfg = configuration.parse_xml(bad)
+    cfg.stop_time_sec = 30
+    ctrl = ProcsController(Options(scheduler_policy="global", workers=0,
+                                   seed=7, stop_time_sec=30, processes=2),
+                           cfg)
+    with pytest.raises(RuntimeError, match="shard failed"):
+        ctrl.run()
+
+
 def test_cli_dispatch(tmp_path):
     """The user-facing path: `shadow-tpu config.xml --processes 2` routes
     through run_simulation to the sharded coordinator and exits 0."""

@@ -120,6 +120,24 @@ def test_bitcoin_gossip_propagation():
         assert n5.first_seen_ns[block_id] > n1.first_seen_ns[block_id]
 
 
+def test_bitcoin_tx_gossip():
+    """Transaction relay (the dominant real-network traffic): txs
+    originated at two leaf nodes reach every mempool through
+    TXINV/GETTX/TX epidemic broadcast, alongside block gossip."""
+    xml = BITCOIN_XML.replace(
+        'arguments="n3,n4"', 'arguments="n3,n4 txgen 7 300 4"').replace(
+        'arguments="n1"', 'arguments="n1 txgen 11 250 3"')
+    rc, ctrl = run_sim(xml, stop=600)
+    assert rc == 0
+    for name in ("miner", "n1", "n2", "n3", "n4", "n5"):
+        st = ctrl.engine.host_by_name(name).processes[0].app_state
+        assert len(st.mempool) == 7, \
+            f"{name} has {len(st.mempool)}/7 txs in its mempool"
+        assert len(st.blocks) == 3          # block gossip still intact
+    n3 = ctrl.engine.host_by_name("n3").processes[0].app_state
+    assert n3.txs_originated == 3
+
+
 def test_bitcoin_no_duplicate_block_downloads():
     """A node with two peers must fetch each block body once (getdata only
     for unseen ids), even though it hears two invs."""
