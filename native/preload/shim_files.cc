@@ -422,6 +422,51 @@ extern "C" int chown(const char *path, uid_t owner, gid_t group) {
   return real_chown(rpath, owner, group);
 }
 
+/* extended attributes (path-based variants only; the fd-based f*xattr
+ * family needs no interposition — fds were namespace-resolved at open) */
+
+extern "C" ssize_t getxattr(const char *path, const char *name, void *value,
+                            size_t size) {
+  REALF(ssize_t, getxattr, const char *, const char *, void *, size_t);
+  RESOLVE(path, 0);
+  return real_getxattr(rpath, name, value, size);
+}
+
+extern "C" ssize_t lgetxattr(const char *path, const char *name, void *value,
+                             size_t size) {
+  REALF(ssize_t, lgetxattr, const char *, const char *, void *, size_t);
+  RESOLVE(path, 0);
+  return real_lgetxattr(rpath, name, value, size);
+}
+
+extern "C" int setxattr(const char *path, const char *name,
+                        const void *value, size_t size, int flags) {
+  REALF(int, setxattr, const char *, const char *, const void *, size_t,
+        int);
+  RESOLVE(path, 0);
+  return real_setxattr(rpath, name, value, size, flags);
+}
+
+extern "C" int lsetxattr(const char *path, const char *name,
+                         const void *value, size_t size, int flags) {
+  REALF(int, lsetxattr, const char *, const char *, const void *, size_t,
+        int);
+  RESOLVE(path, 0);
+  return real_lsetxattr(rpath, name, value, size, flags);
+}
+
+extern "C" ssize_t listxattr(const char *path, char *list, size_t size) {
+  REALF(ssize_t, listxattr, const char *, char *, size_t);
+  RESOLVE(path, 0);
+  return real_listxattr(rpath, list, size);
+}
+
+extern "C" int removexattr(const char *path, const char *name) {
+  REALF(int, removexattr, const char *, const char *);
+  RESOLVE(path, 0);
+  return real_removexattr(rpath, name);
+}
+
 /* On current glibc the __xstat family are versioned COMPAT symbols, so
  * dlsym(RTLD_NEXT) may return NULL; fall back to the plain syscalls the
  * modern wrappers use (the version argument only selects struct layout,

@@ -789,9 +789,38 @@ static int cmd_files(const char *tag) {
   return 0;
 }
 
+/* xattr family through the namespace (ENOTSUP on the backing fs => 99,
+ * callers skip) */
+#include <sys/xattr.h>
+static int cmd_xattr(const char *tag) {
+  char dir[160], file[224], val[64];
+  snprintf(dir, sizeof dir, "/var/tmp/xattrcheck-%s", tag);
+  snprintf(file, sizeof file, "%s/f", dir);
+  mkdir("/var", 0755);
+  mkdir("/var/tmp", 0755);
+  if (mkdir(dir, 0755) != 0 && errno != EEXIST) return 1;
+  int fd = open(file, O_CREAT | O_WRONLY, 0644);
+  if (fd < 0) return 2;
+  close(fd);
+  if (setxattr(file, "user.shadow", tag, strlen(tag), 0) != 0)
+    return errno == ENOTSUP ? 99 : 3;
+  ssize_t n = getxattr(file, "user.shadow", val, sizeof val);
+  if (n != (ssize_t)strlen(tag) || memcmp(val, tag, (size_t)n) != 0)
+    return 4;
+  char names[256];
+  ssize_t ln = listxattr(file, names, sizeof names);
+  if (ln <= 0 || !memmem(names, (size_t)ln, "user.shadow", 11)) return 5;
+  if (removexattr(file, "user.shadow") != 0) return 6;
+  if (getxattr(file, "user.shadow", val, sizeof val) >= 0) return 7;
+  if (!under_sim()) { unlink(file); rmdir(dir); }
+  printf("xattr OK tag=%s\n", tag);
+  return 0;
+}
+
 int main(int argc, char **argv) {
   if (argc < 2) return 64;
   const char *cmd = argv[1];
+  if (!strcmp(cmd, "xattrcheck") && argc >= 3) return cmd_xattr(argv[2]);
   if (!strcmp(cmd, "files") && argc >= 3) return cmd_files(argv[2]);
   if (!strcmp(cmd, "vtime")) return cmd_vtime();
   if (!strcmp(cmd, "sockmisc")) return cmd_sockmisc();

@@ -461,6 +461,30 @@ def test_native_file_namespace(native_bin, native_so, tmp_path):
                     / f"{other}.dat").exists(), "namespace leaked"
 
 
+def test_native_xattr_namespace(native_bin, tmp_path):
+    """Path-based xattrs resolve through the per-host namespace: an
+    attribute set on /var/... inside the sim lands on the host's vfs file
+    (verified from outside), and the get/list/remove round-trip passes
+    both natively and simulated."""
+    native = subprocess.run([native_bin, "xattrcheck", "native"], timeout=30)
+    if native.returncode == 99:
+        pytest.skip("backing filesystem does not support user xattrs")
+    assert native.returncode == 0
+
+    data = tmp_path / "data"
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="20">
+          <plugin id="app" path="{native_bin}" />
+          <host id="hx"><process plugin="app" starttime="1" arguments="xattrcheck hx" /></host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml, data_directory=data)
+    assert rc == 0
+    assert exit_codes(ctrl, "hx") == {"hx": [0]}
+    assert os.path.exists(vfs_path(data, "hx",
+                                   "/var/tmp/xattrcheck-hx/f"))
+
+
 def test_native_sockmisc(native_bin):
     """setsockopt/getsockopt buffer sizes, EADDRINUSE on double bind,
     getsockname, getpeername-ENOTCONN — dual execution (reference:
