@@ -31,17 +31,35 @@ import os
 import time
 
 # Some environments pin JAX_PLATFORMS to a plugin name (e.g. "axon") that
-# does not register in every process; jax then refuses to start.  Probe in
-# a subprocess: if the pinned platform cannot initialize, fall back to
-# auto-pick (the real TPU when reachable, CPU otherwise).
+# does not register in every process — or whose device tunnel is down, in
+# which case backend init HANGS rather than failing.  Probe in a subprocess
+# with a deadline; on failure or hang, fall back to a pure-CPU bench.  The
+# hang case needs a re-exec: the plugin's sitecustomize registered its
+# backend at interpreter start, and once registered even JAX_PLATFORMS=cpu
+# initializes it — only a fresh interpreter without the trigger env var
+# (PALLAS_AXON_POOL_IPS) escapes it.  A degraded CPU bench beats a crashed
+# one; the JSON records which device actually ran.
 if os.environ.get("JAX_PLATFORMS") not in (None, "", "cpu"):
     import subprocess
     import sys
-    _probe = subprocess.run(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        capture_output=True, timeout=120)
-    if _probe.returncode != 0:
-        os.environ["JAX_PLATFORMS"] = ""
+    try:
+        # DEVNULL, not capture_output: after a timeout SIGKILLs the child,
+        # captured pipes would block on any tunnel-helper grandchild that
+        # inherited them — the exact hang this probe exists to bound
+        _probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            timeout=120)
+        _probe_ok = _probe.returncode == 0
+    except subprocess.TimeoutExpired:
+        _probe_ok = False
+    if not _probe_ok:
+        if os.environ.get("SHADOW_BENCH_REEXEC") != "1":
+            env = dict(os.environ, JAX_PLATFORMS="cpu",
+                       SHADOW_BENCH_REEXEC="1")
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            os.execve(sys.executable, [sys.executable] + sys.argv, env)
+        os.environ["JAX_PLATFORMS"] = "cpu"
 
 import numpy as np
 

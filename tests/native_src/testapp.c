@@ -792,6 +792,16 @@ static int cmd_files(const char *tag) {
 /* xattr family through the namespace (ENOTSUP on the backing fs => 99,
  * callers skip) */
 #include <sys/xattr.h>
+static int xattr_done(const char *file, const char *dir, int rc) {
+  /* single exit path: native runs clean the real fs even on the
+   * ENOTSUP-skip and error returns */
+  if (!under_sim()) {
+    unlink(file);
+    rmdir(dir);
+  }
+  return rc;
+}
+
 static int cmd_xattr(const char *tag) {
   char dir[160], file[224], val[64];
   snprintf(dir, sizeof dir, "/var/tmp/xattrcheck-%s", tag);
@@ -800,21 +810,22 @@ static int cmd_xattr(const char *tag) {
   mkdir("/var/tmp", 0755);
   if (mkdir(dir, 0755) != 0 && errno != EEXIST) return 1;
   int fd = open(file, O_CREAT | O_WRONLY, 0644);
-  if (fd < 0) return 2;
+  if (fd < 0) return xattr_done(file, dir, 2);
   close(fd);
   if (setxattr(file, "user.shadow", tag, strlen(tag), 0) != 0)
-    return errno == ENOTSUP ? 99 : 3;
+    return xattr_done(file, dir, errno == ENOTSUP ? 99 : 3);
   ssize_t n = getxattr(file, "user.shadow", val, sizeof val);
   if (n != (ssize_t)strlen(tag) || memcmp(val, tag, (size_t)n) != 0)
-    return 4;
+    return xattr_done(file, dir, 4);
   char names[256];
   ssize_t ln = listxattr(file, names, sizeof names);
-  if (ln <= 0 || !memmem(names, (size_t)ln, "user.shadow", 11)) return 5;
-  if (removexattr(file, "user.shadow") != 0) return 6;
-  if (getxattr(file, "user.shadow", val, sizeof val) >= 0) return 7;
-  if (!under_sim()) { unlink(file); rmdir(dir); }
+  if (ln <= 0 || !memmem(names, (size_t)ln, "user.shadow", 11))
+    return xattr_done(file, dir, 5);
+  if (removexattr(file, "user.shadow") != 0) return xattr_done(file, dir, 6);
+  if (getxattr(file, "user.shadow", val, sizeof val) >= 0)
+    return xattr_done(file, dir, 7);
   printf("xattr OK tag=%s\n", tag);
-  return 0;
+  return xattr_done(file, dir, 0);
 }
 
 int main(int argc, char **argv) {

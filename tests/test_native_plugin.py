@@ -480,7 +480,12 @@ def test_native_xattr_namespace(native_bin, tmp_path):
     """)
     rc, ctrl = run_sim(xml, data_directory=data)
     assert rc == 0
-    assert exit_codes(ctrl, "hx") == {"hx": [0]}
+    codes = exit_codes(ctrl, "hx")
+    if codes == {"hx": [99]}:
+        # the vfs tree lives under tmp_path, whose fs (often tmpfs) may
+        # lack user xattrs even when /var/tmp has them
+        pytest.skip("sim data dir's filesystem lacks user xattrs")
+    assert codes == {"hx": [0]}
     assert os.path.exists(vfs_path(data, "hx",
                                    "/var/tmp/xattrcheck-hx/f"))
 
