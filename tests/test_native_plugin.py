@@ -8,6 +8,7 @@ testapp.c implements the scenarios; the LD_PRELOAD shim
 (native/preload/shim.cc) routes its libc calls into the virtual kernel.
 """
 
+import errno
 import os
 import subprocess
 import textwrap
@@ -478,14 +479,21 @@ def test_native_xattr_namespace(native_bin, tmp_path):
           <host id="hx"><process plugin="app" starttime="1" arguments="xattrcheck hx" /></host>
         </shadow>
     """)
+    # probe the DATA DIR's fs capability directly (often tmpfs, which may
+    # lack user xattrs even when /var/tmp has them) — a direct probe, so a
+    # sim regression that spuriously surfaces ENOTSUP still FAILS the test
+    # rather than masquerading as a capability skip
+    probe = tmp_path / "xattr-probe"
+    probe.write_bytes(b"")
+    try:
+        os.setxattr(str(probe), "user.probe", b"1")
+    except OSError as e:
+        if e.errno == errno.ENOTSUP:
+            pytest.skip("sim data dir's filesystem lacks user xattrs")
+        raise
     rc, ctrl = run_sim(xml, data_directory=data)
     assert rc == 0
-    codes = exit_codes(ctrl, "hx")
-    if codes == {"hx": [99]}:
-        # the vfs tree lives under tmp_path, whose fs (often tmpfs) may
-        # lack user xattrs even when /var/tmp has them
-        pytest.skip("sim data dir's filesystem lacks user xattrs")
-    assert codes == {"hx": [0]}
+    assert exit_codes(ctrl, "hx") == {"hx": [0]}
     assert os.path.exists(vfs_path(data, "hx",
                                    "/var/tmp/xattrcheck-hx/f"))
 
