@@ -32,14 +32,20 @@ import time
 
 # Some environments pin JAX_PLATFORMS to a plugin name (e.g. "axon") that
 # does not register in every process — or whose device tunnel is down, in
-# which case backend init HANGS rather than failing.  Probe in a subprocess
-# with a deadline; on failure or hang, fall back to a pure-CPU bench.  The
-# hang case needs a re-exec: the plugin's sitecustomize registered its
-# backend at interpreter start, and once registered even JAX_PLATFORMS=cpu
-# initializes it — only a fresh interpreter without the trigger env var
-# (PALLAS_AXON_POOL_IPS) escapes it.  A degraded CPU bench beats a crashed
-# one; the JSON records which device actually ran.
-if os.environ.get("JAX_PLATFORMS") not in (None, "", "cpu"):
+# which case backend init HANGS rather than failing.  Three cases:
+#   * explicit cpu: scrub registered plugins so a dead tunnel can't hang
+#     a deliberately-cpu bench (shadow_tpu.utils.cpu_only);
+#   * pinned non-cpu, or auto-pick with a plugin trigger present: probe in
+#     a subprocess with a deadline.  A fast failure falls back to
+#     auto-pick (a device registered under another name can still win); a
+#     HANG re-execs into a clean interpreter without the trigger env var
+#     (once registered, even JAX_PLATFORMS=cpu initializes the plugin).
+# A degraded CPU bench beats a crashed one; the JSON records the device.
+_jp = os.environ.get("JAX_PLATFORMS")
+if _jp == "cpu":
+    from shadow_tpu.utils.cpu_only import force_cpu_backend
+    force_cpu_backend()
+elif _jp or os.environ.get("PALLAS_AXON_POOL_IPS"):
     import subprocess
     import sys
     _hang = False
@@ -57,15 +63,11 @@ if os.environ.get("JAX_PLATFORMS") not in (None, "", "cpu"):
         _hang = True
     if not _probe_ok:
         if _hang and os.environ.get("SHADOW_BENCH_REEXEC") != "1":
-            # only the hang case needs the clean-interpreter cpu re-exec;
-            # a fast failure keeps auto-pick so a device registered under
-            # another platform name can still be chosen
             env = dict(os.environ, JAX_PLATFORMS="cpu",
                        SHADOW_BENCH_REEXEC="1")
             env.pop("PALLAS_AXON_POOL_IPS", None)
             os.execve(sys.executable, [sys.executable] + sys.argv, env)
-        os.environ["JAX_PLATFORMS"] = \
-            "cpu" if os.environ.get("SHADOW_BENCH_REEXEC") == "1" else ""
+        os.environ["JAX_PLATFORMS"] = ""
 
 import numpy as np
 

@@ -1,0 +1,33 @@
+"""Force jax onto the CPU backend, immune to dead accelerator plugins.
+
+An accelerator plugin registered by a sitecustomize at interpreter start
+gets INITIALIZED by jax's ``backends()`` even under ``JAX_PLATFORMS=cpu``
+(the registration may also override the platform-list config, e.g. to
+"axon,cpu"); if the plugin's device tunnel is down, that init hangs
+forever.  Callers that are cpu-only BY DESIGN (the test suite, the
+multichip dryrun on a virtual mesh, an explicitly cpu-pinned bench) call
+:func:`force_cpu_backend` before their first jax use.
+
+Single definition on purpose: the workaround touches a private jax attr
+(``_backend_factories``) that can reshape across jax versions — one place
+to fix, three call sites (tests/conftest.py, __graft_entry__.py,
+bench.py).  Best-effort: failures fall through to jax's normal behavior.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_backend() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+        import jax._src.xla_bridge as xb
+
+        for name in [n for n in getattr(xb, "_backend_factories", {})
+                     if n != "cpu"]:
+            xb._backend_factories.pop(name, None)
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass

@@ -13,3 +13,16 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "PALLAS_AXON_POOL_IPS" in os.environ:
+    # an accelerator plugin was registered at interpreter start; a dead
+    # device tunnel would hang the whole suite at the first jax use, so
+    # scrub it (gated on the trigger var: normal dev runs skip the jax
+    # import cost entirely)
+    from shadow_tpu.utils.cpu_only import force_cpu_backend
+
+    force_cpu_backend()
+    # spawned children (parallel/procs.py shards, pool helpers) re-run
+    # sitecustomize; make sure they inherit the cpu pin rather than
+    # re-trigger accelerator registration mid-test
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
