@@ -128,7 +128,7 @@ class Worker:
             # exactly where the local path would (inside schedule_task), then
             # ship the finished hop to the owner shard; it pushes the
             # delivery event with the identical (time, dst, src, seq) tuple.
-            t = self.now + latency
+            t = self.now + max(0, int(latency))  # schedule_task's normalization
             if t >= engine.end_time:
                 return
             src_host = self.active_host

@@ -337,7 +337,9 @@ class ProcsController:
         save_state(state, path, {
             "seed": self.options.seed,
             "scheduler_policy": self.options.scheduler_policy,
-            "workers": self.options.workers,
+            # record the EFFECTIVE worker count: every shard runs with
+            # workers=0 (see _child_options), whatever the user passed.
+            "workers": 0,
             "stop_time_sec": self.options.stop_time_sec,
             "processes": self.n_shards,
         })

@@ -47,8 +47,11 @@ class PriorityQueue(Generic[T]):
             # reference's priority_queue.c maintains for the same reason).
             # A live entry in ANOTHER queue would mean the one-queue-at-a-
             # time invariant broke upstream; mutating that queue from here
-            # would race its lock, so fail loudly instead.
-            assert old[4] is self, "item is live in another queue"
+            # would race its lock, so fail loudly instead.  Unconditional
+            # (not assert): under python -O a silent violation would corrupt
+            # the other queue's _len and skew state digests.
+            if old[4] is not self:
+                raise RuntimeError("item is live in another queue")
             old[3] = False
             old[2] = None
             self._len -= 1
@@ -90,8 +93,15 @@ class PriorityQueue(Generic[T]):
             entry = heapq.heappop(heap)
             if entry[3]:
                 entry[3] = False
+                item = entry[2]
+                # Clear both directions of the entry<->item link: the engine
+                # runs with cyclic GC disabled, and a dead [.., item, ..] cell
+                # still referenced by item.pq_entry is an uncollectable cycle
+                # that would pin every executed Event until shutdown.
+                entry[2] = None
+                item.pq_entry = None
                 self._len -= 1
-                return entry[2]
+                return item
         return None
 
     def pop_before(self, time_limit) -> Optional[T]:
@@ -107,8 +117,11 @@ class PriorityQueue(Generic[T]):
                 return None
             heapq.heappop(heap)
             entry[3] = False
+            item = entry[2]
+            entry[2] = None          # break the cycle (see pop())
+            item.pq_entry = None
             self._len -= 1
-            return entry[2]
+            return item
         return None
 
 

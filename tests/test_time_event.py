@@ -49,6 +49,40 @@ def test_pqueue_orders_events():
     assert q.pop() is None
 
 
+def test_pqueue_pop_breaks_refcycle():
+    # The engine runs with cyclic GC disabled: a popped item must be
+    # collectable by refcount alone, i.e. pop()/pop_before() must clear the
+    # entry->item and item->entry links (ADVICE r3 high finding).
+    import gc
+    import weakref
+
+    class Item:
+        __slots__ = ("pq_entry", "__weakref__")
+
+        def __init__(self):
+            self.pq_entry = None
+
+    q = PriorityQueue()
+    refs = []
+    for t in (1, 2):
+        e = Item()
+        refs.append(weakref.ref(e))
+        q.push(e, key=(t, 0, 0, 0))
+    del e
+    gc.disable()
+    try:
+        a = q.pop()
+        assert a.pq_entry is None
+        del a
+        assert refs[0]() is None, "popped event still referenced (ref cycle)"
+        b = q.pop_before(10)
+        assert b.pq_entry is None
+        del b
+        assert refs[1]() is None, "pop_before event still referenced"
+    finally:
+        gc.enable()
+
+
 def test_pqueue_remove():
     q = PriorityQueue()
     a, b = mk(1, 0, 0, 0), mk(2, 0, 0, 0)
