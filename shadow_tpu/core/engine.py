@@ -271,16 +271,26 @@ class Engine:
         return 1 if self.plugin_errors else 0
 
     def _flush_round(self) -> None:
-        """Round-boundary hook for batching policies (tpu): run the device
-        step for the packets sent this round and push their delivery events
-        before the next window is computed."""
+        """Round-boundary hook for batching policies (tpu): LAUNCH the device
+        step for the packets sent this round.  In async mode the results are
+        materialized by _consume_flush at the top of the next loop iteration
+        (always before the next window is computed), so the device computes
+        through the logger flush / heartbeat / window bookkeeping."""
         flush = getattr(self.scheduler.policy, "flush_round", None)
         if flush is not None:
             flush(self)
         if self._checkpointer is not None:
+            # snapshots must include every in-flight delivery: consume first
+            self._consume_flush()
             path = self._checkpointer.maybe_write(self)
             if path:
                 get_logger().message("engine", f"checkpoint written: {path}")
+
+    def _consume_flush(self) -> None:
+        """Materialize + push any async flush results (no-op otherwise)."""
+        consume = getattr(self.scheduler.policy, "consume_flush", None)
+        if consume is not None:
+            consume(self)
 
     def _advance_window(self, lookahead: int) -> bool:
         nxt = self.scheduler.next_event_time()
@@ -324,7 +334,12 @@ class Engine:
         set_current_worker(worker)
         perf = _walltime.perf_counter_ns
         try:
-            while self._advance_window(lookahead):
+            while True:
+                tc = perf()
+                self._consume_flush()
+                self.flush_ns += perf() - tc
+                if not self._advance_window(lookahead):
+                    break
                 worker.round_end = self.scheduler.window_end
                 t0 = perf()
                 worker.run_round()
@@ -371,7 +386,12 @@ class Engine:
             t.start()
         perf = _walltime.perf_counter_ns
         try:
-            while self._advance_window(lookahead):
+            while True:
+                tc = perf()
+                self._consume_flush()
+                self.flush_ns += perf() - tc
+                if not self._advance_window(lookahead):
+                    break
                 t0 = perf()
                 start_latch.count_down_await()
                 start_latch.reset()

@@ -71,6 +71,8 @@ class Options:
     tpu_max_inflight: int = 1 << 16      # padded packet-batch capacity
     tpu_devices: int = 0                 # 0 = all local devices
     tpu_shard_matrix: bool = False       # row-shard path matrices over the mesh
+    tpu_device_threshold: int = 0        # >0: batches below N bypass to numpy
+    tpu_chunk: int = 0                   # mid-round async launch size (0=off)
     # Checkpointing (new capability; absent in the reference — SURVEY.md §5)
     checkpoint_interval_sec: int = 0     # --checkpoint-interval (0 = off)
     checkpoint_dir: str = "shadow-checkpoints"  # --checkpoint-dir
@@ -129,6 +131,16 @@ def build_parser() -> argparse.ArgumentParser:
                    dest="tpu_shard_matrix",
                    help="row-shard the path matrices across the device mesh "
                         "(for graphs whose tensors exceed one chip's HBM)")
+    p.add_argument("--tpu-device-threshold", type=int, default=0,
+                   dest="tpu_device_threshold",
+                   help="route round batches smaller than N to the "
+                        "bit-identical numpy path instead of the device "
+                        "(0 = always dispatch to the device)")
+    p.add_argument("--tpu-chunk", type=int, default=0, dest="tpu_chunk",
+                   help="launch a device step as soon as N packet hops "
+                        "accumulate mid-round, overlapping device compute "
+                        "with the rest of the round (0 = launch at the "
+                        "barrier only)")
     p.add_argument("--test", action="store_true", dest="test_mode",
                    help="run the built-in example simulation")
     return p

@@ -94,17 +94,55 @@ def _finish_hop(lat, rel, uid_lo, uid_hi, send_times, valid,
     return deliver, keep
 
 
+@jax.jit
+def packet_hop_step_packed(latency_ns: jnp.ndarray,   # int64 [A, A]
+                           reliability: jnp.ndarray,  # f32   [A, A]
+                           packed: jnp.ndarray,       # int64 [1+B, 3]
+                           key_lo: jnp.ndarray, key_hi: jnp.ndarray,
+                           bootstrap_end: jnp.ndarray,
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Packed-layout hop step: ONE host->device array per flush instead of
+    six, and zero per-call scalar uploads.  Row 0 is a header: (valid row
+    count n, round barrier ns, 0).  Data row layout: word0 = (src_row << 32)
+    | dst_row, word1 = the packet uid (uint64 bit pattern), word2 = send
+    time ns.  The validity mask is derived on-device (iota < n), so padding
+    costs no transfer; outputs stay PADDED — callers slice host-side after
+    materializing, because a device-side [:n] slice would be a second
+    dispatched op per flush (measured ~140us each on the CPU backend).
+    Same math as packet_hop_step via _finish_hop — bit-identical decisions."""
+    n = packed[0, 0].astype(jnp.int32)
+    barrier = packed[0, 1]
+    w0 = packed[1:, 0]
+    uid = packed[1:, 1]
+    send_times = packed[1:, 2]
+    src = (w0 >> jnp.int64(32)).astype(jnp.int32)
+    dst = (w0 & jnp.int64(0xFFFFFFFF)).astype(jnp.int32)
+    # arithmetic >> then mask == logical shift for the uint64 bit pattern
+    uid_lo = (uid & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    uid_hi = ((uid >> jnp.int64(32)) & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+    valid = jnp.arange(w0.shape[0], dtype=jnp.int32) < n
+    lat = latency_ns[src, dst]
+    rel = reliability[src, dst]
+    return _finish_hop(lat, rel, uid_lo, uid_hi, send_times, valid,
+                       key_lo, key_hi, bootstrap_end, barrier)
+
+
 class PacketHopKernel:
     """Host-side wrapper owning the device-resident topology tensors and the
     drop key; turns a round's (src_row, dst_row, uid, send_time) arrays into
     (deliver_time, keep) numpy arrays with one device call."""
 
-    # Below this batch size the per-call dispatch + host<->device transfer
-    # costs more than the hop math itself; the kernel then computes the
-    # round with the bitwise-identical vectorized numpy path instead
-    # (uniform_np and the jnp threefry are the same cipher — asserted by
-    # tests/test_rng.py — so results are indistinguishable).
-    DEVICE_THRESHOLD = 4096
+    # >0: batches below this size are computed with the bitwise-identical
+    # vectorized numpy path instead of a device call (uniform_np and the jnp
+    # threefry are the same cipher — asserted by tests/test_rng.py — so
+    # results are indistinguishable).  The default dropped 4096 -> 0 in r4:
+    # the packed header-row upload (no per-call scalars), unsliced padded
+    # outputs, and the asynchronous launch/consume split cut the measured
+    # per-dispatch tax to one ~30us jit call (CPU backend), at which point
+    # always-device measured FASTER than any bypass mix on tor200 (5.57s vs
+    # 5.69-5.75s).  ``--tpu-device-threshold N`` restores a bypass for
+    # environments with pathological dispatch round trips (remote tunnels).
+    DEVICE_THRESHOLD = 0
 
     def __init__(self, topology, drop_key: int, bootstrap_end_ns: int,
                  device_threshold: Optional[int] = None):
@@ -166,9 +204,30 @@ class PacketHopKernel:
                 pad(np.asarray(send_times, dtype=np.int64)),
                 valid)
 
-    def step(self, src_rows: np.ndarray, dst_rows: np.ndarray,
-             uids: np.ndarray, send_times: np.ndarray,
-             barrier_ns: int) -> Tuple[np.ndarray, np.ndarray]:
+    def _pack(self, src_rows, dst_rows, uids, send_times, b: int,
+              barrier_ns: int) -> np.ndarray:
+        """Assemble the [1+b, 3] int64 packed batch (header row 0 carries
+        n and the barrier — see packet_hop_step_packed's layout)."""
+        n = len(src_rows)
+        packed = np.zeros((1 + b, 3), dtype=np.int64)
+        packed[0, 0] = n
+        packed[0, 1] = barrier_ns
+        packed[1:n + 1, 0] = ((np.asarray(src_rows, dtype=np.int64) << 32)
+                              | np.asarray(dst_rows, dtype=np.int64))
+        packed[1:n + 1, 1] = np.asarray(uids, dtype=np.uint64).view(np.int64)
+        packed[1:n + 1, 2] = np.asarray(send_times, dtype=np.int64)
+        return packed
+
+    def launch(self, src_rows: np.ndarray, dst_rows: np.ndarray,
+               uids: np.ndarray, send_times: np.ndarray,
+               barrier_ns: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Dispatch one chunk WITHOUT materializing the result: returns
+        (deliver, keep) that may be unfinished PADDED device arrays (length
+        >= N; callers slice to their row count after np.asarray).  The
+        caller converts with np.asarray when it actually needs the values
+        (the engine does so at the next round boundary), so device compute
+        overlaps host-side work.  The numpy bypass path (DEVICE_THRESHOLD)
+        returns finished exact-length host arrays with the same interface."""
         n = len(src_rows)
         if n == 0:
             return (np.empty(0, dtype=np.int64), np.empty(0, dtype=bool))
@@ -178,14 +237,22 @@ class PacketHopKernel:
                                     barrier_ns)
         b = bucket_size(n)
         self.buckets_seen.add(b)
-        batch = self._padded_batch(src_rows, dst_rows, uids, send_times, b)
-        deliver, keep = packet_hop_step(
-            self.latency, self.reliability,
-            *(jnp.asarray(a) for a in batch),
-            self.key_lo, self.key_hi, self.bootstrap_end,
-            jnp.int64(barrier_ns))
+        packed = self._pack(src_rows, dst_rows, uids, send_times, b,
+                            barrier_ns)
+        deliver, keep = packet_hop_step_packed(
+            self.latency, self.reliability, packed,
+            self.key_lo, self.key_hi, self.bootstrap_end)
         self.device_calls += 1
-        return (np.asarray(deliver)[:n], np.asarray(keep)[:n])
+        return deliver, keep
+
+    def step(self, src_rows: np.ndarray, dst_rows: np.ndarray,
+             uids: np.ndarray, send_times: np.ndarray,
+             barrier_ns: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Synchronous variant of launch (materialized, exact-length)."""
+        n = len(src_rows)
+        deliver, keep = self.launch(src_rows, dst_rows, uids, send_times,
+                                    barrier_ns)
+        return np.asarray(deliver)[:n], np.asarray(keep)[:n]
 
 
 # ---------------------------------------------------------------------------
@@ -375,10 +442,28 @@ class ShardedPacketHopKernel(PacketHopKernel):
             self._step = _make_batch_sharded_2out(self.mesh, "pkt")
             self._batch_placement = self._batch_sharding
 
+    def launch(self, src_rows, dst_rows, uids, send_times, barrier_ns):
+        # the mesh layouts keep their explicit-sharding step; deliveries are
+        # still returned unmaterialized (jax arrays), so consume-side overlap
+        # applies here too
+        return self.step_sharded(src_rows, dst_rows, uids, send_times,
+                                 barrier_ns)
+
     def step(self, src_rows, dst_rows, uids, send_times, barrier_ns):
+        deliver, keep = self.step_sharded(src_rows, dst_rows, uids,
+                                          send_times, barrier_ns)
+        return np.asarray(deliver), np.asarray(keep)
+
+    def step_sharded(self, src_rows, dst_rows, uids, send_times, barrier_ns):
         n = len(src_rows)
         if n == 0:
             return (np.empty(0, dtype=np.int64), np.empty(0, dtype=bool))
+        if n < self.DEVICE_THRESHOLD:
+            # same numpy bypass contract as the single-device kernel
+            # (--tpu-device-threshold applies to every layout)
+            return self._step_numpy(np.asarray(src_rows), np.asarray(dst_rows),
+                                    np.asarray(uids), np.asarray(send_times),
+                                    barrier_ns)
         # bucket must also be divisible by the mesh axis
         b = max(bucket_size(n), self.n_devices * MIN_BUCKET)
         if b % self.n_devices:
@@ -392,7 +477,7 @@ class ShardedPacketHopKernel(PacketHopKernel):
             self.key_lo, self.key_hi, self.bootstrap_end,
             jnp.int64(barrier_ns))
         self.device_calls += 1
-        return (np.asarray(deliver)[:n], np.asarray(keep)[:n])
+        return deliver[:n], keep[:n]
 
 
 def _make_batch_sharded_2out(mesh, axis: str):

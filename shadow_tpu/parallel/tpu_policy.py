@@ -12,12 +12,15 @@ intra-round causality violation, the same argument the reference's
 host-steal policy uses for its cross-host barrier clamp
 (scheduler_policy_host_steal.c:229-242).
 
-The batch is structure-of-arrays from the moment of capture: offer_packet
-appends into parallel columns (row indices come from the per-host cached
-topology row, so there is no per-packet dict lookup), and flush_round turns
-them into numpy arrays with one bulk conversion each before the device step.
-Survivor delivery events are then pushed with the per-host queue locks taken
-once per destination host, not once per packet.
+Capture is one tuple append per packet (row indices come from the per-host
+cached topology row, so there is no per-packet dict lookup); flush_round
+unzips the rows into numpy columns, packs them into ONE [1+B, 3] int64
+device upload (header row = batch count + barrier, so no per-call scalar
+transfers), and LAUNCHES the jitted step without materializing.  The engine
+consumes the results at the top of the next loop iteration — always before
+the next window is computed, so causality and determinism are exact — which
+overlaps device compute with the barrier bookkeeping (and, on a real
+accelerator, hides the device round trip behind host-side work).
 
 Parity: drops are keyed by packet uid through the same threefry cipher the
 CPU policies use, so a simulation under ``tpu`` delivers/drops exactly the
@@ -43,21 +46,31 @@ class TPUPolicy(HostQueuesPolicy):
     def __init__(self):
         super().__init__()
         self._batch_lock = threading.Lock()
-        # SoA pending batch (parallel columns, one row per offered packet)
-        self._p_pkts: List = []
-        self._p_src_hosts: List = []
-        self._p_dst_hosts: List = []
-        self._p_seqs: List[int] = []
-        self._p_src_rows: List[int] = []
-        self._p_dst_rows: List[int] = []
-        self._p_uids: List[int] = []
-        self._p_times: List[int] = []
+        # pending batch: one row tuple per offered packet (pkt, src_host,
+        # dst_host, seq, src_row, dst_row, uid, time); a single append per
+        # offer keeps the capture hot path minimal — the flush unzips into
+        # SoA columns with one zip(*) pass
+        self._p_rows: List[Tuple] = []
         self._kernel = None
         self.packets_batched = 0
         self.packets_dropped = 0
+        # launched-but-unconsumed chunks: (pkts, src_hosts, dst_hosts, seqs,
+        # src_rows, dst_rows, deliver, keep) where deliver/keep may still be
+        # computing on the device.  consume_flush materializes them at the
+        # NEXT round boundary, so device compute overlaps host round work.
+        self._pending: List[Tuple] = []
+        # mid-round chunk size: once this many offers accumulate, a chunk is
+        # launched immediately so the device works while the round is still
+        # executing (0 = launch only at the barrier; None = read the option
+        # on first offer — lazily, because the engine isn't known yet)
+        self._chunk: Optional[int] = None
+        # serializes _launch (worker threads may chunk-launch concurrently;
+        # distinct from _batch_lock, which _drain_batch takes)
+        self._launch_lock = threading.Lock()
+        self._sync = False          # --processes shards need same-round results
         # per-round introspection (read by the engine heartbeat)
         self.last_batch = 0
-        self.device_ns = 0          # cumulative wall ns inside kernel.step
+        self.device_ns = 0          # cumulative wall ns blocked on the device
         self.host_flush_ns = 0      # cumulative wall ns in flush outside step
 
     # -- worker-facing batching -------------------------------------------
@@ -75,17 +88,28 @@ class TPUPolicy(HostQueuesPolicy):
         seq_owner = src_host if src_host is not None else dst_host
         seq = seq_owner.next_event_sequence()
         with self._batch_lock:
-            self._p_pkts.append(packet)
-            self._p_src_hosts.append(src_host)
-            self._p_dst_hosts.append(dst_host)
-            self._p_seqs.append(seq)
-            self._p_src_rows.append(src_host.topo_row if src_host is not None
-                                    else dst_host.topo_row)
-            self._p_dst_rows.append(dst_host.topo_row)
-            self._p_uids.append(packet.uid)
-            self._p_times.append(worker.now)
+            self._p_rows.append(
+                (packet, src_host, dst_host, seq,
+                 src_host.topo_row if src_host is not None
+                 else dst_host.topo_row,
+                 dst_host.topo_row, packet.uid, worker.now))
+            n = len(self._p_rows)
         self.packets_batched += 1
+        if self._chunk is None:
+            self._chunk = getattr(engine.options, "tpu_chunk", 0)
+        if self._chunk and n >= self._chunk:
+            # mid-round launch: ship the accumulated chunk now so the device
+            # computes while the host executes the rest of the round
+            self._launch(engine, self._drain_batch())
         return True
+
+    def _drain_batch(self) -> Optional[Tuple]:
+        with self._batch_lock:
+            if not self._p_rows:
+                return None
+            rows = self._p_rows
+            self._p_rows = []
+        return tuple(zip(*rows))
 
     # -- round-boundary flush ---------------------------------------------
     def _ensure_kernel(self, engine):
@@ -93,76 +117,126 @@ class TPUPolicy(HostQueuesPolicy):
             from ..ops.round_step import (PacketHopKernel,
                                           ShardedPacketHopKernel)
             topo = engine.topology
-            n_dev = getattr(engine.options, "tpu_devices", 0)
+            opts = engine.options
+            n_dev = getattr(opts, "tpu_devices", 0)
             if n_dev == 0:
                 # 0 = all local devices (options.py); sharding only engages
                 # when that is actually more than one chip
                 import jax
                 n_dev = len(jax.devices())
+            threshold = getattr(opts, "tpu_device_threshold", 0)
             if n_dev > 1:
                 # scale-out: the round batch is sharded across a 1-D mesh
                 # (ICI collectives combine the min-next-time reduction)
                 self._kernel = ShardedPacketHopKernel(
                     topo, engine._drop_key, engine.bootstrap_end, n_dev,
-                    shard_matrix=getattr(engine.options,
-                                         "tpu_shard_matrix", False))
+                    shard_matrix=getattr(opts, "tpu_shard_matrix", False))
+                self._kernel.DEVICE_THRESHOLD = threshold
             else:
                 self._kernel = PacketHopKernel(
-                    topo, engine._drop_key, engine.bootstrap_end)
+                    topo, engine._drop_key, engine.bootstrap_end,
+                    device_threshold=threshold)
+            if self._chunk is None:
+                self._chunk = getattr(opts, "tpu_chunk", 0)
+            # --processes shards hand cross-shard hops to their owner at the
+            # SAME round's barrier (procs.py outbox drain), so they cannot
+            # defer materialization; checkpointing snapshots round state, so
+            # it needs everything pushed too (the engine consumes before
+            # writing regardless — this just keeps flush's return count
+            # meaningful there).
+            self._sync = engine.shard_count > 1
         return self._kernel
 
-    def flush_round(self, engine) -> int:
-        """Run the device step for the round's batch and push the surviving
-        delivery events.  Called by the engine once per round, after workers
-        drain and before the next window is computed."""
+    def _launch(self, engine, cols) -> None:
+        """Dispatch one chunk's device step asynchronously and queue it for
+        consume_flush.  (pkts, ..., times) columns -> pending tuple.
+        Serialized: worker threads may chunk-launch concurrently and the
+        kernel/perf counters are shared state."""
+        if cols is None:
+            return
+        with self._launch_lock:
+            self._launch_locked(engine, cols)
+
+    def _launch_locked(self, engine, cols) -> None:
         t0 = _walltime.perf_counter_ns()
-        with self._batch_lock:
-            n = len(self._p_pkts)
-            if n == 0:
-                self.last_batch = 0
-                return 0
-            pkts = self._p_pkts;      self._p_pkts = []
-            src_hosts = self._p_src_hosts;  self._p_src_hosts = []
-            dst_hosts = self._p_dst_hosts;  self._p_dst_hosts = []
-            seqs = self._p_seqs;      self._p_seqs = []
-            src_rows = self._p_src_rows;    self._p_src_rows = []
-            dst_rows = self._p_dst_rows;    self._p_dst_rows = []
-            uids = self._p_uids;      self._p_uids = []
-            times = self._p_times;    self._p_times = []
+        (pkts, src_hosts, dst_hosts, seqs, src_rows, dst_rows,
+         uids, times) = cols
+        n = len(pkts)
         self.last_batch = n
         kernel = self._ensure_kernel(engine)
-        topo = engine.topology
-
         src_arr = np.array(src_rows, dtype=np.int32)
         dst_arr = np.array(dst_rows, dtype=np.int32)
         uid_arr = np.array(uids, dtype=np.uint64)
         time_arr = np.array(times, dtype=np.int64)
-
         barrier = engine.scheduler.window_end
-        t1 = _walltime.perf_counter_ns()
         # --tpu-max-inflight bounds one device step's padded batch (HBM
         # safety valve for enormous rounds); lanes are independent, so
         # chunked steps are exact
         cap = max(1, getattr(engine.options, "tpu_max_inflight", 0) or n)
-        if n <= cap:
-            deliver, keep = kernel.step(src_arr, dst_arr, uid_arr, time_arr,
-                                        barrier)
-        else:
-            parts = [kernel.step(src_arr[i:i + cap], dst_arr[i:i + cap],
-                                 uid_arr[i:i + cap], time_arr[i:i + cap],
-                                 barrier)
-                     for i in range(0, n, cap)]
-            deliver = np.concatenate([p[0] for p in parts])
-            keep = np.concatenate([p[1] for p in parts])
-        t2 = _walltime.perf_counter_ns()
+        for i in range(0, n, cap):
+            j = min(i + cap, n)
+            deliver, keep = kernel.launch(src_arr[i:j], dst_arr[i:j],
+                                          uid_arr[i:j], time_arr[i:j],
+                                          barrier)
+            self._pending.append((pkts[i:j], src_hosts[i:j], dst_hosts[i:j],
+                                  seqs[i:j], src_arr[i:j], dst_arr[i:j],
+                                  deliver, keep, barrier))
+        self.host_flush_ns += _walltime.perf_counter_ns() - t0
 
-        # per-path packet accounting for the kept lanes, vectorized
-        # (the CPU latency lookup path counts per call)
-        np.add.at(topo.path_packet_counts, (src_arr[keep], dst_arr[keep]),
-                  1)
-        deliver_list = deliver.tolist()
-        keep_list = keep.tolist()
+    def warmup(self, engine, max_batch: int = 8192) -> None:
+        """Pre-compile the hop kernel for every bucket size up to
+        ``max_batch`` (one dummy launch per power-of-two shape).  XLA
+        compiles are 20-40s each on a real TPU; benches and long runs warm
+        them up front so compile time isn't charged to the measured loop."""
+        from ..ops.round_step import MIN_BUCKET, bucket_size
+        kernel = self._ensure_kernel(engine)
+        if kernel.DEVICE_THRESHOLD and max_batch < kernel.DEVICE_THRESHOLD:
+            return
+        b = MIN_BUCKET
+        while b <= bucket_size(max_batch):
+            # smallest batch that maps to bucket b AND clears the bypass; a
+            # bucket whose whole (b/2, b] range is below the threshold can
+            # never reach the device, so skip it instead of re-warming the
+            # threshold's own bucket shape repeatedly
+            n = max(b // 2 + 1, kernel.DEVICE_THRESHOLD, 1)
+            if n > b:
+                b <<= 1
+                continue
+            dummy_rows = np.zeros(n, dtype=np.int32)
+            d, k = kernel.launch(dummy_rows, dummy_rows,
+                                 np.zeros(n, dtype=np.uint64),
+                                 np.zeros(n, dtype=np.int64), 0)
+            np.asarray(d); np.asarray(k)
+            b <<= 1
+        kernel.device_calls = 0
+        kernel.host_calls = 0
+        kernel.buckets_seen.clear()
 
+    def flush_round(self, engine) -> int:
+        """Launch the device step for the round's remaining batch.  Called by
+        the engine once per round after workers drain.  In async mode (the
+        default) the results are NOT materialized here — the engine calls
+        consume_flush at the top of the next iteration, before the next
+        window is computed, so the device works through the barrier
+        bookkeeping.  Sharded runs consume immediately (same-round outbox
+        contract)."""
+        self._ensure_kernel(engine)
+        self._launch(engine, self._drain_batch())
+        if self._sync:
+            return self.consume_flush(engine)
+        return 0
+
+    def consume_flush(self, engine) -> int:
+        """Materialize every launched chunk and push the surviving delivery
+        events.  MUST run before the engine computes the next window (the
+        engine loop guarantees it); the time blocked here is the exposed
+        device wait the async split is minimizing."""
+        if not self._pending:
+            return 0
+        t0 = _walltime.perf_counter_ns()
+        pending = self._pending
+        self._pending = []
+        topo = engine.topology
         delivered = 0
         dropped = 0
         end_time = engine.end_time
@@ -173,43 +247,63 @@ class TPUPolicy(HostQueuesPolicy):
         owns = engine.owns_host
         outboxes = engine.shard_outboxes
         shard_of = engine.shard_of
-        for i in range(n):
-            pkt = pkts[i]
-            if not keep_list[i]:
-                pkt.add_status("INET_DROPPED")
-                count_drop(pkt)
-                dropped += 1
-                continue
-            t = deliver_list[i]
-            if t >= end_time:
-                continue
-            pkt.add_status("INET_SENT")
-            dst = dst_hosts[i]
-            if sharded and not owns(dst):
-                # --processes: hand the finished hop to the owner shard (the
-                # seq was claimed at offer time, so the event tuple matches)
-                outboxes[shard_of(dst)].append(
-                    (t, dst.id, src_hosts[i].id, seqs[i], pkt.to_wire()))
+        t_dev = 0
+        for (pkts, src_hosts, dst_hosts, seqs, src_arr, dst_arr,
+             deliver, keep, barrier) in pending:
+            td0 = _walltime.perf_counter_ns()
+            m = len(pkts)
+            # blocks iff the device isn't done; device results are padded to
+            # the bucket size (slicing on host is one memcpy, not a dispatch)
+            deliver = np.asarray(deliver)[:m]
+            keep = np.asarray(keep)[:m]
+            t_dev += _walltime.perf_counter_ns() - td0
+            # per-path packet accounting for the kept lanes, vectorized
+            # (the CPU latency lookup path counts per call)
+            np.add.at(topo.path_packet_counts,
+                      (src_arr[keep], dst_arr[keep]), 1)
+            deliver_list = deliver.tolist()
+            keep_list = keep.tolist()
+            for i in range(len(pkts)):
+                pkt = pkts[i]
+                if not keep_list[i]:
+                    pkt.add_status("INET_DROPPED")
+                    count_drop(pkt)
+                    dropped += 1
+                    continue
+                t = deliver_list[i]
+                if t >= end_time:
+                    continue
+                pkt.add_status("INET_SENT")
+                dst = dst_hosts[i]
+                if sharded and not owns(dst):
+                    # --processes: hand the finished hop to the owner shard
+                    # (the seq was claimed at offer time, so the event tuple
+                    # matches)
+                    outboxes[shard_of(dst)].append(
+                        (t, dst.id, src_hosts[i].id, seqs[i], pkt.to_wire()))
+                    delivered += 1
+                    continue
+                task = Task(_deliver_packet_task, dst, pkt,
+                            name="deliver_packet")
+                ev = Event(task, t, dst, src_hosts[i], seqs[i])
+                push(ev, 0, barrier)
                 delivered += 1
-                continue
-            task = Task(_deliver_packet_task, dst, pkt,
-                        name="deliver_packet")
-            ev = Event(task, t, dst, src_hosts[i], seqs[i])
-            push(ev, 0, barrier)
-            delivered += 1
         counters.count_new("event", delivered)
         self.packets_dropped += dropped
-        t3 = _walltime.perf_counter_ns()
-        self.device_ns += t2 - t1
-        self.host_flush_ns += (t1 - t0) + (t3 - t2)
+        t1 = _walltime.perf_counter_ns()
+        self.device_ns += t_dev
+        self.host_flush_ns += (t1 - t0) - t_dev
         return delivered
 
     def pending_count(self) -> int:
-        return super().pending_count() + len(self._p_pkts)
+        return (super().pending_count() + len(self._p_rows)
+                + sum(len(p[0]) for p in self._pending))
 
     def next_time(self) -> int:
-        # A non-empty batch means there are future deliveries not yet pushed;
-        # flush_round always runs before next_time in the engine loop, so the
-        # base implementation is correct — assert the contract in debug runs.
-        assert not self._p_pkts, "flush_round must run before next_time"
+        # Unlaunched offers or unconsumed chunks here would mean the engine
+        # computed a window while deliveries were still in flight; the loop
+        # contract (consume_flush -> next_time -> run -> flush_round) makes
+        # that impossible — assert it.
+        assert not self._p_rows and not self._pending, \
+            "consume_flush must run before next_time"
         return super().next_time()
