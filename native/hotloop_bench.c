@@ -1,0 +1,159 @@
+/* C baseline for the discrete-event hot loop.
+ *
+ * The reference engine runs its event loop in C (worker.c:149-216: pop ->
+ * execute -> repeat) and its inter-host packet hop in C (worker.c:243-304:
+ * reliability draw -> latency lookup -> push delivery event).  The full
+ * reference cannot build here (igraph is not installed and installing is
+ * forbidden), so this ~200-line harness replicates the SHAPE of that hot
+ * loop at C speed — binary-heap event queue ordered by the same
+ * deterministic tuple (time, dstHost, srcHost, seq) (event.c:110-153), hop
+ * math per event, conservative round windows — and reports events/second.
+ * bench.py runs it and records `c_hotloop_events_per_sec`, the yardstick
+ * every Python/device engine number is compared against (BASELINE.md: "must
+ * be measured").
+ *
+ * Original implementation (no reference code): own heap, own xorshift RNG,
+ * dense latency matrix instead of igraph Dijkstra (the rebuild's topology
+ * design).  Workload shape mirrors the tor200 tracking bench: every event
+ * forwards a packet to a random peer and schedules the delivery.
+ */
+
+#define _POSIX_C_SOURCE 199309L
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+
+typedef struct {
+    uint64_t time;      /* ns */
+    uint32_t dst;
+    uint32_t src;
+    uint64_t seq;
+} Ev;
+
+/* min-heap on (time, dst, src, seq) — the reference's total order */
+static Ev* heap;
+static size_t heap_len, heap_cap;
+
+static int ev_lt(const Ev* a, const Ev* b) {
+    if (a->time != b->time) return a->time < b->time;
+    if (a->dst != b->dst) return a->dst < b->dst;
+    if (a->src != b->src) return a->src < b->src;
+    return a->seq < b->seq;
+}
+
+static void heap_push(Ev e) {
+    if (heap_len == heap_cap) {
+        heap_cap *= 2;
+        heap = realloc(heap, heap_cap * sizeof(Ev));
+    }
+    size_t i = heap_len++;
+    heap[i] = e;
+    while (i > 0) {
+        size_t p = (i - 1) / 2;
+        if (!ev_lt(&heap[i], &heap[p])) break;
+        Ev t = heap[p]; heap[p] = heap[i]; heap[i] = t;
+        i = p;
+    }
+}
+
+static int heap_pop_before(uint64_t limit, Ev* out) {
+    if (heap_len == 0 || heap[0].time >= limit) return 0;
+    *out = heap[0];
+    heap[0] = heap[--heap_len];
+    size_t i = 0;
+    for (;;) {
+        size_t l = 2 * i + 1, r = l + 1, m = i;
+        if (l < heap_len && ev_lt(&heap[l], &heap[m])) m = l;
+        if (r < heap_len && ev_lt(&heap[r], &heap[m])) m = r;
+        if (m == i) break;
+        Ev t = heap[m]; heap[m] = heap[i]; heap[i] = t;
+        i = m;
+    }
+    return 1;
+}
+
+/* xorshift128+ — fast deterministic uniform draws (hop reliability) */
+static uint64_t rs[2] = {0x123456789abcdefULL, 0xfedcba987654321ULL};
+static inline uint64_t rnext(void) {
+    uint64_t x = rs[0], y = rs[1];
+    rs[0] = y;
+    x ^= x << 23;
+    rs[1] = x ^ y ^ (x >> 17) ^ (y >> 26);
+    return rs[1] + y;
+}
+
+int main(int argc, char** argv) {
+    uint32_t n_hosts = argc > 1 ? (uint32_t)atoi(argv[1]) : 305;
+    uint64_t max_events = argc > 2 ? (uint64_t)atoll(argv[2]) : 2000000ULL;
+    uint64_t lookahead = 2000000ULL;                   /* 2 ms window */
+    uint64_t end_time = 3600ULL * 1000000000ULL;
+
+    /* dense latency matrix, 2-120 ms (the tor200 shape) + reliability */
+    uint64_t* lat = malloc((size_t)n_hosts * n_hosts * sizeof(uint64_t));
+    float* rel = malloc((size_t)n_hosts * n_hosts * sizeof(float));
+    for (size_t i = 0; i < (size_t)n_hosts * n_hosts; i++) {
+        lat[i] = 2000000ULL + rnext() % 118000000ULL;
+        rel[i] = 0.98f + (float)(rnext() % 20) * 0.001f;
+    }
+    uint64_t* host_seq = calloc(n_hosts, sizeof(uint64_t));
+
+    heap_cap = 1 << 16;
+    heap = malloc(heap_cap * sizeof(Ev));
+
+    /* seed: one event per host at t in [0, 1ms) */
+    for (uint32_t h = 0; h < n_hosts; h++) {
+        Ev e = {rnext() % 1000000ULL, h, h, host_seq[h]++};
+        heap_push(e);
+    }
+
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+
+    uint64_t executed = 0, dropped = 0, rounds = 0;
+    uint64_t win_start = 0;
+    while (executed < max_events && heap_len > 0 && win_start < end_time) {
+        win_start = heap[0].time;
+        uint64_t win_end = win_start + lookahead;
+        Ev e;
+        while (heap_pop_before(win_end, &e)) {
+            executed++;
+            /* hop: the event's host forwards a packet to a random peer
+             * (worker.c:243-304 shape: draw, lookup, schedule) */
+            uint32_t src = e.dst;
+            uint32_t dst = (uint32_t)(rnext() % n_hosts);
+            size_t idx = (size_t)src * n_hosts + dst;
+            float chance = (float)(rnext() >> 40) * (1.0f / (1 << 24));
+            if (chance > rel[idx]) {
+                /* drop: the flow retransmits (schedule a local retry so the
+                 * event population stays constant, as a TCP flow's would) */
+                dropped++;
+                uint64_t retry = e.time + 1000000ULL;
+                if (retry < win_end) retry = win_end;
+                if (retry < end_time) {
+                    Ev r = {retry, src, src, host_seq[src]++};
+                    heap_push(r);
+                }
+                continue;
+            }
+            uint64_t deliver = e.time + lat[idx];
+            if (deliver < win_end) deliver = win_end;  /* barrier clamp */
+            if (deliver >= end_time) continue;
+            Ev d = {deliver, dst, src, host_seq[src]++};
+            heap_push(d);
+        }
+        rounds++;
+    }
+
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    double secs = (double)(t1.tv_sec - t0.tv_sec)
+                + (double)(t1.tv_nsec - t0.tv_nsec) * 1e-9;
+    printf("{\"c_hotloop_events\": %llu, \"c_hotloop_rounds\": %llu, "
+           "\"c_hotloop_dropped\": %llu, \"c_hotloop_wall_sec\": %.3f, "
+           "\"c_hotloop_events_per_sec\": %.0f}\n",
+           (unsigned long long)executed, (unsigned long long)rounds,
+           (unsigned long long)dropped, secs, (double)executed / secs);
+    free(heap); free(lat); free(rel); free(host_seq);
+    return 0;
+}

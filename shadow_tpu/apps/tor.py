@@ -367,10 +367,19 @@ class _ClientStats:
 
 def client_main(api, args):
     # args: <socksport> <path> <dest> <destport> <nstreams> <spec...>
+    #       [device]
     # path entries are "relayhost" or "relayhost:orport" (default 9001,
     # matching the relay role's default), OR "auto:<dirhost>:<dirport>" to
     # bootstrap like real Tor: fetch the consensus from the directory
-    # authority and pick a bandwidth-weighted 3-hop path
+    # authority and pick a bandwidth-weighted 3-hop path.
+    # The trailing "device" flag promotes the DATA phase to the
+    # device-resident traffic plane: the circuit is still built through the
+    # real engine (TCP + CREATE/EXTEND through real relays), then the bulk
+    # download advances in HBM (parallel/device_plane.py) and the client
+    # blocks until the plane reports completion.
+    device_mode = "device" in args
+    if device_mode:
+        args = [a for a in args if a != "device"]
     if args[1].startswith("auto:"):
         # "auto:<dirhost>" or "auto:<dirhost>:<dirport>" (default 9030,
         # same optional-port convention as relay specs)
@@ -408,6 +417,23 @@ def client_main(api, args):
             api.log(f"tor client: EXTEND to {hop} failed")
             return False
     api.log(f"tor client: circuit built through {'->'.join(h for h, _ in path)}")
+
+    if device_mode:
+        # control plane done — hand the bulk transfer to the device plane
+        handle = api.device_flow_start()
+        done_ns = yield from api.device_flow_join(handle)
+        for i in range(nstreams):
+            spec = specs[i % len(specs)]
+            up, down = (int(x) for x in spec.split(":"))
+            stats.streams_ok += 1
+            stats.bytes_up += up
+            stats.bytes_down += down
+        yield from api.send(fd, make_cell(circ, END))
+        api.close(fd)
+        api.log(f"tor client: device flow complete at "
+                f"{done_ns / 1e9:.3f}s ({stats.bytes_down}B down, "
+                f"{stats.streams_ok} streams)")
+        return True
 
     for i in range(nstreams):
         spec = specs[i % len(specs)]

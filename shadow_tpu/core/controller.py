@@ -117,12 +117,19 @@ class Controller:
         else:
             args = []
         stop_ns = stime.from_seconds(pc.stop_time_sec) if pc.stop_time_sec else 0
-        Process(host, f"{host.name}.{pc.plugin}", app_main, args,
-                start_time_ns=stime.from_seconds(pc.start_time_sec),
-                stop_time_ns=stop_ns, preload=pc.preload)
+        proc = Process(host, f"{host.name}.{pc.plugin}", app_main, args,
+                       start_time_ns=stime.from_seconds(pc.start_time_sec),
+                       stop_time_ns=stop_ns, preload=pc.preload)
+        proc.app_path = path    # device-plane scan matches on resolved app
 
     def run(self) -> int:
         self.setup()
+        # device-mode clients in the workload promote their bulk traffic to
+        # the device-resident plane (parallel/device_plane.py); None when
+        # the workload has none — the engine hooks are then inert
+        from ..parallel.device_plane import build_plane_from_engine
+        self.engine.device_plane = build_plane_from_engine(
+            self.engine, mode=getattr(self.options, "device_plane", "device"))
         return self.engine.run()
 
 

@@ -89,6 +89,9 @@ class Engine:
         self.flush_ns = 0
         self._last_heartbeat_wall = 0.0
         self.heartbeat_wall_interval = 5.0
+        # device-resident traffic plane (parallel/device_plane.py); set by
+        # the Controller when the workload has device-mode flows
+        self.device_plane = None
         self._checkpointer = None
         if getattr(options, "checkpoint_interval_sec", 0) > 0:
             from .checkpoint import CheckpointWriter
@@ -259,6 +262,13 @@ class Engine:
             self.counters.count_free("host")
         log.flush()
         leaks = self.counters.leaks()
+        if self.device_plane is not None:
+            st = self.device_plane.stats()
+            log.message(
+                "engine",
+                f"device plane: {st['completed']}/{st['circuits']} flows "
+                f"complete, {st['forwards']} cell forwards on-device over "
+                f"{st['dispatches']} dispatches (mode={st['mode']})")
         log.message("engine",
                     f"simulation finished: {self.rounds_executed} rounds, "
                     f"{self.events_executed} events, "
@@ -279,6 +289,8 @@ class Engine:
         flush = getattr(self.scheduler.policy, "flush_round", None)
         if flush is not None:
             flush(self)
+        if self.device_plane is not None:
+            self.device_plane.advance(self)
         if self._checkpointer is not None:
             # snapshots must include every in-flight delivery: consume first
             self._consume_flush()
@@ -291,6 +303,8 @@ class Engine:
         consume = getattr(self.scheduler.policy, "consume_flush", None)
         if consume is not None:
             consume(self)
+        if self.device_plane is not None:
+            self.device_plane.consume(self)
 
     def _advance_window(self, lookahead: int) -> bool:
         nxt = self.scheduler.next_event_time()

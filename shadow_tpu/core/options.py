@@ -73,6 +73,8 @@ class Options:
     tpu_shard_matrix: bool = False       # row-shard path matrices over the mesh
     tpu_device_threshold: int = 0        # >0: batches below N bypass to numpy
     tpu_chunk: int = 0                   # mid-round async launch size (0=off)
+    device_plane: str = "device"         # device | numpy (bit-identical twin)
+    device_plane_granule_ms: int = 0     # step size override (0 = auto)
     # Checkpointing (new capability; absent in the reference — SURVEY.md §5)
     checkpoint_interval_sec: int = 0     # --checkpoint-interval (0 = off)
     checkpoint_dir: str = "shadow-checkpoints"  # --checkpoint-dir
@@ -136,6 +138,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="route round batches smaller than N to the "
                         "bit-identical numpy path instead of the device "
                         "(0 = always dispatch to the device)")
+    p.add_argument("--device-plane", choices=("device", "numpy"),
+                   default="device", dest="device_plane",
+                   help="execution mode for device-registered bulk flows: "
+                        "'device' runs them in HBM, 'numpy' runs the "
+                        "bit-identical host twin (parity/debug)")
+    p.add_argument("--device-plane-granule-ms", type=int, default=0,
+                   dest="device_plane_granule_ms",
+                   help="device-plane step size in ms (0 = auto-sized from "
+                        "the topology's max latency; bandwidth stays exact, "
+                        "per-hop latency rounds up to the step)")
     p.add_argument("--tpu-chunk", type=int, default=0, dest="tpu_chunk",
                    help="launch a device step as soon as N packet hops "
                         "accumulate mid-round, overlapping device compute "

@@ -444,15 +444,17 @@ class ShardedPacketHopKernel(PacketHopKernel):
 
     def launch(self, src_rows, dst_rows, uids, send_times, barrier_ns):
         # the mesh layouts keep their explicit-sharding step; deliveries are
-        # still returned unmaterialized (jax arrays), so consume-side overlap
-        # applies here too
+        # still returned unmaterialized (jax arrays, PADDED — callers slice
+        # host-side after np.asarray, same contract as the packed kernel),
+        # so consume-side overlap applies here too
         return self.step_sharded(src_rows, dst_rows, uids, send_times,
                                  barrier_ns)
 
     def step(self, src_rows, dst_rows, uids, send_times, barrier_ns):
+        n = len(src_rows)
         deliver, keep = self.step_sharded(src_rows, dst_rows, uids,
                                           send_times, barrier_ns)
-        return np.asarray(deliver), np.asarray(keep)
+        return np.asarray(deliver)[:n], np.asarray(keep)[:n]
 
     def step_sharded(self, src_rows, dst_rows, uids, send_times, barrier_ns):
         n = len(src_rows)
@@ -477,7 +479,7 @@ class ShardedPacketHopKernel(PacketHopKernel):
             self.key_lo, self.key_hi, self.bootstrap_end,
             jnp.int64(barrier_ns))
         self.device_calls += 1
-        return deliver[:n], keep[:n]
+        return deliver, keep
 
 
 def _make_batch_sharded_2out(mesh, axis: str):

@@ -153,6 +153,143 @@ def torcells_run(queued0: jnp.ndarray,     # int64 [F] initial cells/flow
     return delivered, t, forwards
 
 
+@partial(jax.jit, static_argnames=("ring_len",),
+         donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def torcells_step_window(t0: jnp.ndarray,         # int64 scalar: next tick
+                         queued: jnp.ndarray,     # int64 [F]
+                         ring: jnp.ndarray,       # int64 [L, F]
+                         tokens: jnp.ndarray,     # int64 [H]
+                         delivered: jnp.ndarray,  # int64 [F]
+                         target: jnp.ndarray,     # int64 [F] (last-stage rows)
+                         done_tick: jnp.ndarray,  # int64 [F], -1 = not done
+                         node_sent: jnp.ndarray,  # int64 [H] cumulative bytes
+                         inject: jnp.ndarray,     # int64 [F] new cells @ t0
+                         inject_target: jnp.ndarray,  # int64 [F] target adds
+                         n_ticks: jnp.ndarray,    # int64 scalar (dynamic)
+                         idle_ticks: jnp.ndarray,  # int64 scalar: skipped
+                                                   # empty ticks to fold in
+                         flow_node: jnp.ndarray, flow_lat: jnp.ndarray,
+                         flow_succ: jnp.ndarray, seg_start: jnp.ndarray,
+                         refill: jnp.ndarray, capacity: jnp.ndarray,
+                         ring_len: int):
+    """Advance the cell model by EXACTLY n_ticks, carrying ALL state in HBM
+    across dispatches — the execution-plane variant of torcells_run (state
+    tensors are donated, so each round's dispatch updates in place; the host
+    only uploads the tiny inject vectors and downloads the small
+    delivered/done/node_sent summaries it needs for wakeups/trackers).
+
+    Per-tick math is IDENTICAL to torcells_run's body (pinned bit-for-bit by
+    tests/test_device_plane.py's windowed-vs-run parity case), plus:
+    * per-flow completion ticks (done_tick records the first tick a
+      last-stage flow's delivered count reached its target — the engine
+      turns these into deterministic wake events);
+    * per-node cumulative sent bytes (tracker/heartbeat feed).
+
+    The caller chooses what a "tick" means: DeviceTrafficPlane passes
+    refill/capacity/latencies pre-scaled to coarse steps (its ``granule``),
+    so one loop iteration covers several milliseconds — that keeps BOTH the
+    [ring_len, F] arrival ring small on multi-second-latency topologies and
+    the sequential step count low (the per-step ring update walks the whole
+    ring buffer, so state bytes x steps is the real cost on every backend).
+
+    Returns the updated state tuple plus total forwards this window."""
+    f = queued.shape[0]
+    h = refill.shape[0]
+    size = jnp.int64(CELL_WIRE_BYTES)
+    is_last = flow_succ < 0
+    queued = queued + inject
+    target = target + inject_target
+    # fold skipped idle ticks (the plane had no cells anywhere, so the only
+    # state evolution was bucket refill — exact because refill is capped)
+    tokens = jnp.minimum(capacity, tokens + refill * idle_ticks)
+
+    def body(state):
+        t, queued, ring, tokens, delivered, target, done_tick, node_sent, \
+            forwards = state
+        row = jnp.mod(t, ring_len)
+        arr = ring[row]
+        ring = ring.at[row].set(jnp.zeros(f, jnp.int64))
+        queued = queued + arr
+        tokens = jnp.minimum(capacity, tokens + refill)
+        cap_cells = tokens[flow_node] // size
+        csum = jnp.cumsum(queued)
+        before = csum - queued - jnp.where(
+            seg_start > 0, csum[jnp.maximum(seg_start - 1, 0)],
+            jnp.int64(0)) * (seg_start > 0)
+        served = jnp.clip(cap_cells - before, 0, queued)
+        queued = queued - served
+        spent = jax.ops.segment_sum(served * size, flow_node,
+                                    num_segments=h)
+        tokens = tokens - spent
+        node_sent = node_sent + spent
+        delivered = delivered + jnp.where(is_last, served, 0)
+        newly_done = (is_last & (target > 0) & (done_tick < 0)
+                      & (delivered >= target))
+        done_tick = jnp.where(newly_done, t, done_tick)
+        slot = jnp.mod(t + flow_lat, ring_len)
+        fwd = jnp.where(is_last, jnp.int64(0), served)
+        ring = ring.at[slot, jnp.maximum(flow_succ, 0)].add(fwd)
+        forwards = forwards + jnp.sum(served)
+        return (t + 1, queued, ring, tokens, delivered, target, done_tick,
+                node_sent, forwards)
+
+    end = t0 + n_ticks
+
+    def cond(state):
+        return state[0] < end
+
+    state = (t0, queued, ring, tokens, delivered, target, done_tick,
+             node_sent, jnp.int64(0))
+    return jax.lax.while_loop(cond, body, state)
+
+
+def torcells_step_window_numpy(t0, queued, ring, tokens, delivered, target,
+                               done_tick, node_sent, inject, inject_target,
+                               n_ticks, idle_ticks, flow_node, flow_lat,
+                               flow_succ, seg_start, refill, capacity,
+                               ring_len: int):
+    """Bit-identical host twin of torcells_step_window (same rule, same
+    ring, same completion/byte accounting) — the parity gate's oracle and
+    the --device-plane=numpy execution mode."""
+    f = len(queued)
+    h = len(refill)
+    size = CELL_WIRE_BYTES
+    is_last = flow_succ < 0
+    queued = queued + inject
+    target = target + inject_target
+    tokens = np.minimum(capacity, tokens + refill * int(idle_ticks))
+    forwards = 0
+    t = int(t0)
+    for _ in range(int(n_ticks)):
+        row = t % ring_len
+        arr = ring[row].copy()
+        ring[row] = 0
+        queued = queued + arr
+        tokens = np.minimum(capacity, tokens + refill)
+        cap_cells = tokens[flow_node] // size
+        csum = np.cumsum(queued)
+        seg_base = np.where(seg_start > 0, csum[np.maximum(seg_start - 1, 0)],
+                            0) * (seg_start > 0)
+        before = csum - queued - seg_base
+        served = np.clip(cap_cells - before, 0, queued)
+        queued = queued - served
+        spent = np.bincount(flow_node, weights=served * size,
+                            minlength=h).astype(np.int64)
+        tokens = tokens - spent
+        node_sent = node_sent + spent
+        delivered = delivered + np.where(is_last, served, 0)
+        newly_done = (is_last & (target > 0) & (done_tick < 0)
+                      & (delivered >= target))
+        done_tick = np.where(newly_done, t, done_tick)
+        slot = (t + flow_lat) % ring_len
+        fwd = np.where(is_last, 0, served)
+        np.add.at(ring, (slot, np.maximum(flow_succ, 0)), fwd)
+        forwards += int(served.sum())
+        t += 1
+    return (np.int64(t), queued, ring, tokens, delivered, target, done_tick,
+            node_sent, np.int64(forwards))
+
+
 def torcells_run_numpy(queued0, flow_node, flow_lat, flow_succ, seg_start,
                        refill, capacity, ring_len: int, max_ticks: int):
     """Bit-identical host twin (same allocation rule, same ring)."""

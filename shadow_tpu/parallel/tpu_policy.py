@@ -221,7 +221,10 @@ class TPUPolicy(HostQueuesPolicy):
         bookkeeping.  Sharded runs consume immediately (same-round outbox
         contract)."""
         self._ensure_kernel(engine)
-        self._launch(engine, self._drain_batch())
+        cols = self._drain_batch()
+        if cols is None:
+            self.last_batch = 0
+        self._launch(engine, cols)
         if self._sync:
             return self.consume_flush(engine)
         return 0

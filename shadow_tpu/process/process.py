@@ -59,6 +59,15 @@ class _Sleep(_Syscall):
         self.ns = ns
 
 
+class _DeviceWait(_Syscall):
+    """Block until a device-plane flow completes (parallel/device_plane.py);
+    wake_value = the completion sim time ns."""
+    __slots__ = ("circuit",)
+
+    def __init__(self, circuit: int):
+        self.circuit = circuit
+
+
 class _Stop(_Syscall):
     __slots__ = ()
 
@@ -228,6 +237,18 @@ class Process:
 
                 w.schedule_task(Task(on_timeout, None, None, name="block_timeout"),
                                 req.timeout_ns, dst_host=self.host)
+            return
+        if isinstance(req, _DeviceWait):
+            plane = getattr(self.host.engine, "device_plane", None)
+            if plane is None:
+                raise RuntimeError(
+                    f"{self.name}: device flow wait but the engine has no "
+                    "device plane (is the client missing its 'device' arg?)")
+            if plane.is_done(req.circuit):
+                t.wake_value = plane.result(req.circuit)
+            else:
+                plane.register_waiter(req.circuit, self, t)
+                t.state = BLOCKED
             return
         if isinstance(req, _Stop):
             t.state = DONE
@@ -594,6 +615,24 @@ class SyscallAPI:
     def yield_(self):
         """Cooperative yield (pth_yield)."""
         yield None
+
+    # -- device traffic plane ---------------------------------------------
+    def device_flow_start(self, cells: Optional[int] = None) -> int:
+        """Hand this host's registered bulk transfer to the device traffic
+        plane (parallel/device_plane.py); returns the flow handle.  The
+        flow's route/size come from the process's own config args — apps
+        call this once their control-plane setup (e.g. circuit build) is
+        done, which is the moment the cells start moving on-device."""
+        plane = getattr(self.host.engine, "device_plane", None)
+        if plane is None:
+            raise RuntimeError("no device traffic plane in this simulation")
+        return plane.activate(self.host.name, cells)
+
+    def device_flow_join(self, circuit: int):
+        """Block until the device flow completes; returns the completion
+        sim time ns (generator)."""
+        result = yield _DeviceWait(circuit)
+        return result
 
     # -- logging -----------------------------------------------------------
     def log(self, text: str, level: str = "message") -> None:

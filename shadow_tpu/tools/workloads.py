@@ -62,7 +62,7 @@ def tor_network(n_relays: int = 1000, n_clients: Optional[int] = None,
                 n_servers: Optional[int] = None, stoptime: int = 600,
                 streams_per_client: int = 3, stream_spec: str = "512:51200",
                 topology_path: Optional[str] = None, seed: int = 42,
-                dirauth: bool = False) -> str:
+                dirauth: bool = False, device_data: bool = False) -> str:
     """Tor overlay: relays + clients with random 3-hop paths + destinations.
 
     Mirrors the shape of the reference's Tor experiments (shadow-plugin-tor
@@ -71,7 +71,14 @@ def tor_network(n_relays: int = 1000, n_clients: Optional[int] = None,
     ``dirauth=True`` adds the directory bootstrap phase: a directory
     authority host, relays publishing bandwidth-weighted descriptors, and
     clients fetching the consensus and picking their own weighted paths
-    (instead of config-assigned ones) — real Tor's startup behavior."""
+    (instead of config-assigned ones) — real Tor's startup behavior.
+
+    ``device_data=True`` marks every client for the device-resident traffic
+    plane (circuit build stays on the Python control plane; the bulk
+    download advances in HBM — parallel/device_plane.py).  Requires static
+    paths, so it's mutually exclusive with dirauth."""
+    if device_data and dirauth:
+        raise ValueError("device_data needs static paths (dirauth=False)")
     rng = np.random.default_rng(seed)
     n_clients = n_clients if n_clients is not None else max(1, n_relays)
     n_servers = n_servers if n_servers is not None else max(1, n_relays // 20)
@@ -107,11 +114,12 @@ def tor_network(n_relays: int = 1000, n_clients: Optional[int] = None,
             path_s = ",".join(f"relay{int(r)}" for r in path)
         dest = int(rng.integers(0, n_servers))
         start = 5 + int(rng.integers(0, 30))
+        dev = " device" if device_data else ""
         lines.append(
             f'  <host id="torclient{i}" bandwidthdown="51200" bandwidthup="10240">\n'
             f'    <process plugin="tor" starttime="{start}" '
             f'arguments="client 9050 {path_s} dest{dest} 80 '
-            f'{streams_per_client} {stream_spec}" />\n'
+            f'{streams_per_client} {stream_spec}{dev}" />\n'
             '  </host>')
     lines.append('</shadow>')
     return "\n".join(lines) + "\n"

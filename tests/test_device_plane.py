@@ -1,0 +1,190 @@
+"""Device-resident traffic plane (parallel/device_plane.py) gates.
+
+Three contracts:
+1. The windowed stateful kernel (torcells_step_window) advances the model
+   IDENTICALLY to the reference run-to-completion kernel (torcells_run) and
+   to its own numpy twin, bit for bit, across arbitrary window splits and
+   idle-gap folds.
+2. A full engine simulation produces identical state digests whether the
+   bulk flows run on the device plane or its numpy twin, and whether the
+   scheduler policy is serial or tpu.
+3. Conservation: every injected cell is delivered exactly once when the
+   simulation runs long enough.
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.checkpoint import state_digest
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+from shadow_tpu.tools import workloads
+
+
+def _run(policy="global", mode="device", n_relays=8, n_clients=5, stop=60):
+    cfg = configuration.parse_xml(workloads.tor_network(
+        n_relays, n_clients=n_clients, n_servers=2, stoptime=stop,
+        stream_spec="512:20200", device_data=True))
+    cfg.stop_time_sec = stop
+    ctrl = Controller(Options(scheduler_policy=policy, workers=0, seed=3,
+                              stop_time_sec=stop, log_level="warning",
+                              device_plane=mode), cfg)
+    rc = ctrl.run()
+    assert rc == 0
+    return ctrl
+
+
+# ---------------------------------------------------------------------------
+# kernel-level parity
+# ---------------------------------------------------------------------------
+
+def _toy_instance():
+    from shadow_tpu.ops.torcells_device import DeviceTorCells
+    return DeviceTorCells(n_relays=6, n_circuits=20, seed=5,
+                          relay_bw_kibps=512, max_latency_ms=20)
+
+
+def test_windowed_kernel_matches_run_to_completion():
+    """torcells_step_window with one big window == torcells_run (pins the
+    duplicated per-tick math together bit-for-bit)."""
+    import jax.numpy as jnp
+    from shadow_tpu.ops.torcells_device import (torcells_run,
+                                                torcells_step_window)
+    inst = _toy_instance()
+    fl = inst.flows
+    queued0 = np.where(fl["flow_stage"] == 0, 40, 0).astype(np.int64)
+    ref_del, ref_ticks, ref_fwd = inst.run_device(40, max_ticks=5000)
+
+    f = inst.n_flows
+    h = len(inst.refill)
+    state = (jnp.int64(0), jnp.zeros(f, jnp.int64),
+             jnp.zeros((inst.ring_len, f), jnp.int64),
+             jnp.asarray(inst.capacity),
+             jnp.zeros(f, jnp.int64), jnp.zeros(f, jnp.int64),
+             jnp.full(f, -1, jnp.int64), jnp.zeros(h, jnp.int64))
+    out = torcells_step_window(
+        *state, jnp.asarray(queued0), jnp.asarray(queued0),
+        np.int64(ref_ticks), np.int64(0),
+        jnp.asarray(fl["flow_node"]), jnp.asarray(fl["flow_lat"]),
+        jnp.asarray(fl["flow_succ"]), jnp.asarray(fl["seg_start"]),
+        jnp.asarray(inst.refill), jnp.asarray(inst.capacity),
+        ring_len=inst.ring_len)
+    np.testing.assert_array_equal(np.asarray(out[4]), ref_del)
+    assert int(out[8]) == ref_fwd
+
+
+def test_windowed_kernel_split_and_idle_invariance():
+    """Many small windows + an idle-gap fold == one big window (numpy twin
+    vs device, both ways)."""
+    import jax.numpy as jnp
+    from shadow_tpu.ops.torcells_device import (torcells_step_window,
+                                                torcells_step_window_numpy)
+    inst = _toy_instance()
+    fl = inst.flows
+    f = inst.n_flows
+    h = len(inst.refill)
+    queued0 = np.where(fl["flow_stage"] == 0, 25, 0).astype(np.int64)
+    flow_args = (fl["flow_node"], fl["flow_lat"], fl["flow_succ"],
+                 fl["seg_start"], inst.refill, inst.capacity)
+
+    def np_state():
+        return [np.int64(0), np.zeros(f, np.int64),
+                np.zeros((inst.ring_len, f), np.int64),
+                inst.capacity.copy().astype(np.int64),
+                np.zeros(f, np.int64), np.zeros(f, np.int64),
+                np.full(f, -1, np.int64), np.zeros(h, np.int64)]
+
+    zeros = np.zeros(f, np.int64)
+    # one 600-tick window
+    big = torcells_step_window_numpy(*np_state(), queued0, queued0, 600, 0,
+                                     *flow_args, inst.ring_len)
+    # split: 7 + 93 + 500 with injection only in the first
+    s = np_state()
+    out = torcells_step_window_numpy(*s, queued0, queued0, 7, 0,
+                                     *flow_args, inst.ring_len)
+    out = torcells_step_window_numpy(*out[:8], zeros, zeros, 93, 0,
+                                     *flow_args, inst.ring_len)
+    out = torcells_step_window_numpy(*out[:8], zeros, zeros, 500, 0,
+                                     *flow_args, inst.ring_len)
+    for i in (1, 3, 4, 5, 6, 7):
+        np.testing.assert_array_equal(out[i], big[i])
+
+    # device twin of the split run
+    dev = tuple(jnp.asarray(a) for a in np_state())
+    dout = torcells_step_window(*dev, jnp.asarray(queued0),
+                                jnp.asarray(queued0), np.int64(7),
+                                np.int64(0),
+                                *(jnp.asarray(a) for a in flow_args),
+                                ring_len=inst.ring_len)
+    dout = torcells_step_window(*dout[:8], jnp.asarray(zeros),
+                                jnp.asarray(zeros), np.int64(93),
+                                np.int64(0),
+                                *(jnp.asarray(a) for a in flow_args),
+                                ring_len=inst.ring_len)
+    dout = torcells_step_window(*dout[:8], jnp.asarray(zeros),
+                                jnp.asarray(zeros), np.int64(500),
+                                np.int64(0),
+                                *(jnp.asarray(a) for a in flow_args),
+                                ring_len=inst.ring_len)
+    for i in (1, 3, 4, 5, 6, 7):
+        np.testing.assert_array_equal(np.asarray(dout[i]), big[i])
+
+    # idle fold: running 100 empty ticks == banking them as idle_ticks
+    idle_a = torcells_step_window_numpy(*[x.copy() if hasattr(x, "copy")
+                                          else x for x in out[:8]],
+                                        zeros, zeros, 100, 0,
+                                        *flow_args, inst.ring_len)
+    idle_b = torcells_step_window_numpy(*[x.copy() if hasattr(x, "copy")
+                                          else x for x in out[:8]],
+                                        zeros, zeros, 0, 100,
+                                        *flow_args, inst.ring_len)
+    np.testing.assert_array_equal(idle_a[3], idle_b[3])   # tokens
+    np.testing.assert_array_equal(idle_a[4], idle_b[4])   # delivered
+
+
+# ---------------------------------------------------------------------------
+# engine-level parity + conservation
+# ---------------------------------------------------------------------------
+
+def test_engine_device_vs_numpy_plane_digest_parity():
+    a = _run(mode="device")
+    b = _run(mode="numpy")
+    assert state_digest(a.engine) == state_digest(b.engine)
+    assert a.engine.device_plane.stats()["forwards"] == \
+        b.engine.device_plane.stats()["forwards"]
+
+
+def test_engine_policy_parity_with_device_plane():
+    a = _run(policy="global")
+    b = _run(policy="tpu")
+    assert state_digest(a.engine) == state_digest(b.engine)
+
+
+def test_cell_conservation_and_completion():
+    ctrl = _run(stop=120)
+    st = ctrl.engine.device_plane.stats()
+    assert st["completed"] == st["circuits"], \
+        f"only {st['completed']}/{st['circuits']} flows completed"
+    # each injected cell is forwarded exactly once per stage (5 stages)
+    assert st["forwards"] == st["injected_cells"] * 5
+    plane = ctrl.engine.device_plane
+    delivered = np.asarray(plane._state[4])
+    assert int(delivered[plane.last_flow].sum()) == st["injected_cells"]
+
+
+def test_device_clients_require_static_paths():
+    from shadow_tpu.parallel.device_plane import parse_device_client
+    with pytest.raises(ValueError):
+        parse_device_client("c0", ["client", "9050", "auto:dirauth:9030",
+                                   "dest0", "80", "1", "512:51200", "device"])
+
+
+def test_plane_refuses_sharded_engines():
+    from shadow_tpu.parallel.device_plane import DeviceTrafficPlane
+
+    class FakeEngine:
+        shard_count = 2
+
+    with pytest.raises(RuntimeError):
+        DeviceTrafficPlane(FakeEngine(), [], mode="device")
