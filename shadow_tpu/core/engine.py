@@ -308,6 +308,10 @@ class Engine:
 
     def _advance_window(self, lookahead: int) -> bool:
         nxt = self.scheduler.next_event_time()
+        if self.device_plane is not None:
+            # a busy device plane needs windows even when the Python plane
+            # is idle (its dispatch cadence is the "next event")
+            nxt = min(nxt, self.device_plane.next_time())
         if nxt >= self.end_time or nxt >= stime.SIM_TIME_MAX:
             return False
         self.scheduler.window_start = nxt

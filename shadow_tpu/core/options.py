@@ -75,6 +75,7 @@ class Options:
     tpu_chunk: int = 0                   # mid-round async launch size (0=off)
     device_plane: str = "device"         # device | numpy (bit-identical twin)
     device_plane_granule_ms: int = 0     # step size override (0 = auto)
+    device_plane_batch_steps: int = 4    # min steps per kernel dispatch
     # Checkpointing (new capability; absent in the reference — SURVEY.md §5)
     checkpoint_interval_sec: int = 0     # --checkpoint-interval (0 = off)
     checkpoint_dir: str = "shadow-checkpoints"  # --checkpoint-dir
@@ -148,6 +149,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device-plane step size in ms (0 = auto-sized from "
                         "the topology's max latency; bandwidth stays exact, "
                         "per-hop latency rounds up to the step)")
+    p.add_argument("--device-plane-batch-steps", type=int, default=4,
+                   dest="device_plane_batch_steps",
+                   help="accumulate at least N plane steps per kernel "
+                        "dispatch (amortizes per-dispatch cost on backends "
+                        "without buffer donation)")
     p.add_argument("--tpu-chunk", type=int, default=0, dest="tpu_chunk",
                    help="launch a device step as soon as N packet hops "
                         "accumulate mid-round, overlapping device compute "

@@ -569,7 +569,7 @@ class ThreadPerHostPolicy(SchedulerPolicy):
                 + sum(len(mb) for mb in self._mailboxes.values()))
 
 
-def make_policy(name: str) -> SchedulerPolicy:
+def make_policy(name: str, n_workers: int = 0) -> SchedulerPolicy:
     if name == "global":
         return GlobalSinglePolicy()
     if name == "host":
@@ -583,6 +583,13 @@ def make_policy(name: str) -> SchedulerPolicy:
     if name == "threadXhost":
         return ThreadPerHostPolicy()
     if name == "tpu":
+        # storage layout follows the execution mode: the single global
+        # queue for serial runs (per-host queues cost a min-scan per pop
+        # for no benefit without threads), per-host queues when workers
+        # pop in parallel
+        if n_workers == 0:
+            from ..parallel.tpu_policy import TPUSerialPolicy
+            return TPUSerialPolicy()
         from ..parallel.tpu_policy import TPUPolicy
         return TPUPolicy()
     raise ValueError(f"unknown scheduler policy {name!r}")
@@ -601,7 +608,7 @@ class Scheduler:
             # (scheduler.c:139-142)
             policy_name = "global"
             self.policy_name = "global"
-        self.policy = make_policy(policy_name)
+        self.policy = make_policy(policy_name, self.n_workers)
         if self.n_workers == 0 and isinstance(
                 self.policy, (GlobalSinglePolicy, HostQueuesPolicy)):
             self.policy.serial = True

@@ -108,6 +108,17 @@ class DeviceTrafficPlane:
         assert mode in ("device", "numpy")
         self.engine = engine
         self.mode = mode
+        # dispatch cadence: accumulate at least this many steps before
+        # launching a kernel dispatch (injections wait with them).  One
+        # dispatch per engine round would pay a full state round trip per
+        # round on backends without buffer donation (jax CPU copies the
+        # donated state every call — measured ~7 ms at 50k flows); batching
+        # K rounds' ticks into one dispatch amortizes it K-fold.  Wake
+        # times are observed at the consuming barrier either way, and both
+        # execution modes follow the identical cadence, so digests stay
+        # parity-comparable.
+        self.min_dispatch_steps = max(
+            1, int(getattr(engine.options, "device_plane_batch_steps", 4)))
         self.specs = specs
         for i, s in enumerate(specs):
             s.circuit = i
@@ -122,6 +133,7 @@ class DeviceTrafficPlane:
         self._woken: set = set()
         self._prev_node_sent: Optional[np.ndarray] = None
         self._prev_delivered: Optional[np.ndarray] = None
+        self._flow_args_cached = None
         self.total_forwards = 0
         self.total_injected_cells = 0
         self.dispatches = 0
@@ -253,8 +265,23 @@ class DeviceTrafficPlane:
             import jax.numpy as jnp
             state = tuple(jnp.asarray(a) for a in state)
         self._state = state
+        self._flow_args_cached = None
         self._prev_node_sent = np.zeros(h, dtype=np.int64)
         self._prev_delivered = np.zeros(f, dtype=np.int64)
+
+    def _flow_args(self):
+        """The static flow tables, resident where the kernel runs: committed
+        device buffers in device mode (uploaded ONCE — re-sending ~2 MB of
+        int64 tables per dispatch at 10k circuits would waste host link
+        bandwidth every round), plain numpy for the twin."""
+        if self._flow_args_cached is None:
+            args = (self.flow_node, self.flow_lat_steps, self.flow_succ,
+                    self.seg_start, self.refill_step, self.capacity_step)
+            if self.mode == "device":
+                import jax.numpy as jnp
+                args = tuple(jnp.asarray(a) for a in args)
+            self._flow_args_cached = args
+        return self._flow_args_cached
 
     # -- app-facing -------------------------------------------------------
     def activate(self, client_name: str, cells: Optional[int] = None) -> int:
@@ -276,6 +303,28 @@ class DeviceTrafficPlane:
 
     def register_waiter(self, circuit: int, process, thread) -> None:
         self._waiters[circuit] = (process, thread)
+
+    def warmup(self) -> None:
+        """Pre-compile the windowed kernel for this plane's exact shapes
+        using throwaway state (XLA compiles are 20-40s on a real TPU; the
+        bench excludes them from timed walls).  No plane state is touched."""
+        if self.mode != "device":
+            return
+        import jax.numpy as jnp
+        from ..ops.torcells_device import torcells_step_window
+        f, h = self.n_flows, self.n_nodes
+        z = np.zeros(f, dtype=np.int64)
+        state = (np.int64(0), jnp.zeros(f, jnp.int64),
+                 jnp.zeros((self.ring_len, f), jnp.int64),
+                 jnp.asarray(self.capacity_step),
+                 jnp.zeros(f, jnp.int64), jnp.zeros(f, jnp.int64),
+                 jnp.full(f, -1, jnp.int64), jnp.zeros(h, jnp.int64))
+        out = torcells_step_window(*state, z, z, np.int64(1), np.int64(0),
+                                   self.flow_node, self.flow_lat_steps,
+                                   self.flow_succ, self.seg_start,
+                                   self.refill_step, self.capacity_step,
+                                   ring_len=self.ring_len)
+        np.asarray(out[8])
 
     # -- engine-facing ----------------------------------------------------
     def advance(self, engine) -> None:
@@ -302,6 +351,11 @@ class DeviceTrafficPlane:
             self._ticks_synced = target_ticks
             self.idle_rounds_skipped += 1
             return
+        if n < self.min_dispatch_steps:
+            # cadence batching: let ticks (and injections) accumulate a few
+            # rounds before paying a dispatch; next_time() keeps the engine
+            # window loop coming back even when the Python plane idles
+            return
         f = self.n_flows
         inject = np.zeros(f, dtype=np.int64)
         inject_target = np.zeros(f, dtype=np.int64)
@@ -312,11 +366,16 @@ class DeviceTrafficPlane:
         self._inject_buf.clear()
         idle = self._idle_ticks_banked
         self._idle_ticks_banked = 0
-        # re-base t past any banked idle gap (the ring is empty while idle,
-        # so the tick origin is free; monotonicity preserved)
-        state = (np.int64(self._ticks_synced - n), *self._state[1:])
-        flow_args = (self.flow_node, self.flow_lat_steps, self.flow_succ,
-                     self.seg_start, self.refill_step, self.capacity_step)
+        # Step continuity: the kernel's carried t equals the last dispatch's
+        # end step; _ticks_synced (pre-update here) additionally counts any
+        # banked idle steps, so re-basing to it jumps t exactly over the
+        # idle gap — legal because idle banking requires an empty ring — and
+        # is the identity when nothing was banked.  (Re-basing to anything
+        # else desynchronizes the arrival ring's absolute slots: cells would
+        # be skipped or re-read — caught by an adversarial review repro and
+        # now pinned by test_varying_dispatch_sizes_preserve_arrivals.)
+        state = (np.int64(self._ticks_synced), *self._state[1:])
+        flow_args = self._flow_args()
         if self.mode == "device":
             from ..ops.torcells_device import torcells_step_window
             out = torcells_step_window(*state, inject, inject_target,
@@ -399,6 +458,24 @@ class DeviceTrafficPlane:
         ev = Event(task, when, host, host, host.next_event_sequence())
         engine.counters.count_new("event")
         engine.scheduler.policy.push(ev, 0, engine.scheduler.window_end)
+
+    def busy(self) -> bool:
+        """True while the plane still has work the engine must keep
+        windows advancing for (undelivered cells, buffered injections, or
+        an unconsumed dispatch)."""
+        return (bool(self._inject_buf) or self._inflight
+                or self._cells_delivered_seen < self._cells_dispatched)
+
+    def next_time(self) -> int:
+        """The next sim time the plane needs a window at — its dispatch
+        cadence point.  Folded into the engine's next-window computation so
+        a quiet Python plane cannot strand in-flight device traffic (the
+        plane's flows would otherwise only progress while unrelated Python
+        events kept the round loop alive)."""
+        if not self.busy():
+            return stime.SIM_TIME_MAX
+        return ((self._ticks_synced + self.min_dispatch_steps)
+                * self.granule * TICK_NS)
 
     def stats(self) -> Dict[str, int]:
         return {

@@ -36,15 +36,25 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from ..core.scheduler import HostQueuesPolicy
+from ..core.scheduler import GlobalSinglePolicy, HostQueuesPolicy
 from ..core.event import Event
 from ..core.task import Task
 from ..core.worker import _deliver_packet_task
 
 
-class TPUPolicy(HostQueuesPolicy):
-    def __init__(self):
-        super().__init__()
+class _TPUBatchMixin:
+    """The device-batching behavior (offer/launch/consume/warmup), layered
+    over an event-storage policy.  Two concrete layouts:
+
+    * TPUSerialPolicy — over the single global queue (workers == 0).  The
+      per-host-queue layout costs a measured ~1.5 s extra on tor200's pops
+      alone (min-scan across 305 queues vs one pop_before), which was the
+      bulk of the r3 tpu-vs-serial regression — batching never needed it.
+    * TPUPolicy — over the per-host locked queues (threaded runs, where
+      per-host ownership is what makes parallel pops safe).
+    """
+
+    def _init_batch(self):
         self._batch_lock = threading.Lock()
         # pending batch: one row tuple per offered packet (pkt, src_host,
         # dst_host, seq, src_row, dst_row, uid, time); a single append per
@@ -310,3 +320,19 @@ class TPUPolicy(HostQueuesPolicy):
         assert not self._p_rows and not self._pending, \
             "consume_flush must run before next_time"
         return super().next_time()
+
+
+class TPUSerialPolicy(_TPUBatchMixin, GlobalSinglePolicy):
+    """tpu policy over the single global event queue (workers == 0)."""
+
+    def __init__(self):
+        GlobalSinglePolicy.__init__(self)
+        self._init_batch()
+
+
+class TPUPolicy(_TPUBatchMixin, HostQueuesPolicy):
+    """tpu policy over per-host locked queues (threaded runs)."""
+
+    def __init__(self):
+        HostQueuesPolicy.__init__(self)
+        self._init_batch()

@@ -173,6 +173,21 @@ def test_cell_conservation_and_completion():
     assert int(delivered[plane.last_flow].sum()) == st["injected_cells"]
 
 
+def test_varying_dispatch_sizes_preserve_arrivals():
+    """The kernel's carried step counter must track the plane's synced step
+    exactly across dispatches of VARYING size (round windows are
+    event-driven, so n differs every dispatch).  A wrong re-base
+    desynchronizes the arrival ring's absolute slots — in-flight cells get
+    skipped and arrive a ring revolution late (r4 review repro)."""
+    ctrl = _run(stop=120)
+    plane = ctrl.engine.device_plane
+    # kernel step counter + idle steps banked since the last dispatch ==
+    # the plane's synced step (with the off-by-n re-base this diverges by
+    # the final dispatch's size)
+    assert (int(np.asarray(plane._state[0])) + plane._idle_ticks_banked
+            == plane._ticks_synced)
+
+
 def test_device_clients_require_static_paths():
     from shadow_tpu.parallel.device_plane import parse_device_client
     with pytest.raises(ValueError):
