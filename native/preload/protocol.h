@@ -69,6 +69,9 @@ enum shadow_tpu_op {
   SHD_OP_TIMERFD_SETTIME = 33, /* a=fd b=initial_ns c=interval_ns */
   SHD_OP_PIPE = 34,         /* -> ret=read fd, payload u32 write fd */
   SHD_OP_SOCKETPAIR = 35,   /* -> ret=fd a, payload u32 fd b */
+  SHD_OP_EVENTFD = 36,      /* a=initval b=bit0:semaphore -> fd */
+  SHD_OP_SIGNALFD = 37,     /* a=mask bitmap (bit signo-1) -> fd */
+  SHD_OP_KILL = 38,         /* a=signo (self) -> n signalfds matched */
 };
 
 #define SHD_REQ_HDR_LEN 40u

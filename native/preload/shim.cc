@@ -43,6 +43,9 @@
 #include <sys/socket.h>
 #include <sys/syscall.h>
 #include <sys/time.h>
+#include <signal.h>
+#include <sys/eventfd.h>
+#include <sys/signalfd.h>
 #include <sys/timerfd.h>
 #include <sys/uio.h>
 #include <time.h>
@@ -159,6 +162,8 @@ DECL_REAL(int, timerfd_settime, int, int, const struct itimerspec *,
           struct itimerspec *);
 DECL_REAL(int, dup, int);
 DECL_REAL(int, dup2, int, int);
+DECL_REAL(int, eventfd, unsigned int, int);
+DECL_REAL(int, signalfd, int, const sigset_t *, int);
 
 static void resolve_reals(void) {
 #define SET(name) \
@@ -178,6 +183,7 @@ static void resolve_reals(void) {
   SET(fcntl); SET(ioctl); SET(getsockopt); SET(setsockopt);
   SET(getsockname); SET(getpeername); SET(pipe); SET(pipe2);
   SET(timerfd_create); SET(timerfd_settime); SET(dup); SET(dup2);
+  SET(eventfd); SET(signalfd);
 #undef SET
 }
 
@@ -1138,6 +1144,42 @@ extern "C" int timerfd_settime(int fd, int flags, const struct itimerspec *newv,
   if (oldv) memset(oldv, 0, sizeof *oldv);
   return transact0(SHD_OP_TIMERFD_SETTIME, to_handle(fd), init, iv, 0) < 0
              ? -1 : 0;
+}
+
+/* ------------------------------------------------------ eventfd/signalfd -- */
+
+extern "C" int eventfd(unsigned int initval, int flags) {
+  resolve_reals();
+  if (!g_active) return REAL(eventfd)(initval, flags);
+  int64_t h = transact0(SHD_OP_EVENTFD, (int64_t)initval,
+                        (flags & EFD_SEMAPHORE) ? 1 : 0, 0, 0);
+  if (h < 0) return -1;
+  int fd = to_appfd(h);
+  mark_sim_fd(fd, 1);
+  if (flags & EFD_NONBLOCK) {
+    transact0(SHD_OP_FCNTL, h, F_SETFL, O_NONBLOCK, 0);
+    g_fd_nonblock[fd] = 1;
+  }
+  return fd;
+}
+
+extern "C" int signalfd(int fd, const sigset_t *mask, int flags) {
+  resolve_reals();
+  if (!g_active) return REAL(signalfd)(fd, mask, flags);
+  if (!mask) { errno = EINVAL; return -1; }
+  if (fd != -1) { errno = EINVAL; return -1; }  /* mask update: not modelled */
+  int64_t bm = 0;
+  for (int s = 1; s <= 64; s++)
+    if (sigismember(mask, s) == 1) bm |= (int64_t)1 << (s - 1);
+  int64_t h = transact0(SHD_OP_SIGNALFD, bm, 0, 0, 0);
+  if (h < 0) return -1;
+  int nfd = to_appfd(h);
+  mark_sim_fd(nfd, 1);
+  if (flags & SFD_NONBLOCK) {
+    transact0(SHD_OP_FCNTL, h, F_SETFL, O_NONBLOCK, 0);
+    g_fd_nonblock[nfd] = 1;
+  }
+  return nfd;
 }
 
 /* ----------------------------------------------------------------- pipes -- */

@@ -130,6 +130,7 @@ extern "C" int system(const char *cmd) {
 /* --------------------------------------------------------------- signals -- */
 
 static sighandler_t g_sig_handlers[65];
+static int g_sig_siginfo[65];     /* SA_SIGINFO recorded per signal */
 
 extern "C" sighandler_t signal(int signum, sighandler_t handler) {
   static sighandler_t (*real_signal)(int, sighandler_t);
@@ -152,9 +153,67 @@ extern "C" int sigaction(int signum, const struct sigaction *act,
   if (oldact) {
     memset(oldact, 0, sizeof *oldact);
     oldact->sa_handler = g_sig_handlers[signum];
+    if (g_sig_siginfo[signum]) oldact->sa_flags = SA_SIGINFO;
   }
-  if (act) g_sig_handlers[signum] = act->sa_handler;
+  if (act) {
+    /* sa_handler and sa_sigaction share a union: record which member is
+     * live so the kill() fallback can call it with the right arity */
+    g_sig_handlers[signum] = act->sa_handler;
+    g_sig_siginfo[signum] = (act->sa_flags & SA_SIGINFO) ? 1 : 0;
+  }
   return 0;
+}
+
+/* Self-directed signals ARE delivered (Tor-class event loops raise
+ * SIGTERM/SIGHUP at themselves and expect their signalfd — or their
+ * installed handler — to observe it): kill/raise on the virtual pid routes
+ * to the simulator, which queues the signal on any matching signalfd the
+ * process holds; if none matched, the handler recorded by
+ * signal()/sigaction() runs synchronously, and SIG_DFL on a fatal signal
+ * exits the virtual process (kernel default action).  Cross-process kill
+ * is not modelled (EPERM), matching the reference's undelivered-signal
+ * stance for foreign pids. */
+
+extern "C" int kill(pid_t pid, int sig) {
+  static int (*real_kill)(pid_t, int);
+  if (!real_kill) *(void **)(&real_kill) = dlsym(RTLD_NEXT, "kill");
+  if (!shd_active()) return real_kill(pid, sig);
+  if (pid != 0 && pid != getpid()) { errno = EPERM; return -1; }
+  if (sig == 0) return 0;               /* existence probe */
+  if (sig < 1 || sig > 64) { errno = EINVAL; return -1; }
+  int64_t matched = shd_transact(SHD_OP_KILL, sig, 0, 0, 0, NULL, 0,
+                                 NULL, 0, NULL);
+  if (matched < 0) { errno = EINVAL; return -1; }
+  if (matched == 0) {
+    sighandler_t h = g_sig_handlers[sig];
+    if (h != SIG_DFL && h != SIG_IGN) {
+      if (g_sig_siginfo[sig]) {
+        /* SA_SIGINFO: three-arg form with a zeroed siginfo (the only
+         * in-sim sender is the process itself) */
+        siginfo_t si;
+        memset(&si, 0, sizeof si);
+        si.si_signo = sig;
+        si.si_pid = getpid();
+        ((void (*)(int, siginfo_t *, void *))h)(sig, &si, NULL);
+      } else {
+        h(sig);
+      }
+    } else if (h == SIG_DFL &&
+               (sig == SIGTERM || sig == SIGINT || sig == SIGQUIT ||
+                sig == SIGKILL || sig == SIGHUP)) {
+      /* kernel default action: terminate WITHOUT atexit/stdio flushing
+       * (exit() would run both and diverge from the native leg) */
+      _exit(128 + sig);
+    }
+  }
+  return 0;
+}
+
+extern "C" int raise(int sig) {
+  static int (*real_raise)(int);
+  if (!real_raise) *(void **)(&real_raise) = dlsym(RTLD_NEXT, "raise");
+  if (!shd_active()) return real_raise(sig);
+  return kill(getpid(), sig) == 0 ? 0 : sig;
 }
 
 extern "C" int sigprocmask(int how, const sigset_t *set, sigset_t *oldset) {

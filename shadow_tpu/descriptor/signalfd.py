@@ -1,0 +1,55 @@
+"""signalfd emulation: virtual signals delivered as a readable descriptor.
+
+The reference models signals through its pth substrate (rpth's signal
+handling) and the process_emu layer; in the split-process design a signal
+raised inside the simulation (raise()/kill() on the virtual pid) is routed
+by the shim to the simulator, which queues it on any matching signalfd the
+process holds — signalfd(2) semantics for the subset Tor-class event loops
+use (block the signal, put the signalfd in epoll, read 128-byte
+signalfd_siginfo records):
+
+* the descriptor carries a signal-number mask;
+* deliver(signo) queues a record iff signo is in the mask;
+* read() pops one record (blocks/EAGAIN when empty); readable iff queued.
+
+Records are 128-byte signalfd_siginfo structs with ssi_signo filled and
+the sender fields zero (the only in-sim senders are the process itself and
+the simulator).
+"""
+
+from __future__ import annotations
+
+import struct
+from collections import deque
+from typing import Optional
+
+from .base import Descriptor, S_READABLE
+
+SIGINFO_SIZE = 128
+
+
+class SignalFD(Descriptor):
+    def __init__(self, host, handle: int, mask: int):
+        super().__init__(host, handle, "signalfd")
+        self.mask = int(mask)          # bit (signo-1) set = in mask
+        self.pending: deque = deque()
+
+    def matches(self, signo: int) -> bool:
+        return 1 <= signo <= 64 and bool(self.mask >> (signo - 1) & 1)
+
+    def deliver(self, signo: int) -> bool:
+        if self.closed or not self.matches(signo):
+            return False
+        self.pending.append(signo)
+        self.adjust_status(S_READABLE, True)
+        return True
+
+    def read_siginfo(self) -> Optional[bytes]:
+        if not self.pending:
+            return None
+        signo = self.pending.popleft()
+        if not self.pending:
+            self.adjust_status(S_READABLE, False)
+        # struct signalfd_siginfo: u32 ssi_signo, s32 ssi_errno, s32
+        # ssi_code, then ids/addresses we zero-fill, padded to 128 bytes
+        return struct.pack("<Iii", signo, 0, 0).ljust(SIGINFO_SIZE, b"\0")

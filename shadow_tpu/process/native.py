@@ -72,6 +72,9 @@ OP_TIMERFD_CREATE = 32
 OP_TIMERFD_SETTIME = 33
 OP_PIPE = 34
 OP_SOCKETPAIR = 35
+OP_EVENTFD = 36
+OP_SIGNALFD = 37
+OP_KILL = 38
 
 REQ_HDR = struct.Struct("<IIqqqq")
 RESP_HDR = struct.Struct("<IIqq")
@@ -383,10 +386,40 @@ class NativeKernel:
                 yield _Block(desc, S_READABLE)
             n = desc.read_expirations()
             return 8, struct.pack("<Q", n)
+        if desc.kind == "eventfd":
+            while True:
+                v = desc.read_value()
+                if v is not None:
+                    return 8, struct.pack("<Q", v)
+                if self._nonblock(desc) or bool(c):
+                    return -errno_mod.EAGAIN, b""
+                yield _Block(desc, S_READABLE)
+        if desc.kind == "signalfd":
+            while True:
+                rec = desc.read_siginfo()
+                if rec is not None:
+                    return len(rec), rec
+                if self._nonblock(desc) or bool(c):
+                    return -errno_mod.EAGAIN, b""
+                yield _Block(desc, S_READABLE)
         r = yield from self.op_recv(a, b, c, d, payload)
         return r
 
     def op_write(self, a, b, c, d, payload):
+        desc = self._desc(a)
+        if desc.kind == "eventfd":
+            if len(payload) < 8:            # kernel: EINVAL under 8 bytes,
+                return -errno_mod.EINVAL, b""   # first 8 used otherwise
+            val = struct.unpack("<Q", payload[:8])[0]
+            while True:
+                r = desc.write_value(val)
+                if r is None:
+                    return -errno_mod.EINVAL, b""
+                if r:
+                    return 8, b""
+                if self._nonblock(desc) or bool(b):
+                    return -errno_mod.EAGAIN, b""
+                yield _Block(desc, S_WRITABLE)
         r = yield from self.op_send(a, b, c, d, payload)
         return r
 
@@ -571,6 +604,23 @@ class NativeKernel:
         return ha, struct.pack("<I", hb)
         yield  # pragma: no cover
 
+    def op_eventfd(self, a, b, c, d, payload):
+        # a=initval, b: bit0 = EFD_SEMAPHORE (shim-decoded)
+        return self.api.eventfd_create(int(a), bool(int(b) & 1)), b""
+        yield  # pragma: no cover
+
+    def op_signalfd(self, a, b, c, d, payload):
+        # a = 64-bit signal mask bitmap (bit signo-1)
+        return self.api.signalfd_create(int(a)), b""
+        yield  # pragma: no cover
+
+    def op_kill(self, a, b, c, d, payload):
+        # a = signo, self-directed (shim routes only own-pid kills here);
+        # returns the number of matching signalfds so the shim can fall
+        # back to its recorded handler when none matched
+        return self.api.deliver_signal(int(a)), b""
+        yield  # pragma: no cover
+
     # -- misc --------------------------------------------------------------
     def op_exit(self, a, b, c, d, payload):
         self.exit_code = int(a)
@@ -598,7 +648,8 @@ class NativeKernel:
         OP_WRITE: op_write, OP_EXIT: op_exit, OP_LOG: op_log,
         OP_TIMERFD_CREATE: op_timerfd_create,
         OP_TIMERFD_SETTIME: op_timerfd_settime, OP_PIPE: op_pipe,
-        OP_SOCKETPAIR: op_socketpair,
+        OP_SOCKETPAIR: op_socketpair, OP_EVENTFD: op_eventfd,
+        OP_SIGNALFD: op_signalfd, OP_KILL: op_kill,
     }
 
 

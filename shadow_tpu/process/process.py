@@ -109,6 +109,7 @@ class Process:
         self.return_values: Dict[int, Any] = {}
         self.app_state: Any = None  # apps may park observable state here (tests)
         self._continue_scheduled = False
+        self._signal_fds: List = []   # open SignalFD descriptors (delivery)
         host.add_process(self)
 
     # -- lifecycle ---------------------------------------------------------
@@ -566,6 +567,34 @@ class SyscallAPI:
         tm = Timer(host, handle)
         host.register_descriptor(tm)
         return handle
+
+    def eventfd_create(self, initval: int = 0, semaphore: bool = False) -> int:
+        """eventfd(2): counter descriptor (thread-pool wakeups in epoll)."""
+        from ..descriptor.eventfd import EventFD
+        host = self.host
+        handle = host.allocate_handle()
+        ev = EventFD(host, handle, initval, semaphore)
+        host.register_descriptor(ev)
+        return handle
+
+    def signalfd_create(self, mask: int) -> int:
+        """signalfd(2): virtual-signal queue descriptor for this process."""
+        from ..descriptor.signalfd import SignalFD
+        host = self.host
+        handle = host.allocate_handle()
+        sfd = SignalFD(host, handle, mask)
+        host.register_descriptor(sfd)
+        self.process._signal_fds.append(sfd)
+        return handle
+
+    def deliver_signal(self, signo: int) -> int:
+        """Route a virtual signal raised by this process (raise()/kill() on
+        the virtual pid): queue it on every open matching signalfd; returns
+        the match count (0 = caller may fall back to its recorded handler,
+        which is what the shim does)."""
+        live = [s for s in self.process._signal_fds if not s.closed]
+        self.process._signal_fds = live
+        return sum(1 for s in live if s.deliver(signo))
 
     def timerfd_settime(self, fd: int, initial_sec: float, interval_sec: float = 0.0) -> None:
         self._sock(fd).arm(stime.from_seconds(initial_sec),

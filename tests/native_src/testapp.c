@@ -828,9 +828,257 @@ static int cmd_xattr(const char *tag) {
   return xattr_done(file, dir, 0);
 }
 
+/* ----------------------------------------------------- torserver/client --
+ * The Tor-shaped dual-execution pair: everything a Tor-class daemon leans
+ * on at once — a multi-threaded epoll event loop whose epoll set contains
+ * a LISTEN socket, a SIGNALFD (SIGTERM shutdown), an EVENTFD (worker pool
+ * completion wakeups), and a TIMERFD (heartbeat) — plus a pthread worker
+ * pool consuming accepted connections from a mutex+condvar queue and
+ * echoing 512-byte cells.  The client runs a thread pool of sequential
+ * streams and finally raises the server's shutdown via a QUIT cell, which
+ * the handling WORKER thread converts to raise(SIGTERM) -> the signal
+ * lands in the main loop's signalfd.  Exit 0 is the oracle in both
+ * executions (reference: src/test/pthreads + src/test/signal + the epoll
+ * matrix, run together as one program the way tor itself would). */
+
+#include <pthread.h>
+#include <signal.h>
+#include <sys/eventfd.h>
+#include <sys/signalfd.h>
+#include <sys/timerfd.h>
+
+#define TOR_CELL 512
+#define TOR_DATA 1
+#define TOR_QUIT 2
+
+static struct {
+  int fds[256];
+  int head, tail, stop;
+  pthread_mutex_t mu;
+  pthread_cond_t cv;
+  int efd;
+  long served;      /* completed connections (mu-protected) */
+} g_pool;
+
+static void *tor_worker(void *arg) {
+  (void)arg;
+  char cell[TOR_CELL];
+  for (;;) {
+    pthread_mutex_lock(&g_pool.mu);
+    while (g_pool.head == g_pool.tail && !g_pool.stop)
+      pthread_cond_wait(&g_pool.cv, &g_pool.mu);
+    if (g_pool.head == g_pool.tail && g_pool.stop) {
+      pthread_mutex_unlock(&g_pool.mu);
+      return NULL;
+    }
+    int fd = g_pool.fds[g_pool.head % 256];
+    g_pool.head++;
+    pthread_mutex_unlock(&g_pool.mu);
+
+    int quit = 0;
+    for (;;) {
+      size_t got = 0;
+      while (got < TOR_CELL) {
+        ssize_t r = recv(fd, cell + got, TOR_CELL - got, 0);
+        if (r <= 0) goto conn_done;
+        got += (size_t)r;
+      }
+      uint32_t type;
+      memcpy(&type, cell, 4);
+      if (type == TOR_QUIT) { quit = 1; goto conn_done; }
+      size_t sent = 0;          /* echo the cell (relay hop) */
+      while (sent < TOR_CELL) {
+        ssize_t w = send(fd, cell + sent, TOR_CELL - sent, 0);
+        if (w <= 0) goto conn_done;
+        sent += (size_t)w;
+      }
+    }
+  conn_done:
+    close(fd);
+    pthread_mutex_lock(&g_pool.mu);
+    g_pool.served++;
+    pthread_mutex_unlock(&g_pool.mu);
+    uint64_t one = 1;           /* wake the event loop */
+    if (write(g_pool.efd, &one, 8) != 8) return NULL;
+    /* worker-thread shutdown request.  Process-directed kill, NOT raise():
+     * raise targets the calling THREAD, and a thread-pending signal never
+     * reaches a signalfd (real-kernel semantics; the sim routes both the
+     * same way, so the native leg is the stricter oracle here). */
+    if (quit) kill(getpid(), SIGTERM);
+  }
+}
+
+static int cmd_torserver(uint16_t port, int nworkers, long expect_conns) {
+  memset(&g_pool, 0, sizeof g_pool);
+  pthread_mutex_init(&g_pool.mu, NULL);
+  pthread_cond_init(&g_pool.cv, NULL);
+
+  sigset_t mask;
+  sigemptyset(&mask);
+  sigaddset(&mask, SIGTERM);
+  if (sigprocmask(SIG_BLOCK, &mask, NULL) != 0) return 10;
+  int sfd = signalfd(-1, &mask, SFD_NONBLOCK);
+  if (sfd < 0) return 11;
+  g_pool.efd = eventfd(0, EFD_NONBLOCK);
+  if (g_pool.efd < 0) return 12;
+  int tfd = timerfd_create(CLOCK_MONOTONIC, TFD_NONBLOCK);
+  if (tfd < 0) return 13;
+  /* heartbeat: first expiry 1 ms (so even a fast native run observes a
+   * tick before shutdown), then every 200 ms */
+  struct itimerspec its = {{0, 200000000}, {0, 1000000}};
+  if (timerfd_settime(tfd, 0, &its, NULL) != 0) return 14;
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in sin;
+  memset(&sin, 0, sizeof sin);
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = htonl(INADDR_ANY);
+  sin.sin_port = htons(port);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  if (bind(lfd, (struct sockaddr *)&sin, sizeof sin) != 0) return 15;
+  if (listen(lfd, 64) != 0) return 16;
+  fcntl(lfd, F_SETFL, O_NONBLOCK);   /* drain-accept loop needs EAGAIN */
+
+  pthread_t th[32];
+  if (nworkers > 32) nworkers = 32;
+  for (int i = 0; i < nworkers; i++)
+    if (pthread_create(&th[i], NULL, tor_worker, NULL) != 0) return 17;
+
+  int ep = epoll_create1(0);
+  struct epoll_event ev, evs[16];
+  ev.events = EPOLLIN; ev.data.fd = lfd;
+  epoll_ctl(ep, EPOLL_CTL_ADD, lfd, &ev);
+  ev.data.fd = sfd; epoll_ctl(ep, EPOLL_CTL_ADD, sfd, &ev);
+  ev.data.fd = g_pool.efd; epoll_ctl(ep, EPOLL_CTL_ADD, g_pool.efd, &ev);
+  ev.data.fd = tfd; epoll_ctl(ep, EPOLL_CTL_ADD, tfd, &ev);
+
+  long wakeups = 0, ticks = 0;
+  int term = 0;
+  while (!term) {
+    int n = epoll_wait(ep, evs, 16, 30000);
+    if (n <= 0) return 18;
+    for (int i = 0; i < n; i++) {
+      int fd = evs[i].data.fd;
+      if (fd == lfd) {
+        int cfd;
+        while ((cfd = accept(lfd, NULL, NULL)) >= 0) {
+          pthread_mutex_lock(&g_pool.mu);
+          g_pool.fds[g_pool.tail % 256] = cfd;
+          g_pool.tail++;
+          pthread_cond_signal(&g_pool.cv);
+          pthread_mutex_unlock(&g_pool.mu);
+        }
+      } else if (fd == g_pool.efd) {
+        uint64_t val;
+        if (read(g_pool.efd, &val, 8) == 8) wakeups += (long)val;
+      } else if (fd == tfd) {
+        uint64_t exp;
+        if (read(tfd, &exp, 8) == 8) ticks += (long)exp;
+      } else if (fd == sfd) {
+        struct signalfd_siginfo si;
+        if (read(sfd, &si, sizeof si) != sizeof si) return 19;
+        if (si.ssi_signo != SIGTERM) return 20;
+        term = 1;
+      }
+    }
+  }
+  /* graceful shutdown: stop the pool, join, audit */
+  pthread_mutex_lock(&g_pool.mu);
+  g_pool.stop = 1;
+  pthread_cond_broadcast(&g_pool.cv);
+  pthread_mutex_unlock(&g_pool.mu);
+  for (int i = 0; i < nworkers; i++) pthread_join(th[i], NULL);
+  if (g_pool.served < expect_conns + 1) return 21;  /* +1 = the QUIT conn */
+  if (wakeups < expect_conns) return 22;
+  if (ticks < 1) return 23;
+  return 0;
+}
+
+static int tor_send_cell(int fd, uint32_t type, uint32_t seq) {
+  char cell[TOR_CELL];
+  memset(cell, 0, sizeof cell);
+  memcpy(cell, &type, 4);
+  memcpy(cell + 4, &seq, 4);
+  memset(cell + 8, (int)('a' + (seq % 26)), TOR_CELL - 8);
+  size_t sent = 0;
+  while (sent < TOR_CELL) {
+    ssize_t w = send(fd, cell + sent, TOR_CELL - sent, 0);
+    if (w <= 0) return -1;
+    sent += (size_t)w;
+  }
+  return 0;
+}
+
+static struct {
+  struct sockaddr_in dst;
+  int streams, cells;
+  int failed;
+} g_cli;
+
+static void *tor_client_thread(void *arg) {
+  (void)arg;
+  char cell[TOR_CELL];
+  for (int s = 0; s < g_cli.streams; s++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0 || connect(fd, (struct sockaddr *)&g_cli.dst,
+                          sizeof g_cli.dst) != 0) {
+      g_cli.failed = 1;
+      if (fd >= 0) close(fd);
+      return NULL;
+    }
+    for (int c = 0; c < g_cli.cells; c++) {
+      if (tor_send_cell(fd, TOR_DATA, (uint32_t)c) != 0) { g_cli.failed = 1; break; }
+      size_t got = 0;
+      while (got < TOR_CELL) {
+        ssize_t r = recv(fd, cell + got, TOR_CELL - got, 0);
+        if (r <= 0) { g_cli.failed = 1; break; }
+        got += (size_t)r;
+      }
+      if (got != TOR_CELL) break;
+      uint32_t seq;
+      memcpy(&seq, cell + 4, 4);
+      if (seq != (uint32_t)c || cell[8] != (char)('a' + (c % 26))) {
+        g_cli.failed = 1;
+        break;
+      }
+    }
+    close(fd);
+    if (g_cli.failed) return NULL;
+  }
+  return NULL;
+}
+
+static int cmd_torclient(const char *host, uint16_t port, int nthreads,
+                         int streams, int cells) {
+  memset(&g_cli, 0, sizeof g_cli);
+  if (resolve(host, port, &g_cli.dst) != 0) return 30;
+  g_cli.streams = streams;
+  g_cli.cells = cells;
+  pthread_t th[32];
+  if (nthreads > 32) nthreads = 32;
+  for (int i = 0; i < nthreads; i++)
+    if (pthread_create(&th[i], NULL, tor_client_thread, NULL) != 0) return 31;
+  for (int i = 0; i < nthreads; i++) pthread_join(th[i], NULL);
+  if (g_cli.failed) return 32;
+  /* shut the server down */
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0 || connect(fd, (struct sockaddr *)&g_cli.dst,
+                        sizeof g_cli.dst) != 0) return 33;
+  if (tor_send_cell(fd, TOR_QUIT, 0) != 0) return 34;
+  close(fd);
+  return 0;
+}
+
 int main(int argc, char **argv) {
   if (argc < 2) return 64;
   const char *cmd = argv[1];
+  if (!strcmp(cmd, "torserver") && argc >= 5)
+    return cmd_torserver((uint16_t)atoi(argv[2]), atoi(argv[3]),
+                         atol(argv[4]));
+  if (!strcmp(cmd, "torclient") && argc >= 7)
+    return cmd_torclient(argv[2], (uint16_t)atoi(argv[3]), atoi(argv[4]),
+                         atoi(argv[5]), atoi(argv[6]));
   if (!strcmp(cmd, "xattrcheck") && argc >= 3) return cmd_xattr(argv[2]);
   if (!strcmp(cmd, "files") && argc >= 3) return cmd_files(argv[2]);
   if (!strcmp(cmd, "vtime")) return cmd_vtime();
