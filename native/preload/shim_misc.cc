@@ -131,6 +131,11 @@ extern "C" int system(const char *cmd) {
 
 static sighandler_t g_sig_handlers[65];
 static int g_sig_siginfo[65];     /* SA_SIGINFO recorded per signal */
+static uint64_t g_blocked_mask;   /* process-level approximation of the
+                                     sigprocmask-blocked set */
+static uint64_t g_pending_mask;   /* blocked self-signals awaiting unblock */
+
+static void shd_deliver_local(int sig);   /* fwd decl (used on unblock) */
 
 extern "C" sighandler_t signal(int signum, sighandler_t handler) {
   static sighandler_t (*real_signal)(int, sighandler_t);
@@ -138,7 +143,9 @@ extern "C" sighandler_t signal(int signum, sighandler_t handler) {
   if (!shd_active()) return real_signal(signum, handler);
   if (signum < 1 || signum > 64) { errno = EINVAL; return SIG_ERR; }
   sighandler_t old = g_sig_handlers[signum];
-  g_sig_handlers[signum] = handler;   /* recorded, never delivered */
+  /* recorded; SELF-directed kill()/raise() below delivers these when no
+   * signalfd matches (external signals are still never injected) */
+  g_sig_handlers[signum] = handler;
   return old;
 }
 
@@ -185,28 +192,44 @@ extern "C" int kill(pid_t pid, int sig) {
                                  NULL, 0, NULL);
   if (matched < 0) { errno = EINVAL; return -1; }
   if (matched == 0) {
-    sighandler_t h = g_sig_handlers[sig];
-    if (h != SIG_DFL && h != SIG_IGN) {
-      if (g_sig_siginfo[sig]) {
-        /* SA_SIGINFO: three-arg form with a zeroed siginfo (the only
-         * in-sim sender is the process itself) */
-        siginfo_t si;
-        memset(&si, 0, sizeof si);
-        si.si_signo = sig;
-        si.si_pid = getpid();
-        ((void (*)(int, siginfo_t *, void *))h)(sig, &si, NULL);
-      } else {
-        h(sig);
-      }
-    } else if (h == SIG_DFL &&
-               (sig == SIGTERM || sig == SIGINT || sig == SIGQUIT ||
-                sig == SIGKILL || sig == SIGHUP)) {
-      /* kernel default action: terminate WITHOUT atexit/stdio flushing
-       * (exit() would run both and diverge from the native leg) */
-      _exit(128 + sig);
+    if (g_blocked_mask >> (sig - 1) & 1) {
+      /* blocked and no signalfd consumed it: stays pending (kernel
+       * semantics) — delivered when sigprocmask unblocks it */
+      g_pending_mask |= (uint64_t)1 << (sig - 1);
+      return 0;
     }
+    shd_deliver_local(sig);
   }
   return 0;
+}
+
+static void shd_deliver_local(int sig) {
+  sighandler_t h = g_sig_handlers[sig];
+  if (h != SIG_DFL && h != SIG_IGN) {
+    if (g_sig_siginfo[sig]) {
+      /* SA_SIGINFO: three-arg form with a zeroed siginfo (the only
+       * in-sim sender is the process itself) */
+      siginfo_t si;
+      memset(&si, 0, sizeof si);
+      si.si_signo = sig;
+      si.si_pid = getpid();
+      ((void (*)(int, siginfo_t *, void *))h)(sig, &si, NULL);
+    } else {
+      h(sig);
+    }
+    return;
+  }
+  if (h == SIG_IGN) return;
+  /* SIG_DFL: the kernel's default action is Terminate for everything
+   * except the Ign set (CHLD/URG/WINCH) and the job-control stops, which
+   * a single-process simulation treats as no-ops.  Terminate WITHOUT
+   * atexit/stdio flushing (exit() would run both and diverge from the
+   * native leg of dual execution). */
+  if (sig == SIGCHLD || sig == SIGURG || sig == SIGWINCH ||
+      sig == SIGCONT || sig == SIGSTOP || sig == SIGTSTP ||
+      sig == SIGTTIN || sig == SIGTTOU)
+    return;
+  _exit(128 + sig);
 }
 
 extern "C" int raise(int sig) {
@@ -216,12 +239,40 @@ extern "C" int raise(int sig) {
   return kill(getpid(), sig) == 0 ? 0 : sig;
 }
 
+/* One process-level mask (a deliberate approximation of per-thread masks:
+ * the cooperative-thread plane has no preemption, and signalfd consumers
+ * block process-wide anyway).  Unblocking releases pending self-signals. */
+static int shd_apply_mask(int how, const sigset_t *set, sigset_t *oldset) {
+  if (oldset) {
+    sigemptyset(oldset);
+    for (int s = 1; s <= 64; s++)
+      if (g_blocked_mask >> (s - 1) & 1) sigaddset(oldset, s);
+  }
+  if (!set) return 0;
+  uint64_t bits = 0;
+  for (int s = 1; s <= 64; s++)
+    if (sigismember(set, s) == 1) bits |= (uint64_t)1 << (s - 1);
+  if (how == SIG_BLOCK) g_blocked_mask |= bits;
+  else if (how == SIG_UNBLOCK) g_blocked_mask &= ~bits;
+  else if (how == SIG_SETMASK) g_blocked_mask = bits;
+  else { errno = EINVAL; return -1; }
+  uint64_t release = g_pending_mask & ~g_blocked_mask;
+  for (int s = 1; s <= 64 && release; s++) {
+    uint64_t bit = (uint64_t)1 << (s - 1);
+    if (release & bit) {
+      g_pending_mask &= ~bit;
+      release &= ~bit;
+      shd_deliver_local(s);
+    }
+  }
+  return 0;
+}
+
 extern "C" int sigprocmask(int how, const sigset_t *set, sigset_t *oldset) {
   static int (*real_spm)(int, const sigset_t *, sigset_t *);
   if (!real_spm) *(void **)(&real_spm) = dlsym(RTLD_NEXT, "sigprocmask");
   if (!shd_active()) return real_spm(how, set, oldset);
-  if (oldset) sigemptyset(oldset);
-  return 0;
+  return shd_apply_mask(how, set, oldset);
 }
 
 extern "C" int pthread_sigmask(int how, const sigset_t *set,
@@ -231,8 +282,7 @@ extern "C" int pthread_sigmask(int how, const sigset_t *set,
     if (!real_psm) *(void **)(&real_psm) = dlsym(RTLD_NEXT, "pthread_sigmask");
     return real_psm(how, set, oldset);
   }
-  if (oldset) sigemptyset(oldset);
-  return 0;
+  return shd_apply_mask(how, set, oldset);
 }
 
 /* ------------------------------------------------------------ getifaddrs -- */

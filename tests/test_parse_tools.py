@@ -49,6 +49,48 @@ def test_parse_log_summarizes_a_real_run():
     assert stripped and not any("wall=" in l for l in stripped)
 
 
+def test_plot_log_renders_real_run(tmp_path):
+    """plot-shadow.py analog: a real run's log renders to non-empty PNGs
+    (throughput panels + engine-heartbeat panels)."""
+    import pytest
+    pytest.importorskip("matplotlib")
+    xml = textwrap.dedent("""\
+        <shadow stoptime="130">
+          <plugin id="echo" path="python:echo" />
+          <host id="server" heartbeatfrequency="30">
+            <process plugin="echo" starttime="1" arguments="udp server 9000" />
+          </host>
+          <host id="client" heartbeatfrequency="30">
+            <process plugin="echo" starttime="2"
+                     arguments="udp client server 9000 200 500 0.5" />
+          </host>
+        </shadow>
+    """)
+    buf = io.StringIO()
+    set_logger(SimLogger(level="message", stream=buf))
+    try:
+        cfg = configuration.parse_xml(xml)
+        ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                                  stop_time_sec=cfg.stop_time_sec), cfg)
+        # force an engine heartbeat line regardless of wall speed
+        ctrl.engine.heartbeat_wall_interval = 0.0
+        assert ctrl.run() == 0
+        get_logger().flush()
+    finally:
+        set_logger(SimLogger())
+    lines = buf.getvalue().splitlines()
+    from shadow_tpu.tools.plot_log import engine_heartbeats, plot_heartbeats
+    from shadow_tpu.tools.parse_log import plot_log
+    out = tmp_path / "tp.png"
+    assert plot_log(lines, str(out))
+    assert out.stat().st_size > 1000
+    hbs = engine_heartbeats(lines)
+    assert hbs and all(h["maxrss_mb"] > 0 for h in hbs)
+    hb_out = tmp_path / "hb.png"
+    assert plot_heartbeats(lines, str(hb_out))
+    assert hb_out.stat().st_size > 1000
+
+
 def test_workload_generator_configs_parse():
     """Every named benchmark config the generator emits is loadable by the
     configuration layer (tor10k only when the reference topology exists)."""
