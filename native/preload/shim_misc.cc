@@ -188,17 +188,21 @@ extern "C" int kill(pid_t pid, int sig) {
   if (pid != 0 && pid != getpid()) { errno = EPERM; return -1; }
   if (sig == 0) return 0;               /* existence probe */
   if (sig < 1 || sig > 64) { errno = EINVAL; return -1; }
+  if (!(g_blocked_mask >> (sig - 1) & 1)) {
+    /* unblocked: normal delivery — handler or default action.  A
+     * signalfd only ever receives BLOCKED signals (signalfd(2)); routing
+     * an unblocked one there would let a process that forgot the
+     * sigprocmask step survive a fatal signal it dies from natively. */
+    shd_deliver_local(sig);
+    return 0;
+  }
   int64_t matched = shd_transact(SHD_OP_KILL, sig, 0, 0, 0, NULL, 0,
                                  NULL, 0, NULL);
   if (matched < 0) { errno = EINVAL; return -1; }
   if (matched == 0) {
-    if (g_blocked_mask >> (sig - 1) & 1) {
-      /* blocked and no signalfd consumed it: stays pending (kernel
-       * semantics) — delivered when sigprocmask unblocks it */
-      g_pending_mask |= (uint64_t)1 << (sig - 1);
-      return 0;
-    }
-    shd_deliver_local(sig);
+    /* blocked and no signalfd consumed it: stays pending (kernel
+     * semantics) — delivered when sigprocmask unblocks it */
+    g_pending_mask |= (uint64_t)1 << (sig - 1);
   }
   return 0;
 }
@@ -282,7 +286,8 @@ extern "C" int pthread_sigmask(int how, const sigset_t *set,
     if (!real_psm) *(void **)(&real_psm) = dlsym(RTLD_NEXT, "pthread_sigmask");
     return real_psm(how, set, oldset);
   }
-  return shd_apply_mask(how, set, oldset);
+  /* POSIX: pthread_sigmask returns the error NUMBER (no errno) */
+  return shd_apply_mask(how, set, oldset) == 0 ? 0 : EINVAL;
 }
 
 /* ------------------------------------------------------------ getifaddrs -- */

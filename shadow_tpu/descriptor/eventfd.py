@@ -50,14 +50,14 @@ class EventFD(Descriptor):
     def write_value(self, val: int):
         """One write(2): True if accepted, False if it would block,
         None for EINVAL (value 0xFFFFFFFFFFFFFFFF is never writable —
-        eventfd(2)).  A would-block deasserts S_WRITABLE so a parked
-        writer's level-triggered wait actually suspends until a read
-        drains the counter (read_value re-asserts it)."""
+        eventfd(2)).  S_WRITABLE stays asserted while counter < max
+        (kernel POLLOUT semantics: a write of 1 would succeed) — a
+        blocked LARGE write therefore can't wait on the status bit; the
+        RPC layer retries it on a virtual-time tick instead."""
         val = int(val)
         if val < 0 or val > EFD_MAX:
             return None
         if self.counter + val > EFD_MAX:
-            self.adjust_status(S_WRITABLE, False)
             return False
         if val:
             self.counter += val

@@ -350,7 +350,7 @@ def build_sharded_layout(flow_node, flow_lat, flow_succ, seg_start,
 
     node_p = np.asarray(flow_node)[src]
     lat_p = np.asarray(flow_lat)[src]
-    lat_p[~keep] = 0
+    lat_p[~keep] = 0        # diagnostic copy only; the kernel reads arr_lat
     succ_orig = np.asarray(flow_succ)[src]
     succ_p = np.where((succ_orig >= 0) & keep, inv[np.maximum(succ_orig, 0)],
                       -1)
@@ -379,6 +379,7 @@ def build_sharded_layout(flow_node, flow_lat, flow_succ, seg_start,
     h_pad = max(h_locals) if h_locals else 1
     refill_p = np.zeros(n_shards * h_pad, dtype=np.int64)
     capacity_p = np.zeros(n_shards * h_pad, dtype=np.int64)
+    node_src = np.full(n_shards * h_pad, -1, dtype=np.int64)
     for s in range(n_shards):
         lo = s * pad
         k = keep[lo:lo + pad]
@@ -387,6 +388,7 @@ def build_sharded_layout(flow_node, flow_lat, flow_succ, seg_start,
         refill_p[s * h_pad:s * h_pad + len(uniq)] = np.asarray(refill)[uniq]
         capacity_p[s * h_pad:s * h_pad + len(uniq)] = \
             np.asarray(capacity)[uniq]
+        node_src[s * h_pad:s * h_pad + len(uniq)] = uniq
         node_local[lo + int(k.sum()):lo + pad] = h_pad - 1
     # padding rows point at the shard's last local node; they never serve
     # (queued stays 0) so sharing a real node's bucket is harmless
@@ -399,6 +401,7 @@ def build_sharded_layout(flow_node, flow_lat, flow_succ, seg_start,
         "flow_node_local": node_local, "flow_lat": lat_p,
         "succ_global": succ_p, "seg_start_local": seg_local,
         "refill": refill_p, "capacity": capacity_p, "h_pad": h_pad,
+        "node_src": node_src,    # padded local-node slot -> global node
         "arr_lat": arr_lat,
         "shard_base": (np.arange(n_shards, dtype=np.int64) * pad),
     }
@@ -428,7 +431,7 @@ def make_torcells_sharded_window(mesh, axis: str, ring_len: int):
 
     def step(t0, queued, ring, tokens, delivered, target, done_tick,
              node_sent, inject, inject_target, n_ticks, idle_ticks,
-             flow_node_local, flow_lat, succ_global, seg_start_local,
+             flow_node_local, succ_global, seg_start_local,
              refill, capacity, arr_lat, shard_base):
         """All [*] args sharded on ``axis`` except ring/arr_lat (replicated)
         and scalars.  flow_node_local/seg_start_local are LOCAL indices;
@@ -436,7 +439,7 @@ def make_torcells_sharded_window(mesh, axis: str, ring_len: int):
 
         def shard_body(t0, queued, ring, tokens, delivered, target,
                        done_tick, node_sent, inject, inject_target,
-                       n_ticks, idle_ticks, flow_node_local, flow_lat,
+                       n_ticks, idle_ticks, flow_node_local,
                        succ_global, seg_start_local, refill, capacity,
                        arr_lat, shard_base):
             # NOTE: the tick body must close over THESE (per-shard) tables —
@@ -505,14 +508,14 @@ def make_torcells_sharded_window(mesh, axis: str, ring_len: int):
             shard_body, mesh=mesh,
             in_specs=(repl, sharded, repl, sharded, sharded, sharded,
                       sharded, sharded, sharded, sharded, repl, repl,
-                      sharded, sharded, sharded, sharded, sharded, sharded,
+                      sharded, sharded, sharded, sharded, sharded,
                       repl, sharded),
             out_specs=(repl, sharded, repl, sharded, sharded, sharded,
                        sharded, sharded, repl),
             check_rep=False)(
             t0, queued, ring, tokens, delivered, target, done_tick,
             node_sent, inject, inject_target, n_ticks, idle_ticks,
-            flow_node_local, flow_lat, succ_global, seg_start_local,
+            flow_node_local, succ_global, seg_start_local,
             refill, capacity, arr_lat, shard_base)
 
     return jax.jit(step, static_argnames=())

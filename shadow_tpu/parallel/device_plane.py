@@ -119,11 +119,25 @@ class DeviceTrafficPlane:
         # parity-comparable.
         self.min_dispatch_steps = max(
             1, int(getattr(engine.options, "device_plane_batch_steps", 4)))
+        self._mesh = None
+        self._shard = None           # layout dict when sharded
+        self._sharded_step = None
         self.specs = specs
         for i, s in enumerate(specs):
             s.circuit = i
         self._by_client = {s.client_name: s for s in specs}
         self._build_layout(engine)
+        # multi-chip: shard the flow table over a device mesh (same
+        # --tpu-devices axis the scheduler policy scales on).  Exact — see
+        # ops/torcells_device.build_sharded_layout; state/API stay in the
+        # ORIGINAL flow space, translated at the dispatch boundary.
+        if mode == "device":
+            n_dev = int(getattr(engine.options, "tpu_devices", 1) or 0)
+            if n_dev == 0:
+                import jax
+                n_dev = len(jax.devices())
+            if n_dev > 1:
+                self._setup_sharding(n_dev)
         self._state = None           # lazy: built at first activation
         self._inflight = False
         self._ticks_synced = 0
@@ -251,12 +265,18 @@ class DeviceTrafficPlane:
 
     # -- state ------------------------------------------------------------
     def _init_state(self):
-        f, h = self.n_flows, self.n_nodes
+        if self._shard is not None:
+            f = len(self._shard["src"])
+            h = len(self._shard["refill"])
+            tokens0 = self._shard["capacity"]
+        else:
+            f, h = self.n_flows, self.n_nodes
+            tokens0 = self.capacity_step
         zeros_f = np.zeros(f, dtype=np.int64)
         state = (np.int64(self._ticks_synced),
                  zeros_f.copy(),                                   # queued
                  np.zeros((self.ring_len, f), dtype=np.int64),     # ring
-                 self.capacity_step.copy(),                        # tokens
+                 tokens0.copy(),                                   # tokens
                  zeros_f.copy(),                                   # delivered
                  zeros_f.copy(),                                   # target
                  np.full(f, -1, dtype=np.int64),                   # done_tick
@@ -266,8 +286,53 @@ class DeviceTrafficPlane:
             state = tuple(jnp.asarray(a) for a in state)
         self._state = state
         self._flow_args_cached = None
-        self._prev_node_sent = np.zeros(h, dtype=np.int64)
-        self._prev_delivered = np.zeros(f, dtype=np.int64)
+        self._prev_node_sent = np.zeros(self.n_nodes, dtype=np.int64)
+        self._prev_delivered = np.zeros(self.n_flows, dtype=np.int64)
+
+    def _setup_sharding(self, n_dev: int) -> None:
+        import jax
+        from jax.sharding import Mesh
+        from ..ops.torcells_device import (build_sharded_layout,
+                                           make_torcells_sharded_window)
+        pool = jax.devices()
+        if len(pool) < n_dev:
+            try:
+                cpu_pool = jax.devices("cpu")
+            except RuntimeError:
+                cpu_pool = []
+            if len(cpu_pool) >= n_dev:
+                pool = cpu_pool
+        devices = pool[:n_dev]
+        if len(devices) < n_dev:
+            raise RuntimeError(
+                f"device plane: --tpu-devices={n_dev} but only "
+                f"{len(pool)} present")
+        self._mesh = Mesh(np.array(devices), axis_names=("flows",))
+        self._shard = build_sharded_layout(
+            self.flow_node, self.flow_lat_steps, self.flow_succ,
+            self.seg_start, self.refill_step, self.capacity_step, n_dev)
+        self._sharded_step = make_torcells_sharded_window(
+            self._mesh, "flows", self.ring_len)
+        get_logger().message(
+            "device-plane",
+            f"flow table sharded over {n_dev} devices "
+            f"(pad {self._shard['pad']} flows/shard, "
+            f"{self._shard['h_pad']} nodes/shard)")
+
+    def _read_summaries(self):
+        """(delivered, done_tick, node_sent) in the ORIGINAL flow/node
+        space, whatever the execution layout."""
+        delivered = np.asarray(self._state[4])
+        done_tick = np.asarray(self._state[6])
+        node_sent = np.asarray(self._state[7])
+        if self._shard is None:
+            return delivered, done_tick, node_sent
+        inv = self._shard["inv"]
+        node_src = self._shard["node_src"]
+        global_sent = np.zeros(self.n_nodes, dtype=np.int64)
+        valid = node_src >= 0
+        np.add.at(global_sent, node_src[valid], node_sent[valid])
+        return delivered[inv], done_tick[inv], global_sent
 
     def _flow_args(self):
         """The static flow tables, resident where the kernel runs: committed
@@ -312,6 +377,22 @@ class DeviceTrafficPlane:
             return
         import jax.numpy as jnp
         from ..ops.torcells_device import torcells_step_window
+        if self._shard is not None:
+            lay = self._shard
+            fp, hp = len(lay["src"]), len(lay["refill"])
+            zp = np.zeros(fp, dtype=np.int64)
+            state = (np.int64(0), jnp.zeros(fp, jnp.int64),
+                     jnp.zeros((self.ring_len, fp), jnp.int64),
+                     jnp.asarray(lay["capacity"]),
+                     jnp.zeros(fp, jnp.int64), jnp.zeros(fp, jnp.int64),
+                     jnp.full(fp, -1, jnp.int64), jnp.zeros(hp, jnp.int64))
+            out = self._sharded_step(
+                *state, zp, zp, np.int64(1), np.int64(0),
+                lay["flow_node_local"], lay["succ_global"],
+                lay["seg_start_local"], lay["refill"], lay["capacity"],
+                lay["arr_lat"], lay["shard_base"])
+            np.asarray(out[8])
+            return
         f, h = self.n_flows, self.n_nodes
         z = np.zeros(f, dtype=np.int64)
         state = (np.int64(0), jnp.zeros(f, jnp.int64),
@@ -375,17 +456,27 @@ class DeviceTrafficPlane:
         # be skipped or re-read — caught by an adversarial review repro and
         # now pinned by test_varying_dispatch_sizes_preserve_arrivals.)
         state = (np.int64(self._ticks_synced), *self._state[1:])
-        flow_args = self._flow_args()
-        if self.mode == "device":
+        if self._shard is not None:
+            from ..ops.torcells_device import pad_state
+            lay = self._shard
+            out = self._sharded_step(
+                *state, pad_state(lay, inject), pad_state(lay, inject_target),
+                np.int64(n), np.int64(idle), lay["flow_node_local"],
+                lay["succ_global"], lay["seg_start_local"],
+                lay["refill"], lay["capacity"], lay["arr_lat"],
+                lay["shard_base"])
+        elif self.mode == "device":
             from ..ops.torcells_device import torcells_step_window
             out = torcells_step_window(*state, inject, inject_target,
                                        np.int64(n), np.int64(idle),
-                                       *flow_args, ring_len=self.ring_len)
+                                       *self._flow_args(),
+                                       ring_len=self.ring_len)
         else:
             from ..ops.torcells_device import torcells_step_window_numpy
             out = torcells_step_window_numpy(*state, inject,
                                             inject_target, n, idle,
-                                            *flow_args, self.ring_len)
+                                            *self._flow_args(),
+                                            self.ring_len)
         self._state = out[:8]
         self._forwards_handle = out[8]
         self._ticks_synced = target_ticks
@@ -402,9 +493,7 @@ class DeviceTrafficPlane:
             return
         import time as _wt
         t0 = _wt.perf_counter_ns()
-        delivered = np.asarray(self._state[4])
-        done_tick = np.asarray(self._state[6])
-        node_sent = np.asarray(self._state[7])
+        delivered, done_tick, node_sent = self._read_summaries()
         self.total_forwards += int(np.asarray(self._forwards_handle))
         self._cells_delivered_seen = int(delivered[self.last_flow].sum())
         self._inflight = False

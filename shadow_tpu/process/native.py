@@ -419,7 +419,10 @@ class NativeKernel:
                     return 8, b""
                 if self._nonblock(desc) or bool(b):
                     return -errno_mod.EAGAIN, b""
-                yield _Block(desc, S_WRITABLE)
+                # can't park on S_WRITABLE: POLLOUT stays asserted while
+                # counter < max even though THIS (large) value won't fit
+                # (eventfd(2)); retry each refill tick of virtual time
+                yield _Sleep(1_000_000)
         r = yield from self.op_send(a, b, c, d, payload)
         return r
 

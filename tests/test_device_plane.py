@@ -148,11 +148,42 @@ def test_windowed_kernel_split_and_idle_invariance():
 # ---------------------------------------------------------------------------
 
 def test_engine_device_vs_numpy_plane_digest_parity():
+    # NOTE: under the 8-virtual-device test mesh, mode="device" runs the
+    # SHARDED layout by default (tpu_devices=0 -> all local devices), so
+    # this is simultaneously the sharded-engine vs single-host-twin gate.
     a = _run(mode="device")
     b = _run(mode="numpy")
+    assert a.engine.device_plane._shard is not None, \
+        "expected the sharded layout under the 8-device test mesh"
     assert state_digest(a.engine) == state_digest(b.engine)
     assert a.engine.device_plane.stats()["forwards"] == \
         b.engine.device_plane.stats()["forwards"]
+
+
+def test_engine_sharded_vs_single_device_plane_digest_parity():
+    """Force single-device layout (tpu_devices=1) and compare against the
+    default sharded run: identical digests — multichip is semantics-free."""
+    from shadow_tpu.core import configuration
+    from shadow_tpu.core.options import Options
+    from shadow_tpu.core.controller import Controller
+
+    def run(n_dev):
+        cfg = configuration.parse_xml(workloads.tor_network(
+            8, n_clients=5, n_servers=2, stoptime=60,
+            stream_spec="512:20200", device_data=True))
+        cfg.stop_time_sec = 60
+        ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                                  seed=3, stop_time_sec=60,
+                                  log_level="warning", tpu_devices=n_dev),
+                          cfg)
+        assert ctrl.run() == 0
+        return ctrl
+
+    single = run(1)
+    sharded = run(8)
+    assert single.engine.device_plane._shard is None
+    assert sharded.engine.device_plane._shard is not None
+    assert state_digest(single.engine) == state_digest(sharded.engine)
 
 
 def test_engine_policy_parity_with_device_plane():
@@ -169,7 +200,7 @@ def test_cell_conservation_and_completion():
     # each injected cell is forwarded exactly once per stage (5 stages)
     assert st["forwards"] == st["injected_cells"] * 5
     plane = ctrl.engine.device_plane
-    delivered = np.asarray(plane._state[4])
+    delivered, _done, _sent = plane._read_summaries()
     assert int(delivered[plane.last_flow].sum()) == st["injected_cells"]
 
 
@@ -240,7 +271,7 @@ def test_sharded_windowed_kernel_bit_parity():
               lay["capacity"].copy(), to_padded(np.zeros(f)),
               to_padded(np.zeros(f)), np.full(fp, -1, np.int64),
               np.zeros(len(lay["refill"]), np.int64))
-    static = (lay["flow_node_local"], lay["flow_lat"], lay["succ_global"],
+    static = (lay["flow_node_local"], lay["succ_global"],
               lay["seg_start_local"], lay["refill"], lay["capacity"],
               lay["arr_lat"], lay["shard_base"])
     zp = np.zeros(fp, np.int64)
