@@ -153,10 +153,33 @@ extern "C" int stat(const char *path, struct stat *st) {
   return real_stat(rpath, st);
 }
 
+/* Shim-created absolute symlinks store their target vfs-RESOLVED (see
+ * symlink below); readlink reverse-maps it, so lstat-family st_size must
+ * report the matching app-visible length or the standard
+ * lstat-then-readlink idiom (ret == st_size) breaks on every such link. */
+static void shd_fix_link_size(const char *rpath, long long *size) {
+  if (!shd_active() || !g_vroot_len) return;
+  static ssize_t (*rl)(const char *, char *, size_t);
+  if (!rl) *(void **)(&rl) = dlsym(RTLD_NEXT, "readlink");
+  char tmp[4096], prefix[3100];
+  ssize_t n = rl(rpath, tmp, sizeof tmp);
+  if (n <= 0) return;
+  int plen = snprintf(prefix, sizeof prefix, "%s/vfs", g_vroot);
+  if (plen > 0 && n > plen && strncmp(tmp, prefix, (size_t)plen) == 0 &&
+      tmp[plen] == '/')
+    *size = (long long)(n - plen);
+}
+
 extern "C" int lstat(const char *path, struct stat *st) {
   REALF(int, lstat, const char *, struct stat *);
   RESOLVE(path, 0);
-  return real_lstat(rpath, st);
+  int r = real_lstat(rpath, st);
+  if (r == 0 && S_ISLNK(st->st_mode)) {
+    long long sz = (long long)st->st_size;
+    shd_fix_link_size(rpath, &sz);
+    st->st_size = (off_t)sz;
+  }
+  return r;
 }
 
 extern "C" int fstatat(int dirfd, const char *path, struct stat *st,
@@ -164,7 +187,13 @@ extern "C" int fstatat(int dirfd, const char *path, struct stat *st,
   REALF(int, fstatat, int, const char *, struct stat *, int);
   if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
     RESOLVE(path, 0);
-    return real_fstatat(dirfd, rpath, st, flags);
+    int r = real_fstatat(dirfd, rpath, st, flags);
+    if (r == 0 && (flags & AT_SYMLINK_NOFOLLOW) && S_ISLNK(st->st_mode)) {
+      long long sz = (long long)st->st_size;
+      shd_fix_link_size(rpath, &sz);
+      st->st_size = (off_t)sz;
+    }
+    return r;
   }
   return real_fstatat(dirfd, path, st, flags);
 }
@@ -328,7 +357,13 @@ extern "C" int stat64(const char *path, struct stat64 *st) {
 extern "C" int lstat64(const char *path, struct stat64 *st) {
   REALF(int, lstat64, const char *, struct stat64 *);
   RESOLVE(path, 0);
-  return real_lstat64(rpath, st);
+  int r = real_lstat64(rpath, st);
+  if (r == 0 && S_ISLNK(st->st_mode)) {
+    long long sz = (long long)st->st_size;
+    shd_fix_link_size(rpath, &sz);
+    st->st_size = (off64_t)sz;
+  }
+  return r;
 }
 
 extern "C" int fstatat64(int dirfd, const char *path, struct stat64 *st,
@@ -380,7 +415,26 @@ extern "C" int statx(int dirfd, const char *path, int flags,
 extern "C" ssize_t readlink(const char *path, char *buf, size_t bufsiz) {
   REALF(ssize_t, readlink, const char *, char *, size_t);
   RESOLVE(path, 0);
-  return real_readlink(rpath, buf, bufsiz);
+  if (!shd_active() || !g_vroot_len)
+    return real_readlink(rpath, buf, bufsiz);
+  /* Reverse-map: symlink() stores absolute targets RESOLVED into the vfs
+   * tree (see below); reading them back must yield the app-visible path,
+   * not leak the <data-dir>/vfs prefix.  Read into a full-size local
+   * first so the prefix check can't be foiled by caller truncation. */
+  char tmp[4096];
+  ssize_t n = real_readlink(rpath, tmp, sizeof tmp);
+  if (n <= 0) return n;
+  char prefix[3100];
+  int plen = snprintf(prefix, sizeof prefix, "%s/vfs", g_vroot);
+  const char *out = tmp;
+  if (plen > 0 && n > plen && strncmp(tmp, prefix, (size_t)plen) == 0 &&
+      tmp[plen] == '/') {
+    out = tmp + plen;
+    n -= plen;
+  }
+  if ((size_t)n > bufsiz) n = (ssize_t)bufsiz;  /* readlink(2): truncate */
+  memcpy(buf, out, (size_t)n);
+  return n;
 }
 
 extern "C" int symlink(const char *target, const char *linkpath) {
@@ -403,6 +457,39 @@ extern "C" int link(const char *oldp, const char *newp) {
   const char *ro = shd_resolve_path(oldp, ob, sizeof ob, 0);
   const char *rn = shd_resolve_path(newp, nb, sizeof nb, 1);
   return real_link(ro, rn);
+}
+
+/* at-family variants: the resolvable cases (AT_FDCWD or absolute paths)
+ * route through the interposed base calls so they share the SAME
+ * namespace mapping and readlink reverse-map; true dirfd-relative forms
+ * pass through (dirfds were namespace-resolved at open). */
+extern "C" ssize_t readlinkat(int dirfd, const char *path, char *buf,
+                              size_t bufsiz) {
+  if (dirfd == AT_FDCWD || (path && path[0] == '/'))
+    return readlink(path, buf, bufsiz);
+  REALF(ssize_t, readlinkat, int, const char *, char *, size_t);
+  return real_readlinkat(dirfd, path, buf, bufsiz);
+}
+
+extern "C" int symlinkat(const char *target, int dirfd,
+                         const char *linkpath) {
+  if (dirfd == AT_FDCWD || (linkpath && linkpath[0] == '/'))
+    return symlink(target, linkpath);
+  REALF(int, symlinkat, const char *, int, const char *);
+  return real_symlinkat(target, dirfd, linkpath);
+}
+
+extern "C" int linkat(int olddirfd, const char *oldp, int newdirfd,
+                      const char *newp, int flags) {
+  REALF(int, linkat, int, const char *, int, const char *, int);
+  if ((olddirfd == AT_FDCWD || (oldp && oldp[0] == '/')) &&
+      (newdirfd == AT_FDCWD || (newp && newp[0] == '/'))) {
+    char ob[4096], nb[4096];
+    const char *ro = shd_resolve_path(oldp, ob, sizeof ob, 0);
+    const char *rn = shd_resolve_path(newp, nb, sizeof nb, 1);
+    return real_linkat(AT_FDCWD, ro, AT_FDCWD, rn, flags);
+  }
+  return real_linkat(olddirfd, oldp, newdirfd, newp, flags);
 }
 
 extern "C" int utimensat(int dirfd, const char *path,

@@ -771,6 +771,30 @@ static int cmd_files(const char *tag) {
   char composed[1400];
   snprintf(composed, sizeof composed, "%s/%s", cwd, relname);
   if (access(composed, F_OK) != 0) return 20;
+  /* symlink/readlink/link through the namespace: the stored target is
+   * vfs-resolved on create and must reverse-map to the app-visible path
+   * on readlink; traversal and hard links stay inside the namespace */
+  char lnk[340], hard[340], tbuf[512];
+  snprintf(lnk, sizeof lnk, "%s/%s.lnk", dir, tag);
+  snprintf(hard, sizeof hard, "%s/%s.hard", dir, tag);
+  if (symlink(path2, lnk) != 0) return 21;
+  ssize_t ln = readlink(lnk, tbuf, sizeof tbuf - 1);
+  if (ln <= 0) return 22;
+  tbuf[ln] = '\0';
+  if (strcmp(tbuf, path2) != 0) return 23;   /* app-visible target */
+  if (stat(lnk, &st) != 0) return 24;        /* follows to the file */
+  if (st.st_size != (off_t)strlen(want)) return 25;
+  struct stat sl;
+  if (lstat(lnk, &sl) != 0 || !S_ISLNK(sl.st_mode)) return 30;
+  if (sl.st_size != (off_t)ln) return 31;    /* lstat == readlink length */
+  char tbuf2[512];
+  ssize_t ln2 = readlinkat(AT_FDCWD, lnk, tbuf2, sizeof tbuf2 - 1);
+  if (ln2 != ln || memcmp(tbuf, tbuf2, (size_t)ln) != 0) return 32;
+  if (link(path2, hard) != 0) return 26;
+  struct stat sh;
+  if (stat(hard, &sh) != 0 || sh.st_size != (off_t)strlen(want)) return 27;
+  if (unlink(path2) != 0) return 28;         /* hard link keeps the data */
+  if (stat(hard, &sh) != 0 || sh.st_size != (off_t)strlen(want)) return 29;
   if (under_sim()) {
     /* deep creating open: the namespace makes parent dirs on demand */
     char deep[256];
@@ -782,7 +806,8 @@ static int cmd_files(const char *tag) {
   } else {
     /* native run: clean up the real fs */
     unlink(absname);
-    unlink(path2);
+    unlink(lnk);
+    unlink(hard);
     rmdir(dir);
   }
   printf("files OK tag=%s\n", tag);

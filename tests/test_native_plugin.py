@@ -453,13 +453,17 @@ def test_native_file_namespace(native_bin, native_so, tmp_path):
         {"h1": [0], "h2": [0], "h3": [0]}
     for h in ("h1", "h2", "h3"):
         vfs = data / "hosts" / h / "vfs"
-        dat = vfs / "var" / "tmp" / "shadowfiles" / f"{h}.dat"
-        assert dat.read_bytes() == f"hello-{h}".encode()
+        # the scenario unlinks <h>.dat after hard-linking it to <h>.hard
+        # (link-count semantics); the data must survive under the new name
+        hard = vfs / "var" / "tmp" / "shadowfiles" / f"{h}.hard"
+        assert hard.read_bytes() == f"hello-{h}".encode()
+        lnk = vfs / "var" / "tmp" / "shadowfiles" / f"{h}.lnk"
+        assert lnk.is_symlink(), "symlink missing from the vfs"
         deep = vfs / "srv" / h / "a" / "b" / "deep.txt"
         assert deep.read_bytes() == h.encode()
         other = "h2" if h == "h1" else "h1"
         assert not (vfs / "var" / "tmp" / "shadowfiles"
-                    / f"{other}.dat").exists(), "namespace leaked"
+                    / f"{other}.hard").exists(), "namespace leaked"
 
 
 def test_native_xattr_namespace(native_bin, tmp_path):
