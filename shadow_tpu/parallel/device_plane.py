@@ -30,15 +30,18 @@ across the device/numpy execution modes (--device-plane=numpy runs the
 bit-identical host twin; tests/test_device_plane.py pins both).
 
 What is and is NOT modeled (honesty contract, same spirit as
-ops/bandwidth.py's docstring): the plane models the DOWNLOAD direction of
-each stream (server -> exit -> middle -> guard -> client; the dominant bulk
-in the tgen-style 512:51200 spec), store-and-forward at relay granularity
-with shared-bucket contention, and fixed 512B+header wire cells.  It does
-not model per-cell TCP control (windows, retransmits) for the bulk phase —
-circuit setup DOES exercise the full TCP stack.  Reference analog: the
-traffic pattern shadow-plugin-tor measures (worker.c:243-304 +
-network_interface.c:421-579 per-cell work, executed here as dense tensor
-ticks).
+ops/bandwidth.py's docstring): the plane models BOTH directions of each
+stream as independent cell chains (download server->exit->middle->guard->
+client and upload client->guard->middle->exit->server), store-and-forward
+at relay granularity with per-direction bucket contention (each host
+contributes an egress node on its up bucket for sending hops and an
+ingress node on its down bucket for the delivering hop — the same
+send/receive TokenBucket split the engine's interfaces use), and fixed
+512B+header wire cells.  It does not model per-cell TCP control (windows,
+retransmits) for the bulk phase — circuit setup DOES exercise the full
+TCP stack.  Reference analog: the traffic pattern shadow-plugin-tor
+measures (worker.c:243-304 + network_interface.c:421-579 per-cell work,
+executed here as dense tensor ticks).
 """
 
 from __future__ import annotations
@@ -57,12 +60,21 @@ TICK_NS = 1_000_000          # 1 ms, = the interface refill interval
 
 
 class _FlowSpec:
-    __slots__ = ("client_name", "route_names", "cells", "circuit")
+    """One device-mode client = TWO independent cell chains: the download
+    (server -> exit -> middle -> guard -> client) and the upload
+    (client -> guard -> middle -> exit -> server).  The client's flow is
+    complete when BOTH chains have delivered."""
 
-    def __init__(self, client_name: str, route_names: List[str], cells: int):
+    __slots__ = ("client_name", "route_down", "route_up", "cells_down",
+                 "cells_up", "circuit")
+
+    def __init__(self, client_name: str, route_down: List[str],
+                 route_up: List[str], cells_down: int, cells_up: int):
         self.client_name = client_name
-        self.route_names = route_names    # [server, exit, middle, guard, client]
-        self.cells = cells
+        self.route_down = route_down
+        self.route_up = route_up
+        self.cells_down = cells_down
+        self.cells_up = cells_up
         self.circuit = -1
 
 
@@ -85,13 +97,16 @@ def parse_device_client(host_name: str, args: List[str]) -> Optional[_FlowSpec]:
     nstreams = int(args[5]) if len(args) > 5 else 1
     specs = [a for a in args[6:] if a != "device"] or ["100:10000"]
     from ..apps.tor import PAYLOAD_MAX
-    cells = 0
+    cells_down = cells_up = 0
     for i in range(nstreams):
-        down = int(specs[i % len(specs)].split(":")[1])
-        cells += max(1, math.ceil(down / PAYLOAD_MAX))
-    # route in torcells stage order: server, exit, middle, guard, client
-    return _FlowSpec(host_name, [dest, path[2], path[1], path[0], host_name],
-                     cells)
+        up, down = (int(x) for x in specs[i % len(specs)].split(":"))
+        cells_down += max(1, math.ceil(down / PAYLOAD_MAX))
+        cells_up += max(1, math.ceil(up / PAYLOAD_MAX))
+    guard, middle, exit_ = path[0], path[1], path[2]
+    return _FlowSpec(host_name,
+                     [dest, exit_, middle, guard, host_name],
+                     [host_name, guard, middle, exit_, dest],
+                     cells_down, cells_up)
 
 
 class DeviceTrafficPlane:
@@ -146,7 +161,6 @@ class DeviceTrafficPlane:
         self._done: Dict[int, int] = {}   # circuit -> wake sim time ns
         self._woken: set = set()
         self._prev_node_sent: Optional[np.ndarray] = None
-        self._prev_delivered: Optional[np.ndarray] = None
         self._flow_args_cached = None
         self.total_forwards = 0
         self.total_injected_cells = 0
@@ -169,43 +183,49 @@ class DeviceTrafficPlane:
         gathered from the engine's real topology rows — no [H, H] local
         matrix is ever materialized (10k-host graphs would not fit)."""
         topo = engine.topology
-        names: List[str] = []
-        name_idx: Dict[str, int] = {}
+        # Every host contributes up to TWO plane nodes: its EGRESS node
+        # (up-bandwidth bucket — paces stages 0..3, the sending hops) and
+        # its INGRESS node (down-bandwidth bucket — paces stage 4, the
+        # delivering hop).  Distinct buckets per direction mirror the
+        # engine's send/receive TokenBuckets; a client uploading and
+        # downloading concurrently contends on the right one each way.
+        names: List[Tuple[str, str]] = []      # (host, "tx"|"rx")
+        name_idx: Dict[Tuple[str, str], int] = {}
+
+        def node_of(nm: str, kind: str) -> int:
+            key = (nm, kind)
+            if key not in name_idx:
+                name_idx[key] = len(names)
+                names.append(key)
+            return name_idx[key]
+
+        c = 2 * len(self.specs)                # two chains per client
+        st = self.STAGES
+        route = np.empty((c, st), dtype=np.int64)
         for s in self.specs:
-            for nm in s.route_names:
-                if nm not in name_idx:
-                    name_idx[nm] = len(names)
-                    names.append(nm)
+            for k, rt in ((2 * s.circuit, s.route_down),
+                          (2 * s.circuit + 1, s.route_up)):
+                route[k] = [node_of(nm, "tx") for nm in rt[:-1]] + \
+                           [node_of(rt[-1], "rx")]
         self.node_names = names
         self.node_hosts = []
+        self.node_kind = [k for (_nm, k) in names]
+        self._has_upload = np.array([s.cells_up > 0 for s in self.specs],
+                                    dtype=bool)
         rows = np.empty(len(names), dtype=np.int64)
         rates = np.empty(len(names), dtype=np.int64)
-        for i, nm in enumerate(names):
+        for i, (nm, kind) in enumerate(names):
             host = engine.host_by_name(nm)
             if host is None:
                 raise ValueError(f"device plane: unknown host {nm!r}")
             self.node_hosts.append(host)
             rows[i] = host.topo_row
-            rates[i] = host.params.bw_up_kibps
-        # a node that only ever RECEIVES (pure client, stage 4) is paced by
-        # its download bucket; relays/servers pace sends with the up bucket
-        client_only = np.ones(len(names), dtype=bool)
-        for s in self.specs:
-            for nm in s.route_names[:-1]:
-                client_only[name_idx[nm]] = False
-        for i, nm in enumerate(names):
-            if client_only[i]:
-                rates[i] = self.node_hosts[i].params.bw_down_kibps
+            rates[i] = (host.params.bw_up_kibps if kind == "tx"
+                        else host.params.bw_down_kibps)
         from ..ops.bandwidth import bucket_params
         refill, capacity = bucket_params(rates)
         self.refill = refill.astype(np.int64)
         self.capacity = capacity.astype(np.int64)
-
-        c = len(self.specs)
-        st = self.STAGES
-        route = np.empty((c, st), dtype=np.int64)
-        for s in self.specs:
-            route[s.circuit] = [name_idx[nm] for nm in s.route_names]
         flow_circ = np.repeat(np.arange(c, dtype=np.int64), st)
         flow_stage = np.tile(np.arange(st, dtype=np.int64), c)
         flow_node = route[flow_circ, flow_stage]
@@ -287,7 +307,6 @@ class DeviceTrafficPlane:
         self._state = state
         self._flow_args_cached = None
         self._prev_node_sent = np.zeros(self.n_nodes, dtype=np.int64)
-        self._prev_delivered = np.zeros(self.n_flows, dtype=np.int64)
 
     def _setup_sharding(self, n_dev: int) -> None:
         import jax
@@ -350,14 +369,20 @@ class DeviceTrafficPlane:
 
     # -- app-facing -------------------------------------------------------
     def activate(self, client_name: str, cells: Optional[int] = None) -> int:
-        """Called by the client app once its circuit is built: inject the
-        transfer's cells at the server stage on the next dispatch."""
+        """Called by the client app once its circuit is built: inject both
+        directions' cells (download at the server's chain head, upload at
+        the client's) on the next dispatch."""
         spec = self._by_client.get(client_name)
         if spec is None:
             raise ValueError(f"{client_name} has no device flow spec")
-        n = spec.cells if cells is None else cells
-        self._inject_buf.append((spec.circuit, n))
-        self.total_injected_cells += n
+        # an explicit cells argument overrides the DOWNLOAD size; the
+        # configured upload still runs (completion requires both chains)
+        down = spec.cells_down if cells is None else cells
+        up = spec.cells_up
+        self._inject_buf.append((2 * spec.circuit, down))
+        if up:
+            self._inject_buf.append((2 * spec.circuit + 1, up))
+        self.total_injected_cells += down + up
         return spec.circuit
 
     def is_done(self, circuit: int) -> bool:
@@ -500,7 +525,8 @@ class DeviceTrafficPlane:
         t1 = _wt.perf_counter_ns()
         self.device_ns += t1 - t0
 
-        # trackers: per-node sent-byte deltas; per-client delivered deltas
+        # trackers: per-node spent-byte deltas — an egress node's spend is
+        # the host's tx, an ingress (stage-4) node's spend is its rx
         sent_delta = node_sent - self._prev_node_sent
         self._prev_node_sent = node_sent
         from ..ops.torcells_device import CELL_WIRE_BYTES
@@ -508,30 +534,26 @@ class DeviceTrafficPlane:
             tr = self.node_hosts[i].tracker
             nbytes = int(sent_delta[i])
             ncells = nbytes // CELL_WIRE_BYTES
-            c = tr.out_remote
+            c = tr.out_remote if self.node_kind[i] == "tx" else tr.in_remote
             c.packets_total += ncells
             c.bytes_total += nbytes
             c.packets_data += ncells
             c.bytes_data += nbytes
-        del_delta = delivered - self._prev_delivered
-        self._prev_delivered = delivered.copy()
-        for fi in np.flatnonzero(del_delta):
-            host = self.node_hosts[int(self.flow_node[fi])]
-            ncells = int(del_delta[fi])
-            c = host.tracker.in_remote
-            c.packets_total += ncells
-            c.bytes_total += ncells * CELL_WIRE_BYTES
-            c.packets_data += ncells
-            c.bytes_data += ncells * CELL_WIRE_BYTES
 
-        # wake completed circuits (deterministic: completion tick from the
-        # kernel, clamped to the consuming round's barrier)
+        # wake completed clients: BOTH chains (download 2c, upload 2c+1)
+        # must have delivered; wake at the later completion step
+        # (deterministic: ticks from the kernel, clamped to the barrier).
+        # Mask in numpy first — Python iterations only for newly complete
+        # circuits, not O(circuits) per round.
         barrier = engine.scheduler.window_end
-        for circ in np.flatnonzero(done_tick[self.last_flow] >= 0):
+        done_last = done_tick[self.last_flow]
+        d_steps, u_steps = done_last[0::2], done_last[1::2]
+        ready = (d_steps >= 0) & ((u_steps >= 0) | ~self._has_upload)
+        for circ in np.flatnonzero(ready):
             circ = int(circ)
             if circ in self._done:
                 continue
-            step = int(done_tick[self.last_flow[circ]])
+            step = max(int(d_steps[circ]), int(u_steps[circ]))
             wake = max((step + 1) * TICK_NS * self.granule, barrier)
             self._done[circ] = wake
             self._schedule_wake(engine, circ, wake)
