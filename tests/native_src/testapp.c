@@ -1095,9 +1095,39 @@ static int cmd_torclient(const char *host, uint16_t port, int nthreads,
   return 0;
 }
 
+/* eventfd kernel-semantics corners: semaphore mode decrements, counter
+ * mode resets, the all-ones write is EINVAL, and reads at zero are EAGAIN
+ * (nonblocking).  Same checks native and in-sim. */
+static int cmd_efdsem(void) {
+  int efd = eventfd(3, EFD_SEMAPHORE | EFD_NONBLOCK);
+  if (efd < 0) return 40;
+  uint64_t v;
+  for (int i = 0; i < 3; i++) {
+    if (read(efd, &v, 8) != 8 || v != 1) return 41;  /* semaphore: -1 each */
+  }
+  if (read(efd, &v, 8) != -1 || errno != EAGAIN) return 42;
+  v = 0xFFFFFFFFFFFFFFFFull;                         /* never writable */
+  if (write(efd, &v, 8) != -1 || errno != EINVAL) return 43;
+  v = 2;
+  if (write(efd, &v, 8) != 8) return 44;
+  if (read(efd, &v, 8) != 8 || v != 1) return 45;
+  close(efd);
+  int cfd = eventfd(0, EFD_NONBLOCK);                /* counter mode */
+  if (cfd < 0) return 46;
+  v = 5;
+  if (write(cfd, &v, 8) != 8) return 47;
+  v = 7;
+  if (write(cfd, &v, 8) != 8) return 48;
+  if (read(cfd, &v, 8) != 8 || v != 12) return 49;   /* read resets */
+  if (read(cfd, &v, 8) != -1 || errno != EAGAIN) return 50;
+  close(cfd);
+  return 0;
+}
+
 int main(int argc, char **argv) {
   if (argc < 2) return 64;
   const char *cmd = argv[1];
+  if (!strcmp(cmd, "efdsem")) return cmd_efdsem();
   if (!strcmp(cmd, "torserver") && argc >= 5)
     return cmd_torserver((uint16_t)atoi(argv[2]), atoi(argv[3]),
                          atol(argv[4]));

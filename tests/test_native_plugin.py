@@ -857,6 +857,23 @@ def test_tor_shaped_binaries_at_scale(native_bin):
             {f"tsrv{i}": [0], f"tcli{i}": [0]}, f"pair {i} failed"
 
 
+def test_native_eventfd_semantics(native_bin):
+    """eventfd(2) corner semantics, dual-executed: EFD_SEMAPHORE decrements
+    by one per read, counter mode returns-and-resets, the all-ones write is
+    EINVAL, zero-counter nonblocking reads are EAGAIN."""
+    native = subprocess.run([native_bin, "efdsem"], timeout=30)
+    assert native.returncode == 0
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="10">
+          <plugin id="app" path="{native_bin}" />
+          <host id="h1"><process plugin="app" starttime="1" arguments="efdsem" /></host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml, stop=10)
+    assert rc == 0
+    assert exit_codes(ctrl, "h1") == {"h1": [0]}
+
+
 def test_native_tcp_half_close(native_bin):
     """shutdown(SHUT_WR) half-close: the client sends, FINs its direction,
     then still receives the server's summary reply — dual execution
