@@ -1124,10 +1124,56 @@ static int cmd_efdsem(void) {
   return 0;
 }
 
+/* self-signal delivery: an UNBLOCKED signal with a handler runs the
+ * handler (both plain and SA_SIGINFO arity) and execution continues; with
+ * SIG_DFL on a fatal signal the process dies (the caller checks the death
+ * separately via the sigdfl scenario). */
+static volatile int g_plain_hits, g_info_hits, g_info_signo;
+
+static void plain_handler(int sig) { g_plain_hits += (sig == SIGUSR1); }
+
+static void info_handler(int sig, siginfo_t *si, void *ctx) {
+  (void)ctx;
+  g_info_hits++;
+  g_info_signo = si ? si->si_signo : -1;
+  (void)sig;
+}
+
+static int cmd_sighandler(void) {
+  if (signal(SIGUSR1, plain_handler) == SIG_ERR) return 60;
+  if (kill(getpid(), SIGUSR1) != 0) return 61;
+  if (g_plain_hits != 1) return 62;
+  struct sigaction sa;
+  memset(&sa, 0, sizeof sa);
+  sa.sa_sigaction = info_handler;
+  sa.sa_flags = SA_SIGINFO;
+  if (sigaction(SIGUSR2, &sa, NULL) != 0) return 63;
+  if (raise(SIGUSR2) != 0) return 65;
+  if (g_info_hits != 1 || g_info_signo != SIGUSR2) return 66;
+  /* blocked signal stays pending; unblock delivers it */
+  sigset_t m;
+  sigemptyset(&m);
+  sigaddset(&m, SIGUSR1);
+  if (sigprocmask(SIG_BLOCK, &m, NULL) != 0) return 67;
+  if (kill(getpid(), SIGUSR1) != 0) return 68;
+  if (g_plain_hits != 1) return 69;          /* not delivered while blocked */
+  if (sigprocmask(SIG_UNBLOCK, &m, NULL) != 0) return 70;
+  if (g_plain_hits != 2) return 71;          /* released on unblock */
+  return 0;
+}
+
+static int cmd_sigdfl(void) {
+  /* default action: this must TERMINATE the process (caller checks) */
+  kill(getpid(), SIGTERM);
+  return 0;                                  /* reached = failure */
+}
+
 int main(int argc, char **argv) {
   if (argc < 2) return 64;
   const char *cmd = argv[1];
   if (!strcmp(cmd, "efdsem")) return cmd_efdsem();
+  if (!strcmp(cmd, "sighandler")) return cmd_sighandler();
+  if (!strcmp(cmd, "sigdfl")) return cmd_sigdfl();
   if (!strcmp(cmd, "torserver") && argc >= 5)
     return cmd_torserver((uint16_t)atoi(argv[2]), atoi(argv[3]),
                          atol(argv[4]));

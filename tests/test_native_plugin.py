@@ -874,6 +874,43 @@ def test_native_eventfd_semantics(native_bin):
     assert exit_codes(ctrl, "h1") == {"h1": [0]}
 
 
+def test_native_signal_delivery(native_bin):
+    """Self-directed signal delivery, dual-executed: plain and SA_SIGINFO
+    handlers run with correct arity; a blocked signal stays pending and is
+    released by sigprocmask(SIG_UNBLOCK)."""
+    native = subprocess.run([native_bin, "sighandler"], timeout=30)
+    assert native.returncode == 0
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="10">
+          <plugin id="app" path="{native_bin}" />
+          <host id="h1"><process plugin="app" starttime="1" arguments="sighandler" /></host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml, stop=10)
+    assert rc == 0
+    assert exit_codes(ctrl, "h1") == {"h1": [0]}
+
+
+def test_native_signal_default_action_terminates(native_bin):
+    """SIG_DFL on a fatal self-signal terminates the virtual process (the
+    kernel default), it does not no-op: natively the process dies by
+    SIGTERM; in-sim it exits 128+15 and the run reports the plugin error."""
+    native = subprocess.run([native_bin, "sigdfl"], timeout=30)
+    # a direct child killed by SIGTERM reports -15; anything else (e.g. a
+    # normal exit 143) would mean the default action no-op'd — the exact
+    # regression this test guards
+    assert native.returncode == -15
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="10">
+          <plugin id="app" path="{native_bin}" />
+          <host id="h1"><process plugin="app" starttime="1" arguments="sigdfl" /></host>
+        </shadow>
+    """)
+    rc, ctrl = run_sim(xml, stop=10)
+    assert exit_codes(ctrl, "h1") == {"h1": [128 + 15]}
+    assert rc != 0   # nonzero plugin exit => nonzero sim exit (reference)
+
+
 def test_native_tcp_half_close(native_bin):
     """shutdown(SHUT_WR) half-close: the client sends, FINs its direction,
     then still receives the server's summary reply — dual execution
