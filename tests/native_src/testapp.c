@@ -1140,6 +1140,11 @@ static void info_handler(int sig, siginfo_t *si, void *ctx) {
 }
 
 static int cmd_sighandler(void) {
+  /* dispositions/masks survive exec: start from a known-pristine state so
+   * an ignoring/masking test runner can't produce spurious failures */
+  sigset_t none;
+  sigemptyset(&none);
+  if (sigprocmask(SIG_SETMASK, &none, NULL) != 0) return 59;
   if (signal(SIGUSR1, plain_handler) == SIG_ERR) return 60;
   if (kill(getpid(), SIGUSR1) != 0) return 61;
   if (g_plain_hits != 1) return 62;
@@ -1163,7 +1168,12 @@ static int cmd_sighandler(void) {
 }
 
 static int cmd_sigdfl(void) {
-  /* default action: this must TERMINATE the process (caller checks) */
+  /* default action: this must TERMINATE the process (caller checks).
+   * Reset the inherited disposition/mask first — SIG_IGN survives exec. */
+  sigset_t none;
+  sigemptyset(&none);
+  sigprocmask(SIG_SETMASK, &none, NULL);
+  signal(SIGTERM, SIG_DFL);
   kill(getpid(), SIGTERM);
   return 0;                                  /* reached = failure */
 }

@@ -39,7 +39,7 @@ LOSSY_TOPOLOGY = textwrap.dedent("""\
 """)
 
 
-def make_config(n_msgs=40, stoptime=120):
+def make_config(n_msgs=40, stoptime=120, interval=0.05):
     xml = textwrap.dedent(f"""\
         <shadow stoptime="{stoptime}">
           <topology><![CDATA[{LOSSY_TOPOLOGY}]]></topology>
@@ -50,17 +50,17 @@ def make_config(n_msgs=40, stoptime=120):
           </host>
           <host id="client" iphint="11.0.0.2">
             <process plugin="src"
-                     starttime="2" arguments="udp server 8000 {n_msgs} 256 0.05" />
+                     starttime="2" arguments="udp server 8000 {n_msgs} 256 {interval}" />
           </host>
         </shadow>
     """)
     return configuration.parse_xml(xml)
 
 
-def run_policy(policy, workers=0, seed=11):
-    cfg = make_config()
+def run_policy(policy, workers=0, seed=11, interval=0.05, **extra):
+    cfg = make_config(interval=interval)
     opts = Options(scheduler_policy=policy, workers=workers,
-                   stop_time_sec=cfg.stop_time_sec, seed=seed)
+                   stop_time_sec=cfg.stop_time_sec, seed=seed, **extra)
     ctrl = Controller(opts, cfg)
     rc = ctrl.run()
     assert rc == 0
@@ -142,6 +142,26 @@ def test_tpu_policy_async_consume_contract():
     assert not pol._pending
     assert not pol._p_rows
     assert pol.packets_batched > 0
+
+
+def test_tpu_chunk_mid_round_launch_parity():
+    """--tpu-chunk launches device chunks mid-round (overlap mode); results
+    must be identical to barrier-only launching.  tpu_chunk=1 forces a
+    launch on EVERY offer, so the mid-round path demonstrably fires (more
+    device calls than the one-launch-per-round barrier baseline)."""
+    # bursty interval: many packets share a round, so chunk=1 launches
+    # several chunks per round while the barrier baseline launches one
+    base = run_policy("tpu", interval=0.001)
+    chunked = run_policy("tpu", interval=0.001, tpu_chunk=1)
+    base_kern = base["ctrl"].engine.scheduler.policy._kernel
+    chunk_kern = chunked["ctrl"].engine.scheduler.policy._kernel
+    assert chunked["ctrl"].engine.scheduler.policy._chunk == 1
+    # the chunk branch really engaged: per-offer launches outnumber
+    # per-round launches on this multi-packet-per-round workload
+    assert chunk_kern.device_calls > base_kern.device_calls, \
+        (chunk_kern.device_calls, base_kern.device_calls)
+    for key in ("drops", "server_in", "client_out", "rounds"):
+        assert chunked[key] == base[key], key
 
 
 def test_bucketing_compiles_once_per_size():
