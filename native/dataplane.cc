@@ -1,0 +1,2667 @@
+// C data plane for shadow_tpu: the per-event hot path executed natively.
+//
+// Scope (VERDICT r4 next #1): the TCP/UDP protocol pipeline, interface
+// token buckets + qdisc drain, upstream router AQM, protocol timers
+// (RTO/delayed-ACK/persist/TIME_WAIT/refill) and the inter-host packet hop
+// (reliability draw + latency lookup) all run in C, with their own event
+// heap merged into the Python scheduler's total order at the policy pop.
+// Python keeps the control plane: processes/green threads, connect/accept
+// wakeups (delivered through a status callback fired at the exact points
+// the Python plane fires descriptor listeners), epoll, DNS, logging.
+//
+// This is a faithful C re-expression of this repo's OWN Python modules —
+// descriptor/tcp.py, descriptor/udp.py, host/network_interface.py,
+// host/router.py, core/worker.py(send_packet), core/rng.py — so a native
+// run is bit-identical (state digests) to a Python-plane run.  Reference
+// analog: the loop the reference runs in C (worker.c:149-216,
+// tcp.c:1121-1278, network_interface.c:421-579).
+//
+// Built as a CPython extension (no pybind11 in this image; the CPython API
+// keeps per-call overhead ~100ns, which matters at the run()/callback
+// boundary).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---- constants (mirror core/defs.py / descriptor/tcp.py) -------------------
+constexpr int64_t SIM_MS = 1000000LL;
+constexpr int64_t SIM_SEC = 1000000000LL;
+constexpr int HDR_UDP = 42;
+constexpr int HDR_TCP = 66;
+constexpr int64_t MTU = 1500;
+constexpr int64_t MSS = 1500 - (66 - 14);          // 1448
+constexpr int64_t RTO_INIT = 1000 * SIM_MS;
+constexpr int64_t RTO_MIN = 200 * SIM_MS;
+constexpr int64_t RTO_MAX = 120000 * SIM_MS;
+constexpr int64_t TIME_WAIT_NS = 60 * SIM_SEC;
+constexpr int MAX_SYN_RETRIES = 6;
+constexpr int MAX_RETRIES = 15;                    // Linux tcp_retries2
+constexpr int MAX_SACK_BLOCKS = 4;
+constexpr int64_t RMEM_MAX = 6291456;
+constexpr int64_t WMEM_MAX = 4194304;
+constexpr int64_t REFILL_INTERVAL = 1000000LL;     // 1 ms
+constexpr int64_t CAPACITY_FACTOR = 1;
+constexpr int64_t DGRAM_MAX = 65507;
+constexpr int64_t CODEL_TARGET = 10 * SIM_MS;
+constexpr int64_t CODEL_INTERVAL = 100 * SIM_MS;
+constexpr int CODEL_HARD_LIMIT = 1000;
+constexpr int STATIC_CAPACITY = 1024;
+
+// descriptor status bits (descriptor/base.py)
+enum { S_ACTIVE = 1, S_READABLE = 2, S_WRITABLE = 4, S_CLOSED = 8 };
+// TCP header flags (routing/packet.py)
+enum { F_RST = 2, F_SYN = 4, F_ACK = 8, F_FIN = 16 };
+
+enum TcpState {
+  ST_CLOSED = 0, ST_LISTEN, ST_SYN_SENT, ST_SYN_RECEIVED, ST_ESTABLISHED,
+  ST_FIN_WAIT_1, ST_FIN_WAIT_2, ST_CLOSING, ST_TIME_WAIT, ST_CLOSE_WAIT,
+  ST_LAST_ACK,
+};
+const char *const STATE_NAMES[] = {
+  "closed", "listen", "syn_sent", "syn_received", "established",
+  "fin_wait_1", "fin_wait_2", "closing", "time_wait", "close_wait",
+  "last_ack",
+};
+
+enum Err {
+  E_NONE = 0, E_CONNREFUSED, E_CONNRESET, E_TIMEDOUT, E_CONNABORTED,
+  E_PIPE, E_NOTCONN, E_ISCONN, E_INVAL, E_ADDRINUSE, E_MSGSIZE,
+  E_DESTADDRREQ, E_ADDRNOTAVAIL,
+};
+const char *const ERR_NAMES[] = {
+  "", "ECONNREFUSED", "ECONNRESET", "ETIMEDOUT", "ECONNABORTED",
+  "EPIPE", "ENOTCONN", "EISCONN", "EINVAL", "EADDRINUSE", "EMSGSIZE",
+  "EDESTADDRREQ", "EADDRNOTAVAIL",
+};
+
+// ---- threefry2x32 + uniform (bitwise mirror of core/rng.py) ----------------
+constexpr uint32_t TF_PARITY = 0x1BD11BDA;
+const int TF_ROT[8] = {13, 15, 26, 6, 17, 29, 16, 24};
+
+inline uint32_t rotl32(uint32_t x, int d) {
+  return (x << d) | (x >> (32 - d));
+}
+
+inline void threefry2x32(uint32_t k0, uint32_t k1, uint32_t c0, uint32_t c1,
+                         uint32_t *o0, uint32_t *o1) {
+  uint32_t ks[3] = {k0, k1, TF_PARITY ^ k0 ^ k1};
+  uint32_t x0 = c0 + ks[0];
+  uint32_t x1 = c1 + ks[1];
+  for (int block = 0; block < 5; block++) {
+    const int *rots = (block % 2 == 0) ? TF_ROT : TF_ROT + 4;
+    for (int i = 0; i < 4; i++) {
+      x0 += x1;
+      x1 = rotl32(x1, rots[i]);
+      x1 ^= x0;
+    }
+    x0 += ks[(block + 1) % 3];
+    x1 += ks[(block + 2) % 3] + (uint32_t)(block + 1);
+  }
+  *o0 = x0;
+  *o1 = x1;
+}
+
+// uniform_np(key, counter): float64 in [0,1) from the high lane's top 24 bits
+inline double drop_uniform(uint64_t key, uint64_t counter) {
+  uint32_t x0, x1;
+  threefry2x32((uint32_t)(key & 0xFFFFFFFFu), (uint32_t)(key >> 32),
+               (uint32_t)(counter & 0xFFFFFFFFu), (uint32_t)(counter >> 32),
+               &x0, &x1);
+  return (double)(x0 >> 8) * (1.0 / (double)(1 << 24));
+}
+
+// ---- packet ----------------------------------------------------------------
+struct Pkt {
+  int64_t uid;
+  int64_t priority;
+  int64_t src_ip, dst_ip;
+  int32_t src_port, dst_port;
+  uint8_t is_tcp;
+  uint8_t retransmit;
+  // tcp header
+  uint8_t flags;
+  int64_t seq, ack;
+  int64_t window;
+  int nsack;
+  int64_t sack[MAX_SACK_BLOCKS][2];
+  int64_t ts, ts_echo;
+  int32_t header_size;
+  std::string payload;
+
+  int64_t payload_size() const { return (int64_t)payload.size(); }
+  int64_t total_size() const { return header_size + (int64_t)payload.size(); }
+};
+
+// ---- in-flight TCP segment (descriptor/tcp.py _Segment) --------------------
+struct Seg {
+  int64_t seq, end;
+  uint8_t flags;
+  int64_t send_time_ns;
+  int32_t rtx_count;
+  std::string payload;
+};
+
+// ---- retransmit tally (descriptor/retransmit_tally.py PyTally) -------------
+using Range = std::pair<int64_t, int64_t>;
+
+inline void rng_insert(std::vector<Range> &ranges, int64_t b, int64_t e) {
+  if (b >= e) return;
+  std::vector<Range> out;
+  size_t i = 0, n = ranges.size();
+  while (i < n && ranges[i].second < b) out.push_back(ranges[i++]);
+  while (i < n && ranges[i].first <= e) {
+    b = std::min(b, ranges[i].first);
+    e = std::max(e, ranges[i].second);
+    i++;
+  }
+  out.emplace_back(b, e);
+  for (; i < n; i++) out.push_back(ranges[i]);
+  ranges.swap(out);
+}
+
+inline void rng_subtract(std::vector<Range> &ranges, int64_t b, int64_t e) {
+  if (b >= e) return;
+  std::vector<Range> out;
+  for (auto &r : ranges) {
+    if (r.second <= b || r.first >= e) { out.push_back(r); continue; }
+    if (r.first < b) out.emplace_back(r.first, b);
+    if (r.second > e) out.emplace_back(e, r.second);
+  }
+  ranges.swap(out);
+}
+
+struct Tally {
+  std::vector<Range> sacked, retransmitted, lost;
+
+  void mark_sacked(int64_t b, int64_t e) {
+    rng_insert(sacked, b, e);
+    rng_subtract(lost, b, e);
+    rng_subtract(retransmitted, b, e);
+  }
+  void mark_retransmitted(int64_t b, int64_t e) {
+    rng_insert(retransmitted, b, e);
+    rng_subtract(lost, b, e);
+  }
+  void mark_lost(int64_t b, int64_t e) {
+    rng_insert(lost, b, e);
+    rng_subtract(retransmitted, b, e);
+    for (auto &r : sacked) rng_subtract(lost, r.first, r.second);
+  }
+  void advance_una(int64_t una) {
+    const int64_t lo = -(1LL << 62);
+    rng_subtract(sacked, lo, una);
+    rng_subtract(retransmitted, lo, una);
+    rng_subtract(lost, lo, una);
+  }
+  void update_lost(int64_t una, int dup_acks) {
+    if (dup_acks < 3 || sacked.empty()) return;
+    int64_t hi = sacked.back().second;
+    if (hi <= una) return;
+    std::vector<Range> gap{{una, hi}};
+    for (auto &r : sacked) rng_subtract(gap, r.first, r.second);
+    for (auto &r : retransmitted) rng_subtract(gap, r.first, r.second);
+    for (auto &r : gap) rng_insert(lost, r.first, r.second);
+  }
+};
+
+// ---- congestion control (descriptor/tcp_cong.py) ---------------------------
+enum CcKind { CC_RENO = 0, CC_AIMD = 1, CC_CUBIC = 2 };
+
+struct Cong {
+  int kind = CC_RENO;
+  int64_t mss = MSS;
+  int64_t cwnd = 0;
+  int64_t ssthresh = 0;
+  bool in_fast_recovery = false;
+  int64_t recovery_point = 0;
+  int64_t avoid_acc = 0;
+  // cubic
+  double w_max = 0.0;
+  int64_t epoch_start_ns = 0;
+  double k = 0.0;
+
+  void init(int kind_, int64_t mss_, int64_t ssthresh_, int64_t init_segments) {
+    kind = kind_;
+    mss = mss_;
+    cwnd = std::max<int64_t>(1, init_segments) * mss_;
+    ssthresh = ssthresh_ > 0 ? ssthresh_ : (1LL << 30);
+    in_fast_recovery = false;
+    recovery_point = 0;
+    avoid_acc = 0;
+    w_max = 0.0;
+    epoch_start_ns = 0;
+    k = 0.0;
+  }
+
+  void enter_recovery(int64_t snd_nxt) {
+    if (kind == CC_CUBIC) {
+      w_max = (double)cwnd;
+      ssthresh = std::max<int64_t>((int64_t)((double)cwnd * 0.7), 2 * mss);
+      cwnd = ssthresh;
+      in_fast_recovery = true;
+      recovery_point = snd_nxt;
+      epoch_start_ns = 0;
+      return;
+    }
+    ssthresh = std::max<int64_t>(cwnd / 2, 2 * mss);
+    cwnd = ssthresh + 3 * mss;
+    in_fast_recovery = true;
+    recovery_point = snd_nxt;
+  }
+
+  void exit_recovery() {
+    cwnd = ssthresh;
+    in_fast_recovery = false;
+    avoid_acc = 0;
+  }
+
+  void congestion_avoidance(int64_t acked_bytes, int64_t now_ns) {
+    if (kind == CC_CUBIC) {
+      if (epoch_start_ns == 0) {
+        epoch_start_ns = now_ns;
+        double wm = std::max(w_max, (double)cwnd);
+        k = (wm > (double)cwnd)
+                ? pow((wm - (double)cwnd) / (0.4 * (double)mss), 1.0 / 3.0)
+                : 0.0;
+      }
+      double t = (double)(now_ns - epoch_start_ns) / 1e9;
+      double target = w_max + 0.4 * (double)mss * pow(t - k, 3.0);
+      if (target > (double)cwnd) {
+        cwnd += std::max<int64_t>(mss / 8,
+                                  (int64_t)((target - (double)cwnd) / 8.0));
+        return;
+      }
+      // else fall through to Reno linear growth
+    }
+    avoid_acc += acked_bytes;
+    if (avoid_acc >= cwnd) {
+      avoid_acc -= cwnd;
+      cwnd += mss;
+    }
+  }
+
+  void on_new_ack(int64_t acked_bytes, int64_t snd_una, int64_t now_ns) {
+    if (in_fast_recovery) {
+      if (snd_una >= recovery_point) exit_recovery();
+      else return;  // partial ACK: stay in recovery
+    }
+    if (cwnd < ssthresh) cwnd += std::min(acked_bytes, mss);  // slow start
+    else congestion_avoidance(acked_bytes, now_ns);
+  }
+
+  bool on_duplicate_ack(int count, int64_t snd_nxt) {
+    if (kind == CC_AIMD) {
+      if (count == 3 && !in_fast_recovery) {
+        enter_recovery(snd_nxt);
+        cwnd = ssthresh;  // no +3 inflation
+        return true;
+      }
+      return false;
+    }
+    if (count == 3 && !in_fast_recovery) {
+      enter_recovery(snd_nxt);
+      return true;
+    }
+    if (in_fast_recovery) cwnd += mss;
+    return false;
+  }
+
+  void on_timeout() {
+    if (kind == CC_CUBIC) w_max = (double)cwnd;
+    ssthresh = std::max<int64_t>(cwnd / 2, 2 * mss);
+    cwnd = mss;
+    in_fast_recovery = false;
+    avoid_acc = 0;
+    if (kind == CC_CUBIC) epoch_start_ns = 0;
+  }
+};
+
+// ---- flat byte stream (deque-of-chunks equivalent; content-identical) ------
+struct ByteStream {
+  std::string buf;
+  size_t head = 0;
+
+  int64_t size() const { return (int64_t)(buf.size() - head); }
+  void append(const char *data, size_t n) {
+    compact_if_needed();
+    buf.append(data, n);
+  }
+  void compact_if_needed() {
+    if (head > 65536 && head * 2 > buf.size()) {
+      buf.erase(0, head);
+      head = 0;
+    }
+  }
+  // copy up to n bytes from the front without consuming
+  std::string peek(int64_t n) const {
+    int64_t take = std::min<int64_t>(n, size());
+    return buf.substr(head, (size_t)take);
+  }
+  std::string pop(int64_t n) {
+    int64_t take = std::min<int64_t>(n, size());
+    std::string out = buf.substr(head, (size_t)take);
+    head += (size_t)take;
+    if (head == buf.size()) { buf.clear(); head = 0; }
+    return out;
+  }
+  void clear() { buf.clear(); head = 0; }
+};
+
+// ---- token bucket (host/network_interface.py) ------------------------------
+struct Bucket {
+  int64_t refill = 0, capacity = 0, remaining = 0;
+
+  void init(int64_t rate_kibps) {
+    int64_t time_factor = SIM_SEC / REFILL_INTERVAL;  // 1000
+    refill = (rate_kibps * 1024) / time_factor;
+    capacity = refill * CAPACITY_FACTOR + MTU;
+    remaining = capacity;
+  }
+  void do_refill() { remaining = std::min(remaining + refill, capacity); }
+  bool try_consume(int64_t n) {
+    if (remaining >= n) { remaining -= n; return true; }
+    return false;
+  }
+};
+
+// ---- router AQM (host/router.py) -------------------------------------------
+enum RQKind { RQ_CODEL = 0, RQ_SINGLE = 1, RQ_STATIC = 2 };
+
+struct RouterQ {
+  int kind = RQ_CODEL;
+  std::deque<std::pair<int64_t, Pkt *>> q;  // (enqueue_time, pkt); single uses slot
+  Pkt *slot = nullptr;                      // RQ_SINGLE
+  // codel state
+  bool dropping = false;
+  int64_t drop_next = 0;
+  int64_t drop_count = 0, last_drop_count = 0;
+  int64_t total_drops = 0;
+  int64_t first_above_time = 0;
+  Pkt *staged = nullptr;
+
+  size_t qlen() const {
+    size_t n = (kind == RQ_SINGLE) ? (slot ? 1 : 0) : q.size();
+    return n + (staged ? 1 : 0);
+  }
+  // Router.enqueue's was_empty checks len(self.queue) WITHOUT the staged slot
+  size_t qlen_queue_only() const {
+    return (kind == RQ_SINGLE) ? (slot ? 1 : 0) : q.size();
+  }
+
+  bool enqueue_q(Pkt *p, int64_t now) {  // returns admitted
+    switch (kind) {
+      case RQ_SINGLE:
+        if (slot) return false;
+        slot = p;
+        return true;
+      case RQ_STATIC:
+        if ((int)q.size() >= STATIC_CAPACITY) return false;
+        q.emplace_back(now, p);
+        return true;
+      default:  // codel
+        if ((int)q.size() >= CODEL_HARD_LIMIT) { total_drops++; return false; }
+        q.emplace_back(now, p);
+        return true;
+    }
+  }
+
+  Pkt *peek_q() {
+    if (kind == RQ_SINGLE) return slot;
+    return q.empty() ? nullptr : q.front().second;
+  }
+
+  static int64_t control_law(int64_t t, int64_t count) {
+    return t + (int64_t)((double)CODEL_INTERVAL /
+                         sqrt((double)std::max<int64_t>(1, count)));
+  }
+
+  // codel _do_dequeue -> (pkt, ok_to_drop)
+  Pkt *do_dequeue(int64_t now, bool *ok_to_drop) {
+    *ok_to_drop = false;
+    if (q.empty()) { first_above_time = 0; return nullptr; }
+    int64_t enq_time = q.front().first;
+    Pkt *p = q.front().second;
+    q.pop_front();
+    int64_t sojourn = now - enq_time;
+    if (sojourn < CODEL_TARGET || q.empty()) {  // _q_has_backlog: >=1 queued
+      first_above_time = 0;
+      return p;
+    }
+    if (first_above_time == 0) {
+      first_above_time = now + CODEL_INTERVAL;
+      return p;
+    }
+    *ok_to_drop = now >= first_above_time;
+    return p;
+  }
+
+  // returns delivered packet (codel may free dropped packets along the way)
+  Pkt *dequeue_q(int64_t now) {
+    if (kind == RQ_SINGLE) { Pkt *p = slot; slot = nullptr; return p; }
+    if (kind == RQ_STATIC) {
+      if (q.empty()) return nullptr;
+      Pkt *p = q.front().second;
+      q.pop_front();
+      return p;
+    }
+    bool ok = false;
+    Pkt *p = do_dequeue(now, &ok);
+    if (!p) { dropping = false; return nullptr; }
+    if (dropping) {
+      if (!ok) {
+        dropping = false;
+      } else {
+        while (now >= drop_next && dropping) {
+          delete p;  // ROUTER_DROPPED
+          total_drops++;
+          drop_count++;
+          p = do_dequeue(now, &ok);
+          if (!p) { dropping = false; return nullptr; }
+          if (!ok) dropping = false;
+          else drop_next = control_law(drop_next, drop_count);
+        }
+      }
+    } else if (ok) {
+      delete p;  // ROUTER_DROPPED
+      total_drops++;
+      bool ok2 = false;
+      p = do_dequeue(now, &ok2);
+      if (!p) return nullptr;
+      dropping = true;
+      int64_t delta = drop_count - last_drop_count;
+      drop_count = 1;
+      if (delta > 1 && now - drop_next < 16 * CODEL_INTERVAL)
+        drop_count = delta;
+      drop_next = control_law(now, drop_count);
+      last_drop_count = drop_count;
+    }
+    return p;
+  }
+
+  // Router.peek_deliverable / dequeue / peek with the staging slot
+  Pkt *peek_deliverable(int64_t now) {
+    if (!staged) staged = dequeue_q(now);
+    return staged;
+  }
+  Pkt *take(int64_t now) {
+    if (staged) { Pkt *p = staged; staged = nullptr; return p; }
+    return dequeue_q(now);
+  }
+  Pkt *peek_any() {
+    if (staged) return staged;
+    return peek_q();
+  }
+
+  ~RouterQ() {
+    for (auto &e : q) delete e.second;
+    delete slot;
+    delete staged;
+  }
+};
+
+// ---- sockets ---------------------------------------------------------------
+enum SockKind { K_TCP = 0, K_UDP = 1 };
+
+struct Iface;  // fwd
+
+struct Sock {
+  int32_t id = -1;
+  int32_t hid = -1;
+  int kind = K_TCP;
+  int64_t handle = 0;
+  bool closed = false;   // descriptor closed (base Descriptor.close ran)
+  bool watched = false;  // Python listeners present -> fire CB_STATUS
+  int32_t status = 0;
+
+  // naming: -1 == Python None (wrapper translates)
+  int64_t bound_ip = -1, bound_port = -1, peer_ip = -1, peer_port = -1;
+  int64_t recv_buf_size = 0, send_buf_size = 0;
+  int64_t in_bytes = 0, out_bytes = 0;
+  std::deque<Pkt *> out_packets;
+  std::deque<Pkt *> in_packets;  // UDP arrivals
+  // (iface, proto-implied key) association back-refs
+  std::vector<std::pair<Iface *, uint64_t>> assocs;
+  bool in_ready = false;  // member of its iface's ready-senders ring
+
+  // ---- TCP ----
+  int state = ST_CLOSED;
+  int32_t parent = -1;  // sock id
+  bool accepted = false;
+  int err = E_NONE;
+  int64_t backlog = 0;
+  std::deque<int32_t> accept_q;
+  std::map<uint64_t, int32_t> children;  // (ip<<16|port) -> child sock id
+  int64_t snd_una = 0, snd_nxt = 0, snd_wnd = MSS, rcv_nxt = 0, iss = 0,
+          irs = 0;
+  ByteStream send_pending;
+  int64_t send_pending_bytes = 0;
+  std::deque<Seg> unacked;
+  std::map<int64_t, Pkt *> reorder;
+  int64_t reorder_bytes = 0;
+  ByteStream read_q;
+  int64_t read_bytes = 0;
+  Cong cong;
+  bool has_cong = false;
+  Tally tally;
+  bool tally_dirty = false;
+  int dup_ack_count = 0;
+  int64_t srtt_ns = 0, rttvar_ns = 0, rto_ns = RTO_INIT, rto_expiry = 0;
+  int64_t rto_generation = 0;
+  bool rto_scheduled = false;
+  bool fin_pending = false;
+  int64_t fin_seq = -1;  // None == -1
+  bool eof_received = false, fin_acked = false, app_closed = false,
+       write_shutdown = false, persist_scheduled = false;
+  bool delack_scheduled = false;
+  int64_t delack_counter = 0, quick_acks = 0;
+  bool autotune_recv = true, autotune_send = true;
+  int64_t rtt_bytes_in = 0, rtt_window_start = 0;
+  int64_t last_adv_window = 0;
+
+  ~Sock() {
+    for (Pkt *p : out_packets) delete p;
+    for (Pkt *p : in_packets) delete p;
+    for (auto &kv : reorder) delete kv.second;
+  }
+};
+
+// ---- interface -------------------------------------------------------------
+struct HostS;  // fwd
+
+struct Iface {
+  HostS *host = nullptr;
+  int64_t ip = 0;
+  bool is_loopback = false;
+  int qdisc_rr = 0;  // 0 = fifo (priority), 1 = rr
+  Bucket send_bucket, receive_bucket;
+  RouterQ *router = nullptr;  // eth only
+  // binding: key = (peer_ip<<32)|(port<<16)|peer_port, per proto
+  std::unordered_map<uint64_t, int32_t> bind_tcp, bind_udp;
+  std::deque<int32_t> ready_senders;
+  std::deque<Pkt *> arrivals;
+  bool refill_scheduled = false;
+
+  ~Iface() {
+    delete router;
+    for (Pkt *p : arrivals) delete p;
+  }
+};
+
+inline uint64_t bind_key(int64_t port, int64_t peer_ip, int64_t peer_port) {
+  return ((uint64_t)(peer_ip & 0xFFFFFFFFu) << 32) |
+         ((uint64_t)(port & 0xFFFF) << 16) | (uint64_t)(peer_port & 0xFFFF);
+}
+
+// ---- tracker (host/tracker.py _Counters x4 + drops) ------------------------
+struct TrackCtr {
+  int64_t packets_total = 0, bytes_total = 0;
+  int64_t packets_control = 0, bytes_control = 0;
+  int64_t packets_data = 0, bytes_data = 0;
+  int64_t packets_retrans = 0, bytes_retrans = 0;
+
+  void add(const Pkt *p, bool retransmit) {
+    int64_t n = p->total_size();
+    packets_total++;
+    bytes_total += n;
+    if (p->payload_size() == 0) { packets_control++; bytes_control += n; }
+    else { packets_data++; bytes_data += n; }
+    if (retransmit) { packets_retrans++; bytes_retrans += n; }
+  }
+};
+
+struct HostS {
+  int32_t id = 0;
+  int64_t ip = 0;       // default (eth) address
+  int64_t lo_ip = 0;    // LOCALHOST
+  int32_t topo_row = 0;
+  Iface lo, eth;
+  // deterministic counters (mirror host/host.py)
+  int64_t event_seq = 0;
+  int64_t packet_counter = 0;
+  int64_t packet_priority = 0;
+  int64_t next_handle = 1000;
+  int64_t next_port = 10000;
+  // params
+  int64_t recv_buf_size = 0, send_buf_size = 0;
+  bool autotune_recv = true, autotune_send = true;
+  // tracker
+  TrackCtr in_local, in_remote, out_local, out_remote;
+  int64_t drops = 0;
+
+  int64_t next_event_sequence() { return ++event_seq; }
+  int64_t next_packet_uid() {
+    packet_counter++;
+    return ((int64_t)id << 40) | packet_counter;
+  }
+  int64_t next_packet_priority() { return ++packet_priority; }
+
+  Iface *iface_for_ip(int64_t want) {
+    if (want == lo_ip) return &lo;
+    if (want == ip || want == 0 || want == -1) return &eth;
+    return nullptr;
+  }
+};
+
+// ---- event heap ------------------------------------------------------------
+enum EvType {
+  EV_DELIVER = 0,   // pkt -> dst router/arrival
+  EV_LOCAL,         // pkt -> specific iface arrival (b = iface ip)
+  EV_REFILL,        // eth refill on dst host
+  EV_RTO,           // a = sock, b = generation
+  EV_PERSIST,       // a = sock
+  EV_DELACK,        // a = sock
+  EV_TIMEWAIT,      // a = sock
+};
+
+struct Ev {
+  int64_t time;
+  int32_t dst, src;
+  int64_t seq;
+  int type;
+  int32_t a = 0;
+  int64_t b = 0;
+  Pkt *pkt = nullptr;
+};
+
+struct EvKey {
+  int64_t time;
+  int32_t dst, src;
+  int64_t seq;
+};
+
+inline bool key_lt(const Ev &e, const EvKey &k) {
+  if (e.time != k.time) return e.time < k.time;
+  if (e.dst != k.dst) return e.dst < k.dst;
+  if (e.src != k.src) return e.src < k.src;
+  return e.seq < k.seq;
+}
+
+struct EvGreater {  // min-heap via std::*_heap with greater-than
+  bool operator()(const Ev &a, const Ev &b) const {
+    if (a.time != b.time) return a.time > b.time;
+    if (a.dst != b.dst) return a.dst > b.dst;
+    if (a.src != b.src) return a.src > b.src;
+    return a.seq > b.seq;
+  }
+};
+
+// ---- callback kinds --------------------------------------------------------
+enum CbKind { CB_STATUS = 0, CB_CHILD = 1, CB_CLOSED = 2 };
+
+// ---- the plane -------------------------------------------------------------
+struct Plane {
+  PyObject_HEAD
+  std::vector<Ev> *heap;
+  std::vector<Sock *> *socks;
+  std::vector<HostS *> *hosts;                    // index = hid (dense)
+  std::unordered_map<int64_t, int32_t> *ip2host;  // eth ip -> hid
+  PyObject *cb;             // status/lifecycle callback into Python
+  PyObject *lat_arr;        // borrowed refs kept alive: numpy arrays
+  PyObject *rel_arr;
+  PyObject *cnt_arr;
+  const int64_t *lat;       // [A, A] int64
+  const float *rel;         // [A, A] float32
+  int64_t *path_counts;     // [A, A] int64 (written in place)
+  int64_t A;
+  uint64_t drop_key;
+  int64_t bootstrap_end, end_time, window_end;
+  // run-loop context
+  bool in_run;
+  EvKey limit;              // active run's stop key (lower_limit shrinks it)
+  int64_t now;              // current virtual time during C execution
+  int32_t active_host;      // current executing host (seq owner for pushes)
+  // counters
+  int64_t events_scheduled, events_executed, packet_drops;
+  int64_t last_event_time;
+  // tcp options
+  int cc_kind;
+  int64_t cc_ssthresh, cc_init_segments;
+
+  HostS *H(int32_t hid) { return (*hosts)[hid]; }
+  Sock *S(int32_t sid) { return (*socks)[sid]; }
+};
+
+// pushed events MUST claim their seq at push time from the src host
+void plane_push_ev(Plane *pl, Ev ev) {
+  // policy barrier clamp (core/scheduler.py push: cross-host events are
+  // clamped to the round barrier for causality)
+  if (ev.dst != ev.src && ev.time < pl->window_end) ev.time = pl->window_end;
+  pl->heap->push_back(ev);
+  std::push_heap(pl->heap->begin(), pl->heap->end(), EvGreater());
+  pl->events_scheduled++;
+}
+
+// schedule_task mirror for C-internal events: returns false when declined
+// (past end time), exactly like Worker.schedule_task returning None
+bool plane_schedule(Plane *pl, int type, int64_t delay, int32_t dst_hid,
+                    int32_t a, int64_t b, Pkt *pkt) {
+  int64_t t = pl->now + (delay > 0 ? delay : 0);
+  if (t >= pl->end_time) {
+    delete pkt;
+    return false;
+  }
+  int32_t src = pl->active_host;
+  HostS *seq_owner = pl->H(src >= 0 ? src : dst_hid);
+  Ev ev;
+  ev.time = t;
+  ev.dst = dst_hid;
+  ev.src = src;
+  ev.seq = seq_owner->next_event_sequence();
+  ev.type = type;
+  ev.a = a;
+  ev.b = b;
+  ev.pkt = pkt;
+  plane_push_ev(pl, ev);
+  return true;
+}
+
+// fire the Python callback (only when needed); returns false on exception
+bool plane_cb(Plane *pl, int kind, int32_t hid, int64_t a, int64_t b) {
+  if (!pl->cb || pl->cb == Py_None) return true;
+  PyObject *r = PyObject_CallFunction(pl->cb, "iiLLL", kind, (int)hid,
+                                      (long long)pl->now, (long long)a,
+                                      (long long)b);
+  if (!r) return false;
+  Py_DECREF(r);
+  return true;
+}
+
+// adjust_status mirror: returns false on callback exception
+bool sock_adjust_status(Plane *pl, Sock *s, int bits, bool on) {
+  int old = s->status;
+  if (on) s->status |= bits;
+  else s->status &= ~bits;
+  int changed = old ^ s->status;
+  if (changed && s->watched) {
+    return plane_cb(pl, CB_STATUS, s->hid, s->id, changed);
+  }
+  return true;
+}
+
+// Propagate Python-callback exceptions: CK(x) bubbles a false return up the
+// call chain to run()/the API entry, where the pending exception surfaces.
+#define CK(x) do { if (!(x)) return false; } while (0)
+
+// ---- binding table ---------------------------------------------------------
+std::unordered_map<uint64_t, int32_t> &bind_map(Iface *f, int kind) {
+  return kind == K_TCP ? f->bind_tcp : f->bind_udp;
+}
+
+void iface_associate(Iface *f, Sock *s, int64_t port, int64_t peer_ip,
+                     int64_t peer_port) {
+  uint64_t key = bind_key(port, peer_ip, peer_port);
+  bind_map(f, s->kind)[key] = s->id;
+  for (auto &a : s->assocs)
+    if (a.first == f && a.second == key) return;
+  s->assocs.emplace_back(f, key);
+}
+
+void iface_disassociate_key(Iface *f, uint64_t key, Sock *s) {
+  auto &m = bind_map(f, s->kind);
+  auto it = m.find(key);
+  if (it != m.end() && it->second == s->id) m.erase(it);
+  for (auto it2 = s->assocs.begin(); it2 != s->assocs.end(); ++it2)
+    if (it2->first == f && it2->second == key) { s->assocs.erase(it2); break; }
+}
+
+void iface_disassociate(Plane *pl, Iface *f, int kind, int64_t port,
+                        int64_t peer_ip, int64_t peer_port) {
+  uint64_t key = bind_key(port, peer_ip, peer_port);
+  auto &m = (kind == K_TCP) ? f->bind_tcp : f->bind_udp;
+  auto it = m.find(key);
+  if (it != m.end()) iface_disassociate_key(f, key, pl->S(it->second));
+}
+
+bool iface_is_associated(Iface *f, int kind, int64_t port) {
+  auto &m = (kind == K_TCP) ? f->bind_tcp : f->bind_udp;
+  return m.count(bind_key(port, 0, 0)) != 0;
+}
+
+Sock *iface_lookup(Plane *pl, Iface *f, const Pkt *p) {
+  auto &m = p->is_tcp ? f->bind_tcp : f->bind_udp;
+  auto it = m.find(bind_key(p->dst_port, p->src_ip, p->src_port));
+  if (it == m.end()) it = m.find(bind_key(p->dst_port, 0, 0));
+  return it == m.end() ? nullptr : pl->S(it->second);
+}
+
+void sock_release_bindings(Sock *s) {
+  auto assocs = s->assocs;  // copy: disassociate_key mutates
+  for (auto &a : assocs) iface_disassociate_key(a.first, a.second, s);
+  s->assocs.clear();
+}
+
+// ---- base descriptor close (descriptor/base.py Socket.close path) ----------
+bool sock_base_close(Plane *pl, Sock *s) {
+  if (s->closed) return true;
+  sock_release_bindings(s);
+  s->closed = true;
+  CK(sock_adjust_status(pl, s, S_ACTIVE | S_READABLE | S_WRITABLE, false));
+  CK(sock_adjust_status(pl, s, S_CLOSED, true));
+  // descriptor_table_remove on the Python side
+  CK(plane_cb(pl, CB_CLOSED, s->hid, s->id, 0));
+  return true;
+}
+
+// ---- fwd decls -------------------------------------------------------------
+bool iface_wants_send(Plane *pl, Iface *f, Sock *s);
+bool iface_receive_packets(Plane *pl, Iface *f);
+bool iface_send_packets(Plane *pl, Iface *f);
+void iface_ensure_refill(Plane *pl, Iface *f);
+bool tcp_flush(Plane *pl, Sock *s);
+bool tcp_teardown(Plane *pl, Sock *s);
+bool tcp_update_writable(Plane *pl, Sock *s);
+
+// ---- TCP helpers -----------------------------------------------------------
+inline int64_t tcp_adv_window(const Sock *s) {
+  int64_t used = s->read_bytes + s->reorder_bytes;
+  return std::max<int64_t>(0, s->recv_buf_size - used);
+}
+
+inline int64_t tcp_send_capacity(const Sock *s) {
+  int64_t flight = s->snd_nxt - s->snd_una;
+  int64_t cwnd = s->has_cong ? s->cong.cwnd : MSS;
+  return std::max<int64_t>(
+      0, std::min(cwnd, std::max<int64_t>(s->snd_wnd, 0)) - flight);
+}
+
+// SACK blocks from the reorder buffer: contiguous runs, last 4
+int tcp_sack_blocks(const Sock *s, int64_t out[][2]) {
+  if (s->reorder.empty()) return 0;
+  std::vector<Range> blocks;
+  int64_t start = 0, prev_end = 0;
+  bool have = false;
+  for (auto &kv : s->reorder) {  // std::map: ascending seq
+    int64_t b = kv.first, e = b + kv.second->payload_size();
+    if (!have) { start = b; prev_end = e; have = true; }
+    else if (b <= prev_end) prev_end = std::max(prev_end, e);
+    else { blocks.emplace_back(start, prev_end); start = b; prev_end = e; }
+  }
+  blocks.emplace_back(start, prev_end);
+  int n = (int)std::min<size_t>(blocks.size(), MAX_SACK_BLOCKS);
+  size_t off = blocks.size() - n;
+  for (int i = 0; i < n; i++) {
+    out[i][0] = blocks[off + i].first;
+    out[i][1] = blocks[off + i].second;
+  }
+  return n;
+}
+
+Iface *sock_iface(Plane *pl, Sock *s) {
+  return pl->H(s->hid)->iface_for_ip(s->bound_ip);
+}
+
+// _emit (descriptor/tcp.py:188): build one packet into the out queue
+bool tcp_emit(Plane *pl, Sock *s, int flags, int64_t seq,
+              const char *payload, int64_t plen, int64_t echo_ts,
+              bool track, bool notify) {
+  HostS *h = pl->H(s->hid);
+  int64_t now = pl->now;
+  int64_t adv = tcp_adv_window(s);
+  Pkt *p = new Pkt();
+  p->is_tcp = 1;
+  p->header_size = HDR_TCP;
+  p->src_ip = s->bound_ip;
+  p->src_port = (int32_t)s->bound_port;
+  p->dst_ip = s->peer_ip;
+  p->dst_port = (int32_t)s->peer_port;
+  p->flags = (uint8_t)flags;
+  p->seq = seq;
+  p->ack = (flags & F_ACK) ? s->rcv_nxt : 0;
+  p->window = adv;
+  p->nsack = (!s->reorder.empty() && (flags & F_ACK))
+                 ? tcp_sack_blocks(s, p->sack) : 0;
+  p->ts = now;
+  p->ts_echo = echo_ts >= 0 ? echo_ts : 0;
+  p->uid = h->next_packet_uid();
+  p->priority = h->next_packet_priority();
+  if (plen) p->payload.assign(payload, (size_t)plen);
+  if (flags & F_ACK) s->delack_counter = 0;  // tcp.c:1106-1107
+  int64_t consumes = plen + ((flags & (F_SYN | F_FIN)) ? 1 : 0);
+  if (track && consumes) {
+    Seg seg;
+    seg.seq = seq;
+    seg.end = seq + consumes;
+    seg.flags = (uint8_t)flags;
+    seg.send_time_ns = now;
+    seg.rtx_count = 0;
+    if (plen) seg.payload.assign(payload, (size_t)plen);
+    s->unacked.push_back(std::move(seg));
+    // _arm_rto
+    s->rto_expiry = now + s->rto_ns;
+    if (!s->rto_scheduled) {
+      s->rto_scheduled = true;
+      plane_schedule(pl, EV_RTO, s->rto_ns, s->hid, s->id,
+                     s->rto_generation, nullptr);
+    }
+  }
+  s->last_adv_window = p->window;
+  s->out_packets.push_back(p);
+  s->out_bytes += p->total_size();
+  if (notify) {
+    Iface *f = sock_iface(pl, s);
+    if (f) CK(iface_wants_send(pl, f, s));
+  }
+  return true;
+}
+
+bool tcp_send_ack(Plane *pl, Sock *s, int64_t echo_ts) {
+  return tcp_emit(pl, s, F_ACK, s->snd_nxt, nullptr, 0, echo_ts,
+                  /*track=*/false, /*notify=*/true);
+}
+
+bool tcp_schedule_delayed_ack(Plane *pl, Sock *s) {
+  s->delack_counter++;
+  if (s->delack_scheduled) return true;
+  int64_t delay;
+  if (s->quick_acks < 1000) { s->quick_acks++; delay = SIM_MS; }
+  else delay = 5 * SIM_MS;
+  s->delack_scheduled = true;
+  if (!plane_schedule(pl, EV_DELACK, delay, s->hid, s->id, 0, nullptr)) {
+    // scheduling declined (past end time): leave the timer unarmed
+    s->delack_scheduled = false;
+  }
+  return true;
+}
+
+bool tcp_update_readable(Plane *pl, Sock *s) {
+  bool readable = s->read_q.size() > 0 || s->eof_received ||
+                  !s->accept_q.empty();
+  if (((s->status & S_READABLE) != 0) != readable)
+    CK(sock_adjust_status(pl, s, S_READABLE, readable));
+  return true;
+}
+
+bool tcp_update_writable(Plane *pl, Sock *s) {
+  if (s->state != ST_ESTABLISHED && s->state != ST_CLOSE_WAIT) {
+    if (s->err != E_NONE)
+      CK(sock_adjust_status(pl, s, S_WRITABLE, true));
+    return true;
+  }
+  int64_t space = s->send_buf_size - s->send_pending_bytes -
+                  (s->snd_nxt - s->snd_una);
+  bool writable = space > 0;
+  if (((s->status & S_WRITABLE) != 0) != writable)
+    CK(sock_adjust_status(pl, s, S_WRITABLE, writable));
+  return true;
+}
+
+// ---- RTT / autotuning ------------------------------------------------------
+void tcp_autotune(Plane *pl, Sock *s, int64_t rtt_ns) {
+  int64_t now = pl->now;
+  if (s->rtt_window_start == 0) { s->rtt_window_start = now; return; }
+  if (now - s->rtt_window_start < rtt_ns) return;
+  if (s->autotune_recv && s->rtt_bytes_in > 0) {
+    int64_t target = 2 * s->rtt_bytes_in;
+    if (target > s->recv_buf_size)
+      s->recv_buf_size = std::min(target, RMEM_MAX);
+  }
+  if (s->autotune_send && s->has_cong) {
+    int64_t target = 2 * s->cong.cwnd;
+    if (target > s->send_buf_size)
+      s->send_buf_size = std::min(target, WMEM_MAX);
+  }
+  s->rtt_bytes_in = 0;
+  s->rtt_window_start = now;
+}
+
+void tcp_rtt_sample(Plane *pl, Sock *s, int64_t sample_ns) {
+  if (sample_ns <= 0) return;
+  if (s->srtt_ns == 0) {
+    s->srtt_ns = sample_ns;
+    s->rttvar_ns = sample_ns / 2;
+  } else {
+    int64_t err = sample_ns > s->srtt_ns ? sample_ns - s->srtt_ns
+                                         : s->srtt_ns - sample_ns;
+    s->rttvar_ns = (3 * s->rttvar_ns + err) / 4;
+    s->srtt_ns = (7 * s->srtt_ns + sample_ns) / 8;
+  }
+  s->rto_ns = std::max(RTO_MIN,
+                       std::min(s->srtt_ns + 4 * s->rttvar_ns, RTO_MAX));
+  tcp_autotune(pl, s, sample_ns);
+}
+
+void tcp_recv_autotune(Plane *pl, Sock *s) {
+  if (!s->autotune_recv) return;
+  int64_t now = pl->now;
+  if (s->rtt_window_start == 0) { s->rtt_window_start = now; return; }
+  int64_t rtt = s->srtt_ns ? s->srtt_ns : 200 * SIM_MS;
+  if (now - s->rtt_window_start < rtt) return;
+  int64_t target = 2 * s->rtt_bytes_in;
+  if (target > s->recv_buf_size)
+    s->recv_buf_size = std::min(target, RMEM_MAX);
+  s->rtt_bytes_in = 0;
+  s->rtt_window_start = now;
+}
+
+// ---- RTO / persist ---------------------------------------------------------
+void tcp_arm_rto(Plane *pl, Sock *s) {
+  s->rto_expiry = pl->now + s->rto_ns;
+  if (s->rto_scheduled) return;
+  s->rto_scheduled = true;
+  plane_schedule(pl, EV_RTO, s->rto_ns, s->hid, s->id, s->rto_generation,
+                 nullptr);
+}
+
+void tcp_cancel_rto(Sock *s) {
+  s->rto_generation++;
+  s->rto_scheduled = false;
+}
+
+bool tcp_retransmit_segment(Plane *pl, Sock *s, Seg &seg) {
+  seg.rtx_count++;
+  seg.send_time_ns = pl->now;
+  s->tally.mark_retransmitted(seg.seq, seg.end);
+  int flags = (s->state == ST_SYN_SENT) ? seg.flags : (seg.flags | F_ACK);
+  HostS *h = pl->H(s->hid);
+  Pkt *p = new Pkt();
+  p->is_tcp = 1;
+  p->header_size = HDR_TCP;
+  p->src_ip = s->bound_ip;
+  p->src_port = (int32_t)s->bound_port;
+  p->dst_ip = s->peer_ip;
+  p->dst_port = (int32_t)s->peer_port;
+  p->flags = (uint8_t)flags;
+  p->seq = seg.seq;
+  p->ack = s->rcv_nxt;
+  p->window = tcp_adv_window(s);
+  p->nsack = tcp_sack_blocks(s, p->sack);
+  p->ts = seg.send_time_ns;
+  p->ts_echo = 0;
+  p->uid = h->next_packet_uid();         // fresh uid: independent drop draw
+  p->priority = h->next_packet_priority();
+  p->payload = seg.payload;
+  p->retransmit = 1;                     // SND_TCP_ENQUEUE_RETRANSMIT
+  s->out_packets.push_back(p);
+  s->out_bytes += p->total_size();
+  Iface *f = sock_iface(pl, s);
+  if (f) CK(iface_wants_send(pl, f, s));
+  return true;
+}
+
+bool tcp_fail_connection(Plane *pl, Sock *s, int err) {
+  s->err = err;
+  tcp_cancel_rto(s);
+  s->eof_received = true;
+  if (s->parent >= 0 && !s->accepted) {
+    CK(tcp_teardown(pl, s));
+  } else {
+    s->state = ST_CLOSED;
+    sock_release_bindings(s);
+  }
+  CK(sock_adjust_status(pl, s, S_READABLE | S_WRITABLE, true));
+  return true;
+}
+
+bool tcp_schedule_persist(Plane *pl, Sock *s) {
+  if (s->persist_scheduled) return true;
+  s->persist_scheduled = true;
+  plane_schedule(pl, EV_PERSIST, std::max(s->rto_ns, RTO_MIN), s->hid,
+                 s->id, 0, nullptr);
+  return true;
+}
+
+// ---- the send pipeline (tcp.c _tcp_flush :1121-1278) -----------------------
+bool tcp_retransmit_range(Plane *pl, Sock *s, int64_t b, int64_t e) {
+  for (auto &seg : s->unacked) {
+    if (seg.end <= b || seg.seq >= e) continue;
+    CK(tcp_retransmit_segment(pl, s, seg));
+  }
+  return true;
+}
+
+bool tcp_flush(Plane *pl, Sock *s) {
+  if (s->state == ST_CLOSED) return true;
+  // 1. retransmit tally-marked-lost ranges
+  if (s->tally_dirty) {
+    s->tally_dirty = false;
+    if (!s->tally.lost.empty()) {
+      std::vector<Range> lost;
+      lost.swap(s->tally.lost);  // lost_ranges() + clear_lost()
+      for (auto &r : lost) CK(tcp_retransmit_range(pl, s, r.first, r.second));
+    }
+  }
+  // 2. new data within min(cwnd, peer window); the send buffer is a byte
+  // stream — small app writes coalesce into full-MSS segments
+  bool emitted = false;
+  while (s->send_pending.size() > 0) {
+    int64_t n = std::min(MSS, tcp_send_capacity(s));
+    if (n == 0) break;
+    std::string payload = s->send_pending.pop(n);
+    n = (int64_t)payload.size();
+    s->send_pending_bytes -= n;
+    CK(tcp_emit(pl, s, F_ACK, s->snd_nxt, payload.data(), n, -1,
+                /*track=*/true, /*notify=*/false));
+    s->snd_nxt += n;
+    emitted = true;
+  }
+  // 3. FIN once all data is out
+  if (s->fin_pending && s->send_pending.size() == 0 && s->fin_seq < 0) {
+    s->fin_seq = s->snd_nxt;
+    CK(tcp_emit(pl, s, F_FIN | F_ACK, s->snd_nxt, nullptr, 0, -1, true,
+                false));
+    s->snd_nxt += 1;
+    s->fin_pending = false;
+    emitted = true;
+  }
+  if (emitted) {
+    Iface *f = sock_iface(pl, s);
+    if (f) CK(iface_wants_send(pl, f, s));
+  }
+  // 4. zero-window persist
+  if (s->send_pending.size() > 0 && s->snd_wnd <= 0 && s->unacked.empty())
+    CK(tcp_schedule_persist(pl, s));
+  return true;
+}
+
+// ---- port allocation / binding (host/host.py) ------------------------------
+constexpr int64_t MIN_EPHEMERAL_PORT = 10000, MAX_PORT = 65535;
+
+// returns port or -1 (EADDRINUSE exhausted)
+int64_t host_alloc_port(HostS *h, int kind, Iface *a, Iface *b) {
+  for (int64_t i = 0; i < MAX_PORT - MIN_EPHEMERAL_PORT + 1; i++) {
+    int64_t port = h->next_port++;
+    if (h->next_port > MAX_PORT) h->next_port = MIN_EPHEMERAL_PORT;
+    bool free_ = (!a || !iface_is_associated(a, kind, port)) &&
+                 (!b || !iface_is_associated(b, kind, port));
+    if (free_) return port;
+  }
+  return -1;
+}
+
+// autobind on send/connect without bind() (socket.c behavior)
+int host_autobind(Plane *pl, Sock *s, int64_t dst_ip) {
+  HostS *h = pl->H(s->hid);
+  int64_t src_ip = (dst_ip == h->lo_ip) ? h->lo_ip : h->ip;
+  Iface *f = h->iface_for_ip(src_ip);
+  int64_t port = host_alloc_port(h, s->kind, f, nullptr);
+  if (port < 0) return E_ADDRINUSE;
+  s->bound_ip = src_ip;
+  s->bound_port = port;
+  if (f) iface_associate(f, s, port, 0, 0);
+  return E_NONE;
+}
+
+// ---- TCP user API ----------------------------------------------------------
+int tcp_connect(Plane *pl, Sock *s, int64_t dst_ip, int64_t dst_port,
+                bool *cb_err) {
+  *cb_err = false;
+  if (s->state != ST_CLOSED) return E_ISCONN;
+  if (s->bound_port < 0) {
+    int e = host_autobind(pl, s, dst_ip);
+    if (e) return e;
+  }
+  s->peer_ip = dst_ip;
+  s->peer_port = dst_port;
+  Iface *f = sock_iface(pl, s);
+  if (f) {
+    // narrow the wildcard binding to the 4-tuple for reply routing
+    iface_disassociate(pl, f, K_TCP, s->bound_port, 0, 0);
+    iface_associate(f, s, s->bound_port, dst_ip, dst_port);
+  }
+  s->cong.init(pl->cc_kind, MSS, pl->cc_ssthresh, pl->cc_init_segments);
+  s->has_cong = true;
+  s->snd_wnd = std::max<int64_t>(1, pl->cc_init_segments) * MSS;
+  s->iss = 0;
+  s->snd_una = s->snd_nxt = s->iss;
+  s->state = ST_SYN_SENT;
+  if (!tcp_emit(pl, s, F_SYN, s->snd_nxt, nullptr, 0, -1, true, true)) {
+    *cb_err = true;
+    return E_NONE;
+  }
+  s->snd_nxt += 1;
+  return E_NONE;
+}
+
+int tcp_listen(Plane *pl, Sock *s, int64_t backlog) {
+  if (s->state != ST_CLOSED && s->state != ST_LISTEN) return E_INVAL;
+  if (s->bound_port < 0) {
+    int e = host_autobind(pl, s, 0);
+    if (e) return e;
+  }
+  s->state = ST_LISTEN;
+  s->backlog = backlog;
+  return E_NONE;
+}
+
+// returns child sock id or -1
+int32_t tcp_accept_child(Plane *pl, Sock *s, bool *cb_err) {
+  *cb_err = false;
+  if (s->accept_q.empty()) return -1;
+  int32_t cid = s->accept_q.front();
+  s->accept_q.pop_front();
+  pl->S(cid)->accepted = true;
+  if (!sock_adjust_status(pl, s, S_READABLE, !s->accept_q.empty()))
+    *cb_err = true;
+  return cid;
+}
+
+// returns n sent (>=0) or negative error; *cb_err on callback exception
+int64_t tcp_send_user(Plane *pl, Sock *s, const char *data, int64_t len,
+                      bool *cb_err) {
+  *cb_err = false;
+  if (s->write_shutdown) return -E_PIPE;
+  if (s->state != ST_ESTABLISHED && s->state != ST_CLOSE_WAIT)
+    return -(s->err != E_NONE ? s->err : E_NOTCONN);
+  int64_t space = s->send_buf_size - s->send_pending_bytes -
+                  (s->snd_nxt - s->snd_una);
+  int64_t n = std::min(len, std::max<int64_t>(0, space));
+  if (n == 0) {
+    if (!tcp_update_writable(pl, s)) *cb_err = true;
+    return 0;
+  }
+  s->send_pending.append(data, (size_t)n);
+  s->send_pending_bytes += n;
+  if (!tcp_flush(pl, s) || !tcp_update_writable(pl, s)) *cb_err = true;
+  return n;
+}
+
+// ---- TCP teardown ----------------------------------------------------------
+inline uint64_t child_key(int64_t ip, int64_t port) {
+  return ((uint64_t)(ip & 0xFFFFFFFFu) << 16) | (uint64_t)(port & 0xFFFF);
+}
+
+bool tcp_detach_child(Plane *pl, Sock *parent, Sock *child) {
+  parent->children.erase(child_key(child->peer_ip, child->peer_port));
+  for (auto it = parent->accept_q.begin(); it != parent->accept_q.end(); ++it)
+    if (*it == child->id) {
+      parent->accept_q.erase(it);
+      CK(tcp_update_readable(pl, parent));
+      break;
+    }
+  return true;
+}
+
+bool tcp_teardown(Plane *pl, Sock *s) {
+  s->state = ST_CLOSED;
+  tcp_cancel_rto(s);
+  // a closing listener resets every connection the app has not accepted
+  std::vector<int32_t> kids;
+  for (auto &kv : s->children) kids.push_back(kv.second);
+  for (int32_t cid : kids) {
+    Sock *c = pl->S(cid);
+    c->parent = -1;
+    if (!c->accepted && !c->closed) {
+      if (c->state != ST_CLOSED && c->state != ST_LISTEN)
+        CK(tcp_emit(pl, c, F_RST | F_ACK, c->snd_nxt, nullptr, 0, -1, true,
+                    true));
+      CK(tcp_teardown(pl, c));
+    }
+  }
+  s->children.clear();
+  s->accept_q.clear();
+  if (s->parent >= 0) CK(tcp_detach_child(pl, pl->S(s->parent), s));
+  if (!s->closed) CK(sock_base_close(pl, s));
+  return true;
+}
+
+bool tcp_enter_time_wait(Plane *pl, Sock *s) {
+  s->state = ST_TIME_WAIT;
+  tcp_cancel_rto(s);
+  plane_schedule(pl, EV_TIMEWAIT, TIME_WAIT_NS, s->hid, s->id, 0, nullptr);
+  return true;
+}
+
+bool tcp_app_close(Plane *pl, Sock *s) {
+  if (s->app_closed) return true;
+  s->app_closed = true;
+  if (s->state == ST_LISTEN ||
+      (s->state == ST_CLOSED && s->err == E_NONE && !s->has_cong))
+    return tcp_teardown(pl, s);
+  if (s->state == ST_CLOSED || s->state == ST_TIME_WAIT)
+    return tcp_teardown(pl, s);
+  if (s->state == ST_ESTABLISHED || s->state == ST_SYN_RECEIVED) {
+    s->state = ST_FIN_WAIT_1;
+    s->fin_pending = true;
+    CK(tcp_flush(pl, s));
+  } else if (s->state == ST_CLOSE_WAIT) {
+    s->state = ST_LAST_ACK;
+    s->fin_pending = true;
+    CK(tcp_flush(pl, s));
+  } else if (s->state == ST_SYN_SENT) {
+    CK(tcp_fail_connection(pl, s, E_CONNABORTED));
+    CK(tcp_teardown(pl, s));
+  }
+  return true;
+}
+
+int tcp_shutdown(Plane *pl, Sock *s, int how, bool *cb_err) {
+  *cb_err = false;
+  if (how != 0 && how != 1 && how != 2) return E_INVAL;
+  if (s->state == ST_CLOSED || s->state == ST_LISTEN ||
+      s->state == ST_SYN_SENT)
+    return E_NOTCONN;
+  if ((how == 1 || how == 2) && !s->fin_pending && s->fin_seq < 0) {
+    if (s->state == ST_ESTABLISHED || s->state == ST_SYN_RECEIVED) {
+      s->state = ST_FIN_WAIT_1;
+      s->fin_pending = true;
+      if (!tcp_flush(pl, s)) { *cb_err = true; return E_NONE; }
+    } else if (s->state == ST_CLOSE_WAIT) {
+      s->state = ST_LAST_ACK;
+      s->fin_pending = true;
+      if (!tcp_flush(pl, s)) { *cb_err = true; return E_NONE; }
+    }
+    s->write_shutdown = true;
+    if (!sock_adjust_status(pl, s, S_WRITABLE, false)) {
+      *cb_err = true;
+      return E_NONE;
+    }
+  }
+  if (how == 0 || how == 2) {
+    s->read_q.clear();
+    s->read_bytes = 0;
+    s->eof_received = true;
+    if (!tcp_update_readable(pl, s)) *cb_err = true;
+  }
+  return E_NONE;
+}
+
+// ---- inbound processing (tcp.c tcp_processPacket :1777-2099) ---------------
+bool tcp_on_snd_una_advanced(Plane *pl, Sock *s, int64_t ack) {
+  if (s->state == ST_SYN_RECEIVED && ack >= s->iss + 1) {
+    s->state = ST_ESTABLISHED;
+    CK(tcp_update_writable(pl, s));
+    if (s->parent >= 0) {
+      Sock *parent = pl->S(s->parent);
+      parent->accept_q.push_back(s->id);
+      CK(sock_adjust_status(pl, parent, S_READABLE, true));
+    }
+  }
+  if (s->fin_seq >= 0 && ack >= s->fin_seq + 1) {
+    s->fin_acked = true;
+    if (s->state == ST_FIN_WAIT_1) s->state = ST_FIN_WAIT_2;
+    else if (s->state == ST_CLOSING) CK(tcp_enter_time_wait(pl, s));
+    else if (s->state == ST_LAST_ACK) CK(tcp_teardown(pl, s));
+  }
+  return true;
+}
+
+bool tcp_ack_processing(Plane *pl, Sock *s, Pkt *p) {
+  int64_t ack = p->ack;
+  s->snd_wnd = p->window;
+  int64_t now = pl->now;
+  for (int i = 0; i < p->nsack; i++) {
+    int64_t b = p->sack[i][0], e = p->sack[i][1];
+    if (e > s->snd_una) s->tally.mark_sacked(std::max(b, s->snd_una), e);
+  }
+  if (ack > s->snd_una) {
+    int64_t acked_bytes = ack - s->snd_una;
+    s->snd_una = ack;
+    s->dup_ack_count = 0;
+    s->tally.advance_una(ack);
+    int64_t newest_ts = 0;
+    while (!s->unacked.empty() && s->unacked.front().end <= ack) {
+      Seg &seg = s->unacked.front();
+      if (seg.rtx_count == 0) newest_ts = std::max(newest_ts, seg.send_time_ns);
+      s->unacked.pop_front();
+    }
+    if (p->ts_echo) tcp_rtt_sample(pl, s, now - p->ts_echo);
+    else if (newest_ts) tcp_rtt_sample(pl, s, now - newest_ts);
+    if (s->has_cong) s->cong.on_new_ack(acked_bytes, s->snd_una, now);
+    if (!s->unacked.empty()) {
+      s->rto_expiry = now + s->rto_ns;
+      tcp_arm_rto(pl, s);
+    } else {
+      tcp_cancel_rto(s);
+    }
+    CK(tcp_on_snd_una_advanced(pl, s, ack));
+  } else if (ack == s->snd_una && s->snd_nxt > s->snd_una &&
+             p->payload_size() == 0 && !(p->flags & (F_SYN | F_FIN))) {
+    // pure duplicate ACK
+    s->dup_ack_count++;
+    s->tally.update_lost(s->snd_una, s->dup_ack_count);
+    s->tally_dirty = true;
+    if (s->has_cong &&
+        s->cong.on_duplicate_ack(s->dup_ack_count, s->snd_nxt)) {
+      // fast retransmit: without SACK info, the una segment is lost
+      if (s->tally.lost.empty()) {
+        for (auto &seg : s->unacked) {
+          if (seg.seq == s->snd_una) {
+            s->tally.mark_lost(seg.seq, seg.end);
+            break;
+          }
+          if (seg.seq > s->snd_una) break;
+        }
+      }
+    }
+  }
+  CK(tcp_flush(pl, s));
+  CK(tcp_update_writable(pl, s));
+  return true;
+}
+
+void tcp_append_read(Sock *s, const char *data, int64_t n) {
+  if (!n) return;
+  s->read_q.append(data, (size_t)n);
+  s->read_bytes += n;
+}
+
+bool tcp_on_fin_received(Plane *pl, Sock *s) {
+  s->eof_received = true;
+  if (s->state == ST_ESTABLISHED) s->state = ST_CLOSE_WAIT;
+  else if (s->state == ST_FIN_WAIT_1) {
+    if (!s->fin_acked) s->state = ST_CLOSING;
+    else { s->state = ST_TIME_WAIT; CK(tcp_enter_time_wait(pl, s)); }
+  } else if (s->state == ST_FIN_WAIT_2) {
+    CK(tcp_enter_time_wait(pl, s));
+  }
+  CK(sock_adjust_status(pl, s, S_READABLE, true));  // EOF is readable
+  return true;
+}
+
+bool tcp_drain_reorder(Plane *pl, Sock *s) {
+  for (;;) {
+    auto it = s->reorder.find(s->rcv_nxt);
+    if (it == s->reorder.end()) break;
+    Pkt *p = it->second;
+    s->reorder.erase(it);
+    s->reorder_bytes -= p->payload_size();
+    tcp_append_read(s, p->payload.data(), p->payload_size());
+    s->rcv_nxt += p->payload_size();
+    bool fin = (p->flags & F_FIN) != 0;
+    delete p;
+    if (fin) {
+      s->rcv_nxt += 1;
+      CK(tcp_on_fin_received(pl, s));
+    }
+  }
+  return true;
+}
+
+// takes ownership of p (frees it unless parked in the reorder buffer)
+bool tcp_data_processing(Plane *pl, Sock *s, Pkt *p) {
+  int64_t seq = p->seq;
+  int64_t size = p->payload_size();
+  int64_t end = seq + size;
+  int64_t ts = p->ts;
+  if (size > 0) {
+    if (end <= s->rcv_nxt) {
+      // full duplicate: re-ACK so the sender's tally advances
+      delete p;
+      return tcp_send_ack(pl, s, ts);
+    }
+    if (seq > s->rcv_nxt) {
+      // out of order: hold in reorder buffer if window allows
+      if (s->reorder_bytes + size <= s->recv_buf_size &&
+          !s->reorder.count(seq)) {
+        s->reorder[seq] = p;
+        s->reorder_bytes += size;
+        p = nullptr;
+      } else {
+        delete p;  // RCV_SOCKET_DROPPED
+      }
+      return tcp_send_ack(pl, s, ts);  // dup ACK w/ SACK blocks
+    }
+    // in order (possibly partially duplicate)
+    int64_t off = s->rcv_nxt - seq;
+    tcp_append_read(s, p->payload.data() + off, size - off);
+    s->rcv_nxt = end;
+    CK(tcp_drain_reorder(pl, s));
+  }
+  bool fin = (p->flags & F_FIN) != 0;
+  delete p;
+  if (fin) {
+    int64_t fin_seq = seq + size;
+    if (fin_seq == s->rcv_nxt) {
+      s->rcv_nxt = fin_seq + 1;
+      CK(tcp_on_fin_received(pl, s));
+    }
+    CK(tcp_send_ack(pl, s, ts));
+  } else {
+    CK(tcp_schedule_delayed_ack(pl, s));
+  }
+  if (size > 0) {
+    s->rtt_bytes_in += size;
+    tcp_recv_autotune(pl, s);
+    CK(tcp_update_readable(pl, s));
+  }
+  return true;
+}
+
+bool tcp_push_in(Plane *pl, Sock *s, Pkt *p);  // fwd (listen recurses)
+
+// LISTEN: spawn children (tcp.c child/server mux :91-113)
+bool tcp_listen_process(Plane *pl, Sock *s, Pkt *p) {
+  uint64_t key = child_key(p->src_ip, p->src_port);
+  auto it = s->children.find(key);
+  if (it != s->children.end()) {
+    return tcp_push_in(pl, pl->S(it->second), p);
+  }
+  if (!(p->flags & F_SYN)) { delete p; return true; }  // stray non-SYN
+  // backlog counts connections not yet handed to accept()
+  int64_t pending = (int64_t)s->accept_q.size();
+  for (auto &kv : s->children)
+    if (pl->S(kv.second)->state == ST_SYN_RECEIVED) pending++;
+  if (pending >= std::max<int64_t>(s->backlog, 1)) { delete p; return true; }
+  HostS *h = pl->H(s->hid);
+  Sock *c = new Sock();
+  c->id = (int32_t)pl->socks->size();
+  pl->socks->push_back(c);
+  c->hid = s->hid;
+  c->kind = K_TCP;
+  c->handle = h->next_handle++;
+  c->recv_buf_size = h->recv_buf_size;
+  c->send_buf_size = h->send_buf_size;
+  c->autotune_recv = h->autotune_recv;
+  c->autotune_send = h->autotune_send;
+  c->last_adv_window = c->recv_buf_size;
+  c->status = S_ACTIVE;
+  c->parent = s->id;
+  // register_descriptor on the Python side (digest sees embryonic children)
+  if (!plane_cb(pl, CB_CHILD, c->hid, c->id, c->handle)) { delete p; return false; }
+  // reply with the address the SYN actually arrived on
+  c->bound_ip = p->dst_ip;
+  c->bound_port = s->bound_port;
+  c->peer_ip = p->src_ip;
+  c->peer_port = p->src_port;
+  c->cong.init(pl->cc_kind, MSS, pl->cc_ssthresh, pl->cc_init_segments);
+  c->has_cong = true;
+  c->snd_wnd = std::max<int64_t>(1, pl->cc_init_segments) * MSS;
+  s->children[key] = c->id;
+  Iface *f = h->iface_for_ip(p->dst_ip);
+  if (!f) f = sock_iface(pl, s);
+  if (f) iface_associate(f, c, c->bound_port, p->src_ip, p->src_port);
+  // receive SYN
+  c->irs = p->seq;
+  c->rcv_nxt = p->seq + 1;
+  c->snd_wnd = p->window ? p->window : MSS;
+  c->state = ST_SYN_RECEIVED;
+  c->iss = 0;
+  c->snd_una = c->snd_nxt = c->iss;
+  int64_t echo = p->ts;
+  delete p;
+  CK(tcp_emit(pl, c, F_SYN | F_ACK, c->snd_nxt, nullptr, 0, echo, true,
+              true));
+  c->snd_nxt += 1;
+  return true;
+}
+
+bool tcp_syn_sent_process(Plane *pl, Sock *s, Pkt *p) {
+  if (!((p->flags & F_SYN) && (p->flags & F_ACK))) { delete p; return true; }
+  if (p->ack != s->snd_nxt) { delete p; return true; }
+  s->irs = p->seq;
+  s->rcv_nxt = p->seq + 1;
+  s->snd_una = p->ack;
+  s->snd_wnd = p->window ? p->window : MSS;
+  // unacked.pop(self.iss): drop the SYN segment
+  if (!s->unacked.empty() && s->unacked.front().seq == s->iss)
+    s->unacked.pop_front();
+  tcp_cancel_rto(s);
+  if (p->ts_echo) tcp_rtt_sample(pl, s, pl->now - p->ts_echo);
+  s->state = ST_ESTABLISHED;
+  int64_t echo = p->ts;
+  delete p;
+  CK(tcp_send_ack(pl, s, echo));
+  CK(tcp_update_writable(pl, s));
+  return true;
+}
+
+bool tcp_process_rst(Plane *pl, Sock *s, Pkt *p) {
+  int err = (s->state == ST_SYN_SENT) ? E_CONNREFUSED : E_CONNRESET;
+  delete p;
+  if (s->parent >= 0) CK(tcp_detach_child(pl, pl->S(s->parent), s));
+  return tcp_fail_connection(pl, s, err);
+}
+
+bool tcp_push_in(Plane *pl, Sock *s, Pkt *p) {
+  int flags = p->flags;
+  if (s->state == ST_LISTEN) return tcp_listen_process(pl, s, p);
+  if (flags & F_RST) return tcp_process_rst(pl, s, p);
+  if (s->state == ST_SYN_SENT) return tcp_syn_sent_process(pl, s, p);
+  if (flags & F_SYN) {
+    // duplicate SYN (our SYN+ACK or its ACK was lost): re-ACK
+    int64_t echo = p->ts;
+    delete p;
+    return tcp_send_ack(pl, s, echo);
+  }
+  if (flags & F_ACK) CK(tcp_ack_processing(pl, s, p));
+  if (p->payload_size() > 0 || (flags & F_FIN))
+    return tcp_data_processing(pl, s, p);  // takes ownership
+  delete p;
+  return true;
+}
+
+// ---- UDP (descriptor/udp.py) -----------------------------------------------
+bool udp_update_readable(Plane *pl, Sock *s) {
+  return sock_adjust_status(pl, s, S_READABLE, !s->in_packets.empty());
+}
+
+bool udp_update_writable(Plane *pl, Sock *s) {
+  int64_t max_need = std::min(DGRAM_MAX + HDR_UDP, s->send_buf_size);
+  bool w = (s->out_bytes + max_need <= s->send_buf_size) && !s->closed;
+  return sock_adjust_status(pl, s, S_WRITABLE, w);
+}
+
+// returns n (>=0) or negative error
+int64_t udp_send_user(Plane *pl, Sock *s, const char *data, int64_t len,
+                      int64_t dst_ip, int64_t dst_port, bool *cb_err) {
+  *cb_err = false;
+  HostS *h = pl->H(s->hid);
+  if (dst_ip == 0) {
+    if (s->peer_ip < 0) return -E_DESTADDRREQ;
+    dst_ip = s->peer_ip;
+    dst_port = s->peer_port;
+  }
+  if (s->bound_port < 0) {
+    int e = host_autobind(pl, s, dst_ip);
+    if (e) return -e;
+  }
+  if (len > DGRAM_MAX) return -E_MSGSIZE;
+  int64_t need = len + HDR_UDP;
+  if (need > s->send_buf_size) return -E_MSGSIZE;
+  if (s->out_bytes + need > s->send_buf_size) return 0;  // EWOULDBLOCK
+  Pkt *p = new Pkt();
+  p->is_tcp = 0;
+  p->header_size = HDR_UDP;
+  p->uid = h->next_packet_uid();
+  p->priority = h->next_packet_priority();
+  p->src_ip = s->bound_ip;
+  p->src_port = (int32_t)s->bound_port;
+  p->dst_ip = dst_ip;
+  p->dst_port = (int32_t)dst_port;
+  p->payload.assign(data, (size_t)len);
+  s->out_packets.push_back(p);
+  s->out_bytes += p->total_size();
+  Iface *f = h->iface_for_ip(s->bound_ip);
+  if (f && !iface_wants_send(pl, f, s)) { *cb_err = true; return len; }
+  if (!udp_update_writable(pl, s)) *cb_err = true;
+  return len;
+}
+
+// takes ownership of p
+bool udp_push_in(Plane *pl, Sock *s, Pkt *p) {
+  if (s->peer_ip >= 0 &&
+      (p->src_ip != s->peer_ip || p->src_port != s->peer_port)) {
+    delete p;  // RCV_SOCKET_DROPPED
+    return true;
+  }
+  if (s->in_bytes + p->total_size() > s->recv_buf_size) {
+    delete p;
+    return true;
+  }
+  s->in_packets.push_back(p);
+  s->in_bytes += p->total_size();
+  return udp_update_readable(pl, s);
+}
+
+// ---- interface send/receive loops (host/network_interface.py) --------------
+bool iface_has_pending(Iface *f) {
+  if (!f->ready_senders.empty()) return true;
+  if (f->router && f->router->peek_any()) return true;
+  return !f->arrivals.empty();
+}
+
+void iface_ensure_refill(Plane *pl, Iface *f) {
+  if (f->refill_scheduled || f->is_loopback) return;
+  f->refill_scheduled = true;  // stays set even if scheduling declines
+  plane_schedule(pl, EV_REFILL, REFILL_INTERVAL, f->host->id,
+                 f == &f->host->lo ? 0 : 1, 0, nullptr);
+}
+
+// deliver one received packet to its bound socket (+ tracker); owns pkt
+bool iface_deliver(Plane *pl, Iface *f, Pkt *p) {
+  Sock *s = iface_lookup(pl, f, p);
+  HostS *h = f->host;
+  if (!s) {
+    // RCV_INTERFACE_DROPPED
+    h->drops++;
+    delete p;
+    return true;
+  }
+  bool local = f->ip == h->lo_ip;
+  TrackCtr &ctr = local ? h->in_local : h->in_remote;
+  // push first, then count (mirrors _deliver's order; retransmit split is
+  // an output-side concept, input adds never mark retrans)
+  int64_t tot = p->total_size(), psz = p->payload_size();
+  uint8_t retrans = p->retransmit;
+  if (s->kind == K_TCP) CK(tcp_push_in(pl, s, p));
+  else CK(udp_push_in(pl, s, p));
+  (void)retrans;
+  ctr.packets_total++;
+  ctr.bytes_total += tot;
+  if (psz == 0) { ctr.packets_control++; ctr.bytes_control += tot; }
+  else { ctr.packets_data++; ctr.bytes_data += tot; }
+  return true;
+}
+
+bool iface_receive_packets(Plane *pl, Iface *f) {
+  int64_t now = pl->now;
+  bool bootstrapping = now < pl->bootstrap_end;
+  for (;;) {
+    Pkt *p = nullptr;
+    bool from_local = false;
+    if (!f->arrivals.empty()) {
+      p = f->arrivals.front();
+      from_local = true;
+    } else if (f->router) {
+      p = f->router->peek_deliverable(now);
+    }
+    if (!p) return true;
+    bool unthrottled = f->is_loopback || bootstrapping;
+    if (!unthrottled && !f->receive_bucket.try_consume(p->total_size()))
+      return true;  // out of tokens; refill task resumes us
+    if (from_local) f->arrivals.pop_front();
+    else p = f->router->take(now);
+    // RCV_INTERFACE_RECEIVED
+    CK(iface_deliver(pl, f, p));
+  }
+}
+
+// qdisc: rr = rotate ready ring; fifo = lowest packet priority first
+Sock *iface_select_socket(Plane *pl, Iface *f) {
+  while (!f->ready_senders.empty()) {
+    if (f->qdisc_rr) {
+      Sock *s = pl->S(f->ready_senders.front());
+      if (s->out_packets.empty()) {
+        f->ready_senders.pop_front();
+        s->in_ready = false;
+        continue;
+      }
+      return s;
+    }
+    Sock *best = nullptr;
+    int64_t best_prio = 0;
+    for (int32_t sid : f->ready_senders) {
+      Sock *s = pl->S(sid);
+      if (s->out_packets.empty()) continue;
+      int64_t prio = s->out_packets.front()->priority;
+      if (!best || prio < best_prio) { best = s; best_prio = prio; }
+    }
+    if (!best) {
+      for (int32_t sid : f->ready_senders) pl->S(sid)->in_ready = false;
+      f->ready_senders.clear();
+      return nullptr;
+    }
+    return best;
+  }
+  return nullptr;
+}
+
+bool plane_send_packet(Plane *pl, Pkt *p);  // fwd: the inter-host hop
+
+bool iface_send_packets(Plane *pl, Iface *f) {
+  HostS *h = f->host;
+  bool bootstrapping = pl->now < pl->bootstrap_end;
+  for (;;) {
+    Sock *s = iface_select_socket(pl, f);
+    if (!s) return true;
+    Pkt *p = s->out_packets.front();
+    bool unthrottled = f->is_loopback || bootstrapping;
+    if (!unthrottled && !f->send_bucket.try_consume(p->total_size()))
+      return true;
+    // sock.pull_out_packet() (+ the TCP/UDP writable-update override)
+    s->out_packets.pop_front();
+    s->out_bytes -= p->total_size();
+    if (s->kind == K_TCP) CK(tcp_update_writable(pl, s));
+    else CK(udp_update_writable(pl, s));
+    if (f->qdisc_rr && !f->ready_senders.empty() &&
+        f->ready_senders.front() == s->id) {
+      f->ready_senders.push_back(f->ready_senders.front());
+      f->ready_senders.pop_front();
+    }
+    // SND_INTERFACE_SENT + tracker
+    bool local_if = f->ip == h->lo_ip;
+    TrackCtr &ctr = local_if ? h->out_local : h->out_remote;
+    ctr.add(p, p->retransmit != 0);
+    int64_t dst_ip = p->dst_ip;
+    if (f->is_loopback || dst_ip == f->ip) {
+      // local short-circuit: self-delivery task after a minimal 1-tick
+      // delay to keep event ordering honest
+      Iface *target = h->iface_for_ip(dst_ip);
+      if (!target) target = f;
+      plane_schedule(pl, EV_LOCAL, 1, h->id, target == &h->lo ? 0 : 1, 0, p);
+    } else {
+      CK(plane_send_packet(pl, p));
+    }
+  }
+}
+
+bool iface_wants_send(Plane *pl, Iface *f, Sock *s) {
+  if (!s->in_ready) {
+    s->in_ready = true;
+    f->ready_senders.push_back(s->id);
+  }
+  CK(iface_send_packets(pl, f));
+  if (iface_has_pending(f)) iface_ensure_refill(pl, f);
+  return true;
+}
+
+bool iface_push_arrival(Plane *pl, Iface *f, Pkt *p) {
+  f->arrivals.push_back(p);
+  CK(iface_receive_packets(pl, f));
+  if (iface_has_pending(f)) iface_ensure_refill(pl, f);
+  return true;
+}
+
+bool iface_on_refill(Plane *pl, Iface *f) {
+  f->refill_scheduled = false;
+  f->send_bucket.do_refill();
+  f->receive_bucket.do_refill();
+  CK(iface_receive_packets(pl, f));
+  CK(iface_send_packets(pl, f));
+  if (iface_has_pending(f)) iface_ensure_refill(pl, f);
+  return true;
+}
+
+// ---- the inter-host hop (core/worker.py send_packet) -----------------------
+bool plane_send_packet(Plane *pl, Pkt *p) {
+  int64_t src_row = -1, dst_row = -1;
+  {
+    auto it = pl->ip2host->find(p->src_ip);
+    if (it != pl->ip2host->end()) src_row = pl->H(it->second)->topo_row;
+  }
+  auto dit = pl->ip2host->find(p->dst_ip);
+  if (dit == pl->ip2host->end() || src_row < 0) {
+    // unknown destination: INET_DROPPED (no drop counter — mirrors
+    // worker.send_packet's host_by_ip-None path)
+    delete p;
+    return true;
+  }
+  HostS *dst_host = pl->H(dit->second);
+  dst_row = dst_host->topo_row;
+  double rel = (double)pl->rel[src_row * pl->A + dst_row];
+  bool bootstrapping = pl->now < pl->bootstrap_end;
+  if (!bootstrapping && rel < 1.0) {
+    double u = drop_uniform(pl->drop_key, (uint64_t)p->uid);
+    if (u > rel) {
+      // INET_DROPPED + engine.count_packet_drop
+      pl->packet_drops++;
+      delete p;
+      return true;
+    }
+  }
+  // latency_ns_ip: lookup + per-path packet count (topology.py:394-398)
+  pl->path_counts[src_row * pl->A + dst_row] += 1;
+  int64_t latency = pl->lat[src_row * pl->A + dst_row];
+  // INET_SENT; schedule the delivery on the destination host
+  plane_schedule(pl, EV_DELIVER, latency, dst_host->id, 0, 0, p);
+  return true;
+}
+
+// EV_DELIVER execution (core/worker.py _deliver_packet_task)
+bool plane_deliver(Plane *pl, int32_t hid, Pkt *p) {
+  HostS *h = pl->H(hid);
+  Iface *f = h->iface_for_ip(p->dst_ip);
+  if (!f) { delete p; return true; }  // INET_DROPPED
+  if (f->router) {
+    // Router.enqueue: AQM admit/drop, then nudge the receive loop
+    bool was_empty = f->router->qlen_queue_only() == 0;
+    bool admitted = f->router->enqueue_q(p, pl->now);
+    if (!admitted) { delete p; return true; }  // ROUTER_DROPPED
+    if (was_empty) {
+      // on_router_ready
+      CK(iface_receive_packets(pl, f));
+      if (iface_has_pending(f)) iface_ensure_refill(pl, f);
+    }
+    return true;
+  }
+  return iface_push_arrival(pl, f, p);
+}
+
+// ---- event execution -------------------------------------------------------
+bool plane_exec(Plane *pl, Ev &ev) {
+  pl->now = ev.time;
+  pl->active_host = ev.dst;
+  pl->last_event_time = ev.time;
+  pl->events_executed++;
+  switch (ev.type) {
+    case EV_DELIVER:
+      return plane_deliver(pl, ev.dst, ev.pkt);
+    case EV_LOCAL: {
+      HostS *h = pl->H(ev.dst);
+      Iface *f = ev.a == 0 ? &h->lo : &h->eth;
+      return iface_push_arrival(pl, f, ev.pkt);
+    }
+    case EV_REFILL: {
+      HostS *h = pl->H(ev.dst);
+      Iface *f = ev.a == 0 ? &h->lo : &h->eth;
+      return iface_on_refill(pl, f);
+    }
+    case EV_RTO: {
+      Sock *s = pl->S(ev.a);
+      // stale generations must not clear the armed flag (tcp.py:515-521)
+      if (ev.b != s->rto_generation || s->closed) return true;
+      s->rto_scheduled = false;
+      int64_t now = pl->now;
+      if (s->unacked.empty()) return true;
+      if (now < s->rto_expiry) {
+        // a newer ACK pushed the deadline; re-sleep the difference
+        s->rto_scheduled = true;
+        plane_schedule(pl, EV_RTO, s->rto_expiry - now, s->hid, s->id,
+                       s->rto_generation, nullptr);
+        return true;
+      }
+      Seg &seg = s->unacked.front();
+      if (s->state == ST_SYN_SENT && seg.rtx_count >= MAX_SYN_RETRIES)
+        return tcp_fail_connection(pl, s, E_TIMEDOUT);
+      if (seg.rtx_count >= MAX_RETRIES)
+        return tcp_fail_connection(pl, s, E_TIMEDOUT);
+      if (s->has_cong) s->cong.on_timeout();
+      s->dup_ack_count = 0;
+      s->rto_ns = std::min(s->rto_ns * 2, RTO_MAX);
+      CK(tcp_retransmit_segment(pl, s, seg));
+      tcp_arm_rto(pl, s);
+      return true;
+    }
+    case EV_PERSIST: {
+      Sock *s = pl->S(ev.a);
+      s->persist_scheduled = false;
+      if (s->closed || (s->state != ST_ESTABLISHED &&
+                        s->state != ST_CLOSE_WAIT &&
+                        s->state != ST_FIN_WAIT_1))
+        return true;
+      if (s->send_pending.size() == 0 || s->snd_wnd > 0 ||
+          !s->unacked.empty())
+        return tcp_flush(pl, s);
+      // window probe: force out 1 byte of pending data as a real segment
+      std::string one = s->send_pending.pop(1);
+      s->send_pending_bytes -= 1;
+      CK(tcp_emit(pl, s, F_ACK, s->snd_nxt, one.data(), 1, -1, true, true));
+      s->snd_nxt += 1;
+      return tcp_schedule_persist(pl, s);
+    }
+    case EV_DELACK: {
+      Sock *s = pl->S(ev.a);
+      s->delack_scheduled = false;
+      if (s->delack_counter > 0 && !s->closed && s->state != ST_CLOSED)
+        return tcp_send_ack(pl, s, -1);
+      return true;
+    }
+    case EV_TIMEWAIT: {
+      Sock *s = pl->S(ev.a);
+      if (s->state == ST_TIME_WAIT) return tcp_teardown(pl, s);
+      return true;
+    }
+  }
+  return true;
+}
+
+// ============================================================================
+// Python object + module glue
+// ============================================================================
+
+PyObject *raise_err(int err) {
+  // ConnectionError for the connection-ish members, OSError otherwise —
+  // mirrors the Python plane's exception classes (OSError("ENOTCONN") etc.;
+  // ConnectionError("EDESTADDRREQ...") in udp.py)
+  PyObject *cls =
+      (err == E_DESTADDRREQ) ? PyExc_ConnectionError : PyExc_OSError;
+  PyErr_SetString(cls, ERR_NAMES[err]);
+  return nullptr;
+}
+
+Sock *plane_new_sock(Plane *pl, int32_t hid, int kind) {
+  HostS *h = pl->H(hid);
+  Sock *s = new Sock();
+  s->id = (int32_t)pl->socks->size();
+  pl->socks->push_back(s);
+  s->hid = hid;
+  s->kind = kind;
+  s->handle = h->next_handle++;
+  s->recv_buf_size = h->recv_buf_size;
+  s->send_buf_size = h->send_buf_size;
+  s->autotune_recv = h->autotune_recv;
+  s->autotune_send = h->autotune_send;
+  s->last_adv_window = s->recv_buf_size;
+  s->status = S_ACTIVE;
+  if (kind == K_UDP) s->status |= S_WRITABLE;  // UDPSocket.__init__
+  return s;
+}
+
+#define SELF ((Plane *)self)
+#define GET_SOCK(sid)                                              \
+  ((sid) < 0 || (size_t)(sid) >= SELF->socks->size()               \
+       ? (PyErr_SetString(PyExc_ValueError, "bad sock id"), nullptr) \
+       : SELF->S((int32_t)(sid)))
+
+// ---- lifecycle -------------------------------------------------------------
+PyObject *Plane_py_new(PyTypeObject *type, PyObject *, PyObject *) {
+  Plane *pl = (Plane *)type->tp_alloc(type, 0);
+  if (!pl) return nullptr;
+  pl->heap = new std::vector<Ev>();
+  pl->socks = new std::vector<Sock *>();
+  pl->hosts = new std::vector<HostS *>();
+  pl->ip2host = new std::unordered_map<int64_t, int32_t>();
+  pl->cb = nullptr;
+  pl->lat_arr = pl->rel_arr = pl->cnt_arr = nullptr;
+  pl->lat = nullptr;
+  pl->rel = nullptr;
+  pl->path_counts = nullptr;
+  pl->A = 0;
+  pl->drop_key = 0;
+  pl->bootstrap_end = 0;
+  pl->end_time = 0;
+  pl->window_end = 0;
+  pl->in_run = false;
+  pl->now = 0;
+  pl->active_host = -1;
+  pl->events_scheduled = pl->events_executed = pl->packet_drops = 0;
+  pl->last_event_time = 0;
+  pl->cc_kind = CC_RENO;
+  pl->cc_ssthresh = 0;
+  pl->cc_init_segments = 10;
+  return (PyObject *)pl;
+}
+
+void Plane_dealloc(PyObject *self) {
+  Plane *pl = SELF;
+  for (Ev &e : *pl->heap) delete e.pkt;
+  delete pl->heap;
+  for (Sock *s : *pl->socks) delete s;
+  delete pl->socks;
+  for (HostS *h : *pl->hosts) delete h;
+  delete pl->hosts;
+  delete pl->ip2host;
+  Py_XDECREF(pl->cb);
+  Py_XDECREF(pl->lat_arr);
+  Py_XDECREF(pl->rel_arr);
+  Py_XDECREF(pl->cnt_arr);
+  Py_TYPE(self)->tp_free(self);
+}
+
+// configure(lat_addr, rel_addr, counts_addr, A, drop_key, bootstrap_end,
+//           end_time, cc_kind, cc_ssthresh, cc_init_segments,
+//           lat_keepalive, rel_keepalive, counts_keepalive)
+PyObject *Plane_configure(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  unsigned long long lat_addr, rel_addr, cnt_addr, drop_key;
+  long long A, bootstrap_end, end_time, ssthresh, init_segments;
+  int cc_kind;
+  PyObject *ka1, *ka2, *ka3;
+  if (!PyArg_ParseTuple(args, "KKKLKLLiLLOOO", &lat_addr, &rel_addr,
+                        &cnt_addr, &A, &drop_key, &bootstrap_end, &end_time,
+                        &cc_kind, &ssthresh, &init_segments, &ka1, &ka2,
+                        &ka3))
+    return nullptr;
+  pl->lat = (const int64_t *)(uintptr_t)lat_addr;
+  pl->rel = (const float *)(uintptr_t)rel_addr;
+  pl->path_counts = (int64_t *)(uintptr_t)cnt_addr;
+  pl->A = A;
+  pl->drop_key = drop_key;
+  pl->bootstrap_end = bootstrap_end;
+  pl->end_time = end_time;
+  pl->cc_kind = cc_kind;
+  pl->cc_ssthresh = ssthresh;
+  pl->cc_init_segments = init_segments;
+  Py_INCREF(ka1); Py_XDECREF(pl->lat_arr); pl->lat_arr = ka1;
+  Py_INCREF(ka2); Py_XDECREF(pl->rel_arr); pl->rel_arr = ka2;
+  Py_INCREF(ka3); Py_XDECREF(pl->cnt_arr); pl->cnt_arr = ka3;
+  Py_RETURN_NONE;
+}
+
+PyObject *Plane_set_callback(PyObject *self, PyObject *cb) {
+  Plane *pl = SELF;
+  Py_INCREF(cb);
+  Py_XDECREF(pl->cb);
+  pl->cb = cb;
+  Py_RETURN_NONE;
+}
+
+PyObject *Plane_set_window(PyObject *self, PyObject *arg) {
+  SELF->window_end = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  Py_RETURN_NONE;
+}
+
+// add_host(hid, ip, lo_ip, topo_row, bw_down, bw_up, qdisc_rr, router_kind,
+//          recv_buf, send_buf, autotune_recv, autotune_send,
+//          next_handle, next_port, event_seq, packet_counter,
+//          packet_priority)
+PyObject *Plane_add_host(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long hid, ip, lo_ip, topo_row, bw_down, bw_up, recv_buf, send_buf;
+  long long next_handle, next_port, event_seq, packet_counter,
+      packet_priority;
+  int qdisc_rr, router_kind, at_recv, at_send;
+  if (!PyArg_ParseTuple(args, "LLLLLLiiLLiiLLLLL", &hid, &ip, &lo_ip,
+                        &topo_row, &bw_down, &bw_up, &qdisc_rr, &router_kind,
+                        &recv_buf, &send_buf, &at_recv, &at_send,
+                        &next_handle, &next_port, &event_seq,
+                        &packet_counter, &packet_priority))
+    return nullptr;
+  if ((size_t)hid >= pl->hosts->size()) pl->hosts->resize(hid + 1, nullptr);
+  HostS *h = new HostS();
+  (*pl->hosts)[hid] = h;
+  h->id = (int32_t)hid;
+  h->ip = ip;
+  h->lo_ip = lo_ip;
+  h->topo_row = (int32_t)topo_row;
+  h->recv_buf_size = recv_buf;
+  h->send_buf_size = send_buf;
+  h->autotune_recv = at_recv != 0;
+  h->autotune_send = at_send != 0;
+  h->next_handle = next_handle;
+  h->next_port = next_port;
+  h->event_seq = event_seq;
+  h->packet_counter = packet_counter;
+  h->packet_priority = packet_priority;
+  h->lo.host = h;
+  h->lo.ip = lo_ip;
+  h->lo.is_loopback = true;
+  h->lo.qdisc_rr = qdisc_rr;
+  h->lo.send_bucket.init(0);
+  h->lo.receive_bucket.init(0);
+  h->eth.host = h;
+  h->eth.ip = ip;
+  h->eth.is_loopback = false;
+  h->eth.qdisc_rr = qdisc_rr;
+  h->eth.send_bucket.init(bw_up);
+  h->eth.receive_bucket.init(bw_down);
+  h->eth.router = new RouterQ();
+  h->eth.router->kind = router_kind;
+  (*pl->ip2host)[ip] = (int32_t)hid;
+  Py_RETURN_NONE;
+}
+
+// ---- per-host deterministic counters (proxied by the Python Host) ----------
+PyObject *Plane_next_seq(PyObject *self, PyObject *arg) {
+  long long hid = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  return PyLong_FromLongLong(SELF->H((int32_t)hid)->next_event_sequence());
+}
+
+PyObject *Plane_alloc_handle(PyObject *self, PyObject *arg) {
+  long long hid = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  return PyLong_FromLongLong(SELF->H((int32_t)hid)->next_handle++);
+}
+
+PyObject *Plane_next_packet_uid(PyObject *self, PyObject *arg) {
+  long long hid = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  return PyLong_FromLongLong(SELF->H((int32_t)hid)->next_packet_uid());
+}
+
+PyObject *Plane_next_packet_priority(PyObject *self, PyObject *arg) {
+  long long hid = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  return PyLong_FromLongLong(SELF->H((int32_t)hid)->next_packet_priority());
+}
+
+// ---- socket creation / naming ----------------------------------------------
+PyObject *Plane_socket(PyObject *self, PyObject *args) {
+  long long hid;
+  int kind;
+  if (!PyArg_ParseTuple(args, "Li", &hid, &kind)) return nullptr;
+  Sock *s = plane_new_sock(SELF, (int32_t)hid, kind);
+  return Py_BuildValue("iL", s->id, (long long)s->handle);
+}
+
+// bind(sid, ip, port, wildcard) -> bound port
+PyObject *Plane_bind(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long sid, ip, port;
+  int wildcard;
+  if (!PyArg_ParseTuple(args, "LLLi", &sid, &ip, &port, &wildcard))
+    return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  HostS *h = pl->H(s->hid);
+  Iface *f = h->iface_for_ip(ip);
+  if (!f) return raise_err(E_ADDRNOTAVAIL);
+  Iface *t0 = wildcard ? &h->lo : f;
+  Iface *t1 = wildcard ? &h->eth : nullptr;
+  if (port == 0) {
+    port = host_alloc_port(h, s->kind, t0, t1);
+    if (port < 0) return raise_err(E_ADDRINUSE);
+  }
+  if (iface_is_associated(t0, s->kind, port) ||
+      (t1 && iface_is_associated(t1, s->kind, port)))
+    return raise_err(E_ADDRINUSE);
+  s->bound_ip = f->ip;
+  s->bound_port = port;
+  iface_associate(t0, s, port, 0, 0);
+  if (t1) iface_associate(t1, s, port, 0, 0);
+  return PyLong_FromLongLong(port);
+}
+
+PyObject *Plane_listen(PyObject *self, PyObject *args) {
+  long long sid, backlog;
+  if (!PyArg_ParseTuple(args, "LL", &sid, &backlog)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  int e = tcp_listen(SELF, s, backlog);
+  if (e) return raise_err(e);
+  Py_RETURN_NONE;
+}
+
+PyObject *Plane_connect(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long sid, ip, port, now;
+  if (!PyArg_ParseTuple(args, "LLLL", &sid, &ip, &port, &now))
+    return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  pl->now = now;
+  pl->active_host = s->hid;
+  if (s->kind == K_UDP) {
+    if (s->bound_port < 0) {
+      int e = host_autobind(pl, s, ip);
+      if (e) return raise_err(e);
+    }
+    s->peer_ip = ip;
+    s->peer_port = port;
+    Py_RETURN_TRUE;  // no handshake
+  }
+  bool cb_err = false;
+  int e = tcp_connect(pl, s, ip, port, &cb_err);
+  if (cb_err) return nullptr;
+  if (e) return raise_err(e);
+  Py_RETURN_FALSE;  // in progress; caller blocks on WRITABLE
+}
+
+PyObject *Plane_accept(PyObject *self, PyObject *args) {
+  long long sid, now;
+  if (!PyArg_ParseTuple(args, "LL", &sid, &now)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  SELF->now = now;
+  SELF->active_host = s->hid;
+  bool cb_err = false;
+  int32_t cid = tcp_accept_child(SELF, s, &cb_err);
+  if (cb_err) return nullptr;
+  if (cid < 0) Py_RETURN_NONE;
+  Sock *c = SELF->S(cid);
+  return Py_BuildValue("iLLL", cid, (long long)c->handle,
+                       (long long)c->peer_ip, (long long)c->peer_port);
+}
+
+PyObject *Plane_send(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long sid, dst_ip, dst_port, now;
+  Py_buffer data;
+  if (!PyArg_ParseTuple(args, "Ly*LLL", &sid, &data, &dst_ip, &dst_port,
+                        &now))
+    return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) { PyBuffer_Release(&data); return nullptr; }
+  pl->now = now;
+  pl->active_host = s->hid;
+  bool cb_err = false;
+  int64_t n;
+  if (s->kind == K_TCP)
+    n = tcp_send_user(pl, s, (const char *)data.buf, data.len, &cb_err);
+  else
+    n = udp_send_user(pl, s, (const char *)data.buf, data.len, dst_ip,
+                      dst_port, &cb_err);
+  PyBuffer_Release(&data);
+  if (cb_err) return nullptr;
+  if (n < 0) return raise_err((int)-n);
+  return PyLong_FromLongLong(n);
+}
+
+// recv(sid, nbytes, now) -> None | (bytes, ip, port)
+PyObject *Plane_recv(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long sid, nbytes, now;
+  if (!PyArg_ParseTuple(args, "LLL", &sid, &nbytes, &now)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  pl->now = now;
+  pl->active_host = s->hid;
+  if (s->kind == K_UDP) {
+    if (s->in_packets.empty()) Py_RETURN_NONE;
+    Pkt *p = s->in_packets.front();
+    s->in_packets.pop_front();
+    s->in_bytes -= p->total_size();
+    int64_t take = std::min<int64_t>(nbytes, p->payload_size());
+    PyObject *b = PyBytes_FromStringAndSize(p->payload.data(), take);
+    PyObject *r = Py_BuildValue("NLL", b, (long long)p->src_ip,
+                                (long long)p->src_port);
+    delete p;
+    if (!udp_update_readable(pl, s) || !udp_update_writable(pl, s)) {
+      Py_XDECREF(r);
+      return nullptr;
+    }
+    return r;
+  }
+  if (s->read_q.size() == 0) {
+    if (s->eof_received || s->err != E_NONE)
+      return Py_BuildValue("yLL", "",
+                           (long long)(s->peer_ip >= 0 ? s->peer_ip : 0),
+                           (long long)(s->peer_port >= 0 ? s->peer_port : 0));
+    Py_RETURN_NONE;
+  }
+  std::string out = s->read_q.pop(nbytes);
+  s->read_bytes -= (int64_t)out.size();
+  if (!tcp_update_readable(pl, s)) return nullptr;
+  if (s->last_adv_window == 0 && tcp_adv_window(s) > 0 &&
+      (s->state == ST_ESTABLISHED || s->state == ST_FIN_WAIT_1 ||
+       s->state == ST_FIN_WAIT_2)) {
+    if (!tcp_send_ack(pl, s, -1)) return nullptr;
+  }
+  return Py_BuildValue("y#LL", out.data(), (Py_ssize_t)out.size(),
+                       (long long)(s->peer_ip >= 0 ? s->peer_ip : 0),
+                       (long long)(s->peer_port >= 0 ? s->peer_port : 0));
+}
+
+// peek(sid, nbytes) -> None | (bytes, ip, port)
+PyObject *Plane_peek(PyObject *self, PyObject *args) {
+  long long sid, nbytes;
+  if (!PyArg_ParseTuple(args, "LL", &sid, &nbytes)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  if (s->kind == K_UDP) {
+    if (s->in_packets.empty()) Py_RETURN_NONE;
+    Pkt *p = s->in_packets.front();
+    int64_t take = std::min<int64_t>(nbytes, p->payload_size());
+    return Py_BuildValue("y#LL", p->payload.data(), (Py_ssize_t)take,
+                         (long long)p->src_ip, (long long)p->src_port);
+  }
+  if (s->read_q.size() == 0) {
+    if (s->eof_received || s->err != E_NONE)
+      return Py_BuildValue("yLL", "",
+                           (long long)(s->peer_ip >= 0 ? s->peer_ip : 0),
+                           (long long)(s->peer_port >= 0 ? s->peer_port : 0));
+    Py_RETURN_NONE;
+  }
+  std::string out = s->read_q.peek(nbytes);
+  return Py_BuildValue("y#LL", out.data(), (Py_ssize_t)out.size(),
+                       (long long)(s->peer_ip >= 0 ? s->peer_ip : 0),
+                       (long long)(s->peer_port >= 0 ? s->peer_port : 0));
+}
+
+PyObject *Plane_close(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long sid, now;
+  if (!PyArg_ParseTuple(args, "LL", &sid, &now)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  pl->now = now;
+  pl->active_host = s->hid;
+  bool ok = (s->kind == K_TCP) ? tcp_app_close(pl, s)
+                               : sock_base_close(pl, s);
+  if (!ok) return nullptr;
+  Py_RETURN_NONE;
+}
+
+PyObject *Plane_shutdown(PyObject *self, PyObject *args) {
+  long long sid, now;
+  int how;
+  if (!PyArg_ParseTuple(args, "LiL", &sid, &how, &now)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  SELF->now = now;
+  SELF->active_host = s->hid;
+  bool cb_err = false;
+  int e = tcp_shutdown(SELF, s, how, &cb_err);
+  if (cb_err) return nullptr;
+  if (e) return raise_err(e);
+  Py_RETURN_NONE;
+}
+
+PyObject *Plane_take_error(PyObject *self, PyObject *arg) {
+  long long sid = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  if (s->err == E_NONE) Py_RETURN_NONE;
+  int e = s->err;
+  s->err = E_NONE;
+  return PyUnicode_FromString(ERR_NAMES[e]);
+}
+
+PyObject *Plane_status(PyObject *self, PyObject *arg) {
+  long long sid = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  return PyLong_FromLong(s->status);
+}
+
+// buf_sizes(sid) -> (send_buf, recv_buf); set_buf_size(sid, which, val)
+PyObject *Plane_buf_sizes(PyObject *self, PyObject *arg) {
+  long long sid = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  return Py_BuildValue("(LL)", (long long)s->send_buf_size,
+                       (long long)s->recv_buf_size);
+}
+
+PyObject *Plane_set_buf_size(PyObject *self, PyObject *args) {
+  long long sid, val;
+  int which;  // 0 = send, 1 = recv
+  if (!PyArg_ParseTuple(args, "LiL", &sid, &which, &val)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  if (which == 0) s->send_buf_size = val;
+  else s->recv_buf_size = val;
+  Py_RETURN_NONE;
+}
+
+PyObject *Plane_watch(PyObject *self, PyObject *args) {
+  long long sid;
+  int on;
+  if (!PyArg_ParseTuple(args, "Li", &sid, &on)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  s->watched = on != 0;
+  Py_RETURN_NONE;
+}
+
+// ---- digest / introspection ------------------------------------------------
+PyObject *ll_or_none(int64_t v) {
+  if (v < 0) Py_RETURN_NONE;
+  return PyLong_FromLongLong(v);
+}
+
+// the exact tuple checkpoint._socket_state builds for the Python plane
+PyObject *Plane_sock_state(PyObject *self, PyObject *arg) {
+  long long sid = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  if (s->kind == K_UDP)
+    return Py_BuildValue("(sONNNNLL)", "udp", Py_None,
+                         ll_or_none(s->bound_ip), ll_or_none(s->bound_port),
+                         ll_or_none(s->peer_ip), ll_or_none(s->peer_port),
+                         (long long)s->in_bytes, (long long)s->out_bytes);
+  return Py_BuildValue(
+      "(ssNNNNLLLLLLLLLLL)", "tcp", STATE_NAMES[s->state],
+      ll_or_none(s->bound_ip), ll_or_none(s->bound_port),
+      ll_or_none(s->peer_ip), ll_or_none(s->peer_port),
+      (long long)s->in_bytes, (long long)s->out_bytes,
+      (long long)s->snd_una, (long long)s->snd_nxt, (long long)s->rcv_nxt,
+      (long long)s->snd_wnd, (long long)s->unacked.size(),
+      (long long)s->reorder.size(), (long long)s->send_pending_bytes,
+      (long long)s->read_bytes,
+      (long long)(s->has_cong ? s->cong.cwnd : 0));
+}
+
+// (handle, kind_str, closed, bound_ip, bound_port, peer_ip, peer_port,
+//  state_str_or_None, accepted)
+PyObject *Plane_sock_fields(PyObject *self, PyObject *arg) {
+  long long sid = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  PyObject *st;
+  if (s->kind == K_TCP) st = PyUnicode_FromString(STATE_NAMES[s->state]);
+  else { st = Py_None; Py_INCREF(st); }
+  return Py_BuildValue("(LsiNNNNNi)", (long long)s->handle,
+                       s->kind == K_TCP ? "tcp" : "udp", s->closed ? 1 : 0,
+                       ll_or_none(s->bound_ip), ll_or_none(s->bound_port),
+                       ll_or_none(s->peer_ip), ll_or_none(s->peer_port), st,
+                       s->accepted ? 1 : 0);
+}
+
+PyObject *Plane_tracker(PyObject *self, PyObject *arg) {
+  long long hid = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  HostS *h = SELF->H((int32_t)hid);
+  const TrackCtr *cs[4] = {&h->in_local, &h->in_remote, &h->out_local,
+                           &h->out_remote};
+  PyObject *out = PyTuple_New(33);
+  int k = 0;
+  for (int i = 0; i < 4; i++) {
+    const TrackCtr *c = cs[i];
+    int64_t v[8] = {c->packets_total, c->bytes_total, c->packets_control,
+                    c->bytes_control, c->packets_data, c->bytes_data,
+                    c->packets_retrans, c->bytes_retrans};
+    for (int j = 0; j < 8; j++)
+      PyTuple_SET_ITEM(out, k++, PyLong_FromLongLong(v[j]));
+  }
+  PyTuple_SET_ITEM(out, k++, PyLong_FromLongLong(h->drops));
+  return out;
+}
+
+PyObject *Plane_iface_state(PyObject *self, PyObject *arg) {
+  long long hid = PyLong_AsLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  HostS *h = SELF->H((int32_t)hid);
+  return Py_BuildValue("(LLLL)", (long long)h->lo.send_bucket.remaining,
+                       (long long)h->lo.receive_bucket.remaining,
+                       (long long)h->eth.send_bucket.remaining,
+                       (long long)h->eth.receive_bucket.remaining);
+}
+
+PyObject *Plane_counters(PyObject *self, PyObject *) {
+  Plane *pl = SELF;
+  return Py_BuildValue("(LLLL)", (long long)pl->events_scheduled,
+                       (long long)pl->events_executed,
+                       (long long)pl->packet_drops,
+                       (long long)pl->last_event_time);
+}
+
+// ---- the merged run loop ---------------------------------------------------
+PyObject *Plane_next_key(PyObject *self, PyObject *) {
+  Plane *pl = SELF;
+  if (pl->heap->empty()) Py_RETURN_NONE;
+  const Ev &top = pl->heap->front();
+  return Py_BuildValue("(LiiL)", (long long)top.time, (int)top.dst,
+                       (int)top.src, (long long)top.seq);
+}
+
+PyObject *Plane_pending(PyObject *self, PyObject *) {
+  return PyLong_FromSsize_t((Py_ssize_t)SELF->heap->size());
+}
+
+inline bool evkey_lt(const EvKey &a, const EvKey &b) {
+  if (a.time != b.time) return a.time < b.time;
+  if (a.dst != b.dst) return a.dst < b.dst;
+  if (a.src != b.src) return a.src < b.src;
+  return a.seq < b.seq;
+}
+
+// run(limit_t, limit_dst, limit_src, limit_seq) -> events executed.
+// Executes every C event strictly below the limit key.  Python callbacks
+// fired during execution may schedule earlier Python events; the policy's
+// push hook calls lower_limit, which shrinks the active run's horizon so
+// the merge stays exact.
+PyObject *Plane_run(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long t, seq;
+  int d, s_;
+  if (!PyArg_ParseTuple(args, "LiiL", &t, &d, &s_, &seq)) return nullptr;
+  pl->limit.time = t;
+  pl->limit.dst = d;
+  pl->limit.src = s_;
+  pl->limit.seq = seq;
+  pl->in_run = true;
+  int64_t executed = 0;
+  while (!pl->heap->empty() && key_lt(pl->heap->front(), pl->limit)) {
+    std::pop_heap(pl->heap->begin(), pl->heap->end(), EvGreater());
+    Ev ev = pl->heap->back();
+    pl->heap->pop_back();
+    if (!plane_exec(pl, ev)) {
+      pl->in_run = false;
+      return nullptr;  // Python callback raised
+    }
+    executed++;
+  }
+  pl->in_run = false;
+  return PyLong_FromLongLong(executed);
+}
+
+PyObject *Plane_lower_limit(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long t, seq;
+  int d, s_;
+  if (!PyArg_ParseTuple(args, "LiiL", &t, &d, &s_, &seq)) return nullptr;
+  if (pl->in_run) {
+    EvKey k{t, d, s_, seq};
+    if (evkey_lt(k, pl->limit)) pl->limit = k;
+  }
+  Py_RETURN_NONE;
+}
+
+// ---- method table / type ---------------------------------------------------
+PyMethodDef Plane_methods[] = {
+    {"configure", Plane_configure, METH_VARARGS, nullptr},
+    {"set_callback", Plane_set_callback, METH_O, nullptr},
+    {"set_window", Plane_set_window, METH_O, nullptr},
+    {"add_host", Plane_add_host, METH_VARARGS, nullptr},
+    {"next_seq", Plane_next_seq, METH_O, nullptr},
+    {"alloc_handle", Plane_alloc_handle, METH_O, nullptr},
+    {"next_packet_uid", Plane_next_packet_uid, METH_O, nullptr},
+    {"next_packet_priority", Plane_next_packet_priority, METH_O, nullptr},
+    {"socket", Plane_socket, METH_VARARGS, nullptr},
+    {"bind", Plane_bind, METH_VARARGS, nullptr},
+    {"listen", Plane_listen, METH_VARARGS, nullptr},
+    {"connect", Plane_connect, METH_VARARGS, nullptr},
+    {"accept", Plane_accept, METH_VARARGS, nullptr},
+    {"send", Plane_send, METH_VARARGS, nullptr},
+    {"recv", Plane_recv, METH_VARARGS, nullptr},
+    {"peek", Plane_peek, METH_VARARGS, nullptr},
+    {"close", Plane_close, METH_VARARGS, nullptr},
+    {"shutdown", Plane_shutdown, METH_VARARGS, nullptr},
+    {"take_error", Plane_take_error, METH_O, nullptr},
+    {"status", Plane_status, METH_O, nullptr},
+    {"watch", Plane_watch, METH_VARARGS, nullptr},
+    {"buf_sizes", Plane_buf_sizes, METH_O, nullptr},
+    {"set_buf_size", Plane_set_buf_size, METH_VARARGS, nullptr},
+    {"sock_state", Plane_sock_state, METH_O, nullptr},
+    {"sock_fields", Plane_sock_fields, METH_O, nullptr},
+    {"tracker", Plane_tracker, METH_O, nullptr},
+    {"iface_state", Plane_iface_state, METH_O, nullptr},
+    {"counters", Plane_counters, METH_NOARGS, nullptr},
+    {"next_key", Plane_next_key, METH_NOARGS, nullptr},
+    {"pending", Plane_pending, METH_NOARGS, nullptr},
+    {"run", Plane_run, METH_VARARGS, nullptr},
+    {"lower_limit", Plane_lower_limit, METH_VARARGS, nullptr},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject PlaneType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+    "_shadow_dataplane.Plane",      // tp_name
+    sizeof(Plane),                  // tp_basicsize
+    0,                              // tp_itemsize
+    Plane_dealloc,                  // tp_dealloc
+};
+
+PyModuleDef dataplane_module = {
+    PyModuleDef_HEAD_INIT, "_shadow_dataplane",
+    "C data plane: TCP/UDP + interface + router + hop, natively.", -1,
+    nullptr, nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__shadow_dataplane(void) {
+  PlaneType.tp_flags = Py_TPFLAGS_DEFAULT;
+  PlaneType.tp_new = Plane_py_new;
+  PlaneType.tp_methods = Plane_methods;
+  if (PyType_Ready(&PlaneType) < 0) return nullptr;
+  PyObject *m = PyModule_Create(&dataplane_module);
+  if (!m) return nullptr;
+  Py_INCREF(&PlaneType);
+  if (PyModule_AddObject(m, "Plane", (PyObject *)&PlaneType) < 0) {
+    Py_DECREF(&PlaneType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  return m;
+}

@@ -51,11 +51,16 @@ def _socket_state(sock) -> tuple:
 def _host_state(host) -> Dict:
     descriptors = {}
     for handle, desc in sorted(host._descriptors.items()):
-        if hasattr(desc, "in_bytes"):  # sockets (tcp/udp/pipe ends)
+        if hasattr(desc, "digest_tuple"):  # native-plane sockets: the C
+            descriptors[handle] = desc.digest_tuple()  # state IS the state
+        elif hasattr(desc, "in_bytes"):  # sockets (tcp/udp/pipe ends)
             descriptors[handle] = _socket_state(desc)
         else:
             descriptors[handle] = (desc.kind, desc.status, desc.closed)
     t = host.tracker
+    plane = getattr(host, "native_plane", None)
+    if plane is not None:
+        plane.sync_tracker(host.id, t)
     return {
         "name": host.name,
         "descriptors": descriptors,
@@ -64,7 +69,9 @@ def _host_state(host) -> Dict:
                     t.out_remote.packets_retrans, t.drops),
         "processes": [(p.name, p.running, p.exited, p.exit_code)
                       for p in host.processes],
-        "ifaces": {ip: (i.send_bucket.bytes_remaining, i.receive_bucket.bytes_remaining)
+        "ifaces": plane.iface_digest(host.id) if plane is not None else
+                  {ip: (i.send_bucket.bytes_remaining,
+                        i.receive_bucket.bytes_remaining)
                    for ip, i in sorted(host.interfaces.items())},
     }
 
@@ -154,6 +161,13 @@ class CheckpointWriter:
         self.out_dir = out_dir
         self.next_at = self.interval_ns
         self.written = []
+
+    def due(self, engine) -> bool:
+        """True iff maybe_write would snapshot this round — checked by the
+        engine BEFORE forcing an early flush consume, so a run with
+        --checkpoint-interval keeps the async launch/consume overlap on all
+        the rounds that don't actually write."""
+        return engine.scheduler.window_start >= self.next_at
 
     def maybe_write(self, engine) -> Optional[str]:
         now = engine.scheduler.window_start

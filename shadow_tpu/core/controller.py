@@ -96,6 +96,12 @@ class Controller:
                 for pc in hc.processes:
                     self._add_process(host, pc)
         self.topology.finalize()
+        # the C data plane (parallel/native_plane.py): TCP/UDP pipeline +
+        # interfaces + router + hop execute natively for eligible serial
+        # runs; Python keeps the control plane.  No-op (with a logged
+        # reason) when ineligible in auto mode.
+        from ..parallel.native_plane import attach as attach_native
+        attach_native(self.engine)
 
     def _add_process(self, host: Host, pc) -> None:
         path = self._program_paths.get(pc.plugin, pc.plugin)

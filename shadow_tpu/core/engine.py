@@ -92,6 +92,9 @@ class Engine:
         # device-resident traffic plane (parallel/device_plane.py); set by
         # the Controller when the workload has device-mode flows
         self.device_plane = None
+        # C data plane (parallel/native_plane.py); set by attach() when the
+        # run is eligible — protocol/interface/hop events then execute in C
+        self.native_plane = None
         self._checkpointer = None
         if getattr(options, "checkpoint_interval_sec", 0) > 0:
             from .checkpoint import CheckpointWriter
@@ -254,6 +257,11 @@ class Engine:
                 gc.unfreeze()
                 gc.collect()
         self._running = False
+        if self.native_plane is not None:
+            # post-run reads (tests, tools, digests) see the Python tracker
+            # objects; the authoritative counts accumulated in C
+            for host in self.hosts.values():
+                self.native_plane.sync_tracker(host.id, host.tracker)
         # teardown: hosts (and their descriptors) are reclaimed here
         for host in self.hosts.values():
             for iface in set(host.interfaces.values()):
@@ -291,8 +299,11 @@ class Engine:
             flush(self)
         if self.device_plane is not None:
             self.device_plane.advance(self)
-        if self._checkpointer is not None:
+        if self._checkpointer is not None and self._checkpointer.due(self):
             # snapshots must include every in-flight delivery: consume first
+            # (only on rounds that actually write — an unconditional consume
+            # here would forfeit the async launch/consume overlap for the
+            # whole run)
             self._consume_flush()
             path = self._checkpointer.maybe_write(self)
             if path:
@@ -316,6 +327,9 @@ class Engine:
             return False
         self.scheduler.window_start = nxt
         self.scheduler.window_end = min(nxt + lookahead, self.end_time)
+        if self.native_plane is not None:
+            # the C plane clamps its cross-host pushes to the same barrier
+            self.native_plane.set_window(self.scheduler.window_end)
         return True
 
     def _heartbeat(self) -> None:
@@ -369,6 +383,16 @@ class Engine:
                 self._heartbeat()
                 get_logger().flush()
             self.events_executed = worker.counters._free.get("event", 0)
+            if self.native_plane is not None:
+                # fold the C plane's event lifecycle into the engine's
+                # totals (created at schedule, freed at execution — same
+                # accounting the Python events get)
+                sched, execd, drops, _last = self.native_plane.counters()
+                self.events_executed += execd
+                worker.counters.count_new("event", sched)
+                worker.counters.count_free("event", execd)
+                if drops:
+                    worker.counters.count_new("packet_drop", drops)
         finally:
             worker.finish()
             set_current_worker(None)

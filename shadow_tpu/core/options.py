@@ -74,6 +74,9 @@ class Options:
     tpu_device_threshold: int = 0        # >0: batches below N bypass to numpy
     tpu_chunk: int = 0                   # mid-round async launch size (0=off)
     device_plane: str = "device"         # device | numpy (bit-identical twin)
+    dataplane: str = "auto"              # auto | native | python: C data
+                                         # plane for eligible serial runs
+                                         # (parallel/native_plane.py)
     device_plane_granule_ms: int = 0     # step size override (0 = auto)
     device_plane_batch_steps: int = 4    # min steps per kernel dispatch
     # Checkpointing (new capability; absent in the reference — SURVEY.md §5)
@@ -139,6 +142,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="route round batches smaller than N to the "
                         "bit-identical numpy path instead of the device "
                         "(0 = always dispatch to the device)")
+    p.add_argument("--dataplane", choices=("auto", "native", "python"),
+                   default="auto", dest="dataplane",
+                   help="C data plane for the per-event hot path (auto: "
+                        "engage when the run is serial/global-policy "
+                        "without pcap/CPU-model/debug; native: require it; "
+                        "python: pure-Python plane)")
     p.add_argument("--device-plane", choices=("device", "numpy"),
                    default="device", dest="device_plane",
                    help="execution mode for device-registered bulk flows: "

@@ -40,6 +40,11 @@ class SignalFD(Descriptor):
     def deliver(self, signo: int) -> bool:
         if self.closed or not self.matches(signo):
             return False
+        # standard signals (1-31) coalesce: the kernel keeps ONE pending
+        # instance per signal, so a second raise before the first read is
+        # invisible; real-time signals (>=32) queue each instance
+        if signo < 32 and signo in self.pending:
+            return True
         self.pending.append(signo)
         self.adjust_status(S_READABLE, True)
         return True

@@ -90,6 +90,11 @@ class Tracker:
                                      "tx_buffer": tx_buf, "tx_length": tx_len}
 
     def heartbeat(self, now: int) -> None:
+        native = getattr(self, "_native", None)
+        if native is not None:
+            # native dataplane: the authoritative counters live in C
+            plane, hid = native
+            plane.sync_tracker(hid, self)
         r_in, r_out = self.in_remote, self.out_remote
         level = getattr(self.host.params, "heartbeat_log_level", None) \
             or "message"

@@ -1,0 +1,475 @@
+"""Native (C) data plane: glue between the engine and _shadow_dataplane.so.
+
+The C extension (native/dataplane.cc) owns the per-event hot path — TCP/UDP
+protocol pipeline, interface token buckets + qdisc, router AQM, protocol
+timers, and the inter-host hop — as a faithful C re-expression of this
+repo's own Python modules, so a native run produces bit-identical state
+digests to a Python-plane run (tests/test_native_dataplane.py pins this).
+
+This module provides:
+
+* :class:`NativeSocket` — the Python descriptor wrapper apps/epoll/process
+  blocking interact with; every data operation is one C call.
+* :class:`NativePlane` — engine-side owner: host registration, the status
+  callback shim (fires Python descriptor listeners at the exact points the
+  Python plane fires them, with the worker clock/active-host mirrored so
+  wakeup events draw the same sequence ids), digest/tracker access.
+* :class:`NativeGlobalPolicy` — the serial scheduler policy that merges the
+  C event heap with the Python event queue into one total order: runs of
+  consecutive C events execute in a single ``plane.run`` call (no Python
+  dispatch per protocol event — the 3x+ events/s lever, VERDICT r4 next
+  #1); a Python callback that schedules an earlier Python event shrinks the
+  active run's horizon through ``lower_limit``, keeping the merge exact.
+
+Reference analog: the reference runs this loop in C end-to-end
+(worker.c:149-216, tcp.c:1121-1278, network_interface.c:421-579); here the
+control plane stays Python and only the data plane is native.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+from typing import List, Optional
+
+from ..core import stime
+from ..core.logger import get_logger
+from ..core.scheduler import GlobalSinglePolicy
+from ..core.worker import current_worker
+
+CB_STATUS, CB_CHILD, CB_CLOSED = 0, 1, 2
+K_TCP, K_UDP = 0, 1
+_SENT_D = -(2 ** 31)
+_SENT_Q = -(2 ** 63)
+
+_MOD = None
+_MOD_TRIED = False
+
+
+def _load_module():
+    """Import the extension from shadow_tpu/native/, building on demand."""
+    global _MOD, _MOD_TRIED
+    if _MOD_TRIED:
+        return _MOD
+    _MOD_TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "native", "_shadow_dataplane.so")
+    if not os.path.exists(path):
+        try:
+            subprocess.run(["make", "-s", os.path.join("..", "shadow_tpu",
+                                                       "native",
+                                                       "_shadow_dataplane.so")],
+                           cwd=os.path.join(here, "..", "native"),
+                           check=True, timeout=120)
+        except Exception:
+            return None
+    if not os.path.exists(path):
+        return None
+    try:
+        spec = importlib.util.spec_from_file_location("_shadow_dataplane",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _MOD = mod
+    except Exception:
+        _MOD = None
+    return _MOD
+
+
+def native_available() -> bool:
+    return _load_module() is not None
+
+
+_CC_KINDS = {"reno": 0, "aimd": 1, "cubic": 2}
+_RQ_KINDS = {"codel": 0, "single": 1, "static": 2}
+
+
+class NativeSocket:
+    """Descriptor-API wrapper over one C-plane socket.
+
+    Mirrors the surface SyscallAPI / epoll / the process block-dispatch use
+    on TCPSocket/UDPSocket.  Status bits live in C; listener registration
+    toggles the C-side ``watched`` flag so unwatched sockets never pay a
+    callback."""
+
+    __slots__ = ("plane", "sid", "handle", "host", "kind", "closed",
+                 "_listeners", "_nonblock", "unix_path")
+
+    def __init__(self, plane: "NativePlane", sid: int, handle: int, host,
+                 kind: str):
+        self.plane = plane
+        self.sid = sid
+        self.handle = handle
+        self.host = host
+        self.kind = kind
+        self.closed = False
+        self._listeners: List = []
+        self._nonblock = False      # set by the shim's fcntl(O_NONBLOCK)
+        self.unix_path = None
+
+    # -- status / listeners (descriptor/base.py) --------------------------
+    @property
+    def status(self) -> int:
+        return self.plane.c.status(self.sid)
+
+    def has_status(self, bits: int) -> bool:
+        return (self.plane.c.status(self.sid) & bits) == bits
+
+    def add_listener(self, cb) -> None:
+        if cb not in self._listeners:
+            self._listeners.append(cb)
+            if len(self._listeners) == 1:
+                self.plane.c.watch(self.sid, 1)
+
+    def remove_listener(self, cb) -> None:
+        if cb in self._listeners:
+            self._listeners.remove(cb)
+            if not self._listeners:
+                self.plane.c.watch(self.sid, 0)
+
+    def _notify(self, changed: int) -> None:
+        for cb in list(self._listeners):
+            cb(self, changed)
+
+    # -- naming -----------------------------------------------------------
+    def _fields(self):
+        return self.plane.c.sock_fields(self.sid)
+
+    @property
+    def bound_ip(self):
+        return self._fields()[3]
+
+    @property
+    def bound_port(self):
+        return self._fields()[4]
+
+    @property
+    def peer_ip(self):
+        return self._fields()[5]
+
+    @property
+    def peer_port(self):
+        return self._fields()[6]
+
+    @property
+    def state(self):
+        return self._fields()[7]
+
+    @property
+    def is_bound(self) -> bool:
+        return self._fields()[4] is not None
+
+    @property
+    def in_bytes(self) -> int:
+        """FIONREAD surface (RPC shim ioctl): buffered input bytes.  The C
+        plane tracks the same quantity the Python sockets do (UDP: queued
+        datagram bytes incl. headers; TCP: 0 — tcp.py never maintains
+        in_bytes, read_bytes is its measure), so parity holds exactly."""
+        return self.plane.c.sock_state(self.sid)[6]
+
+    # -- buffer sizes (RPC shim setsockopt/getsockopt) --------------------
+    @property
+    def send_buf_size(self) -> int:
+        return self.plane.c.buf_sizes(self.sid)[0]
+
+    @send_buf_size.setter
+    def send_buf_size(self, v: int) -> None:
+        self.plane.c.set_buf_size(self.sid, 0, int(v))
+
+    @property
+    def recv_buf_size(self) -> int:
+        return self.plane.c.buf_sizes(self.sid)[1]
+
+    @recv_buf_size.setter
+    def recv_buf_size(self, v: int) -> None:
+        self.plane.c.set_buf_size(self.sid, 1, int(v))
+
+    # -- data/user API (SyscallAPI surface) -------------------------------
+    def bind_native(self, ip: int, port: int, wildcard: bool) -> int:
+        return self.plane.c.bind(self.sid, ip, port, 1 if wildcard else 0)
+
+    def connect_to(self, dst_ip: int, dst_port: int) -> bool:
+        return self.plane.c.connect(self.sid, dst_ip, dst_port,
+                                    self.host.now)
+
+    def take_socket_error(self) -> Optional[str]:
+        return self.plane.c.take_error(self.sid)
+
+    def listen(self, backlog: int = 128) -> None:
+        self.plane.c.listen(self.sid, backlog)
+
+    def accept_child(self) -> Optional["NativeSocket"]:
+        r = self.plane.c.accept(self.sid, self.host.now)
+        if r is None:
+            return None
+        cid = r[0]
+        return self.plane.wrappers[cid]
+
+    def send_user_data(self, data, dst_ip: int = 0, dst_port: int = 0) -> int:
+        return self.plane.c.send(self.sid, data, dst_ip, dst_port,
+                                 self.host.now)
+
+    def receive_user_data(self, nbytes: int):
+        return self.plane.c.recv(self.sid, nbytes, self.host.now)
+
+    def peek_user_data(self, nbytes: int):
+        return self.plane.c.peek(self.sid, nbytes)
+
+    def shutdown(self, how: int) -> None:
+        self.plane.c.shutdown(self.sid, how, self.host.now)
+
+    def close(self) -> None:
+        self.plane.c.close(self.sid, self.host.now)
+
+    # -- digest (core/checkpoint.py _socket_state) ------------------------
+    def digest_tuple(self) -> tuple:
+        return self.plane.c.sock_state(self.sid)
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"NativeSocket(fd={self.handle}, kind={self.kind})"
+
+
+class NativeGlobalPolicy(GlobalSinglePolicy):
+    """Serial global policy merging the C event heap into the total order."""
+
+    def __init__(self, plane: "NativePlane"):
+        super().__init__()
+        self._plane = plane
+        self.serial = True
+
+    def push(self, event, worker_id: int, barrier: int) -> None:
+        if event.dst_host is not event.src_host and event.time < barrier:
+            event.time = barrier
+        self.queue.push(event)
+        # a callback-scheduled Python event may precede the C heap's next
+        # event: shrink the active C run's horizon (no-op outside run)
+        self._plane.c.lower_limit(*event.order_key())
+
+    def pop(self, worker_id: int, window_end: int):
+        if worker_id != 0:
+            return None
+        c = self._plane.c
+        q = self.queue
+        while True:
+            pk = q.peek_key()
+            ck = c.next_key()
+            py_ok = pk is not None and pk[0] < window_end
+            c_ok = ck is not None and ck[0] < window_end
+            if c_ok and (not py_ok or ck < pk):
+                # execute the C run up to the next Python event (or the
+                # window end); callbacks may add Python events and shrink
+                # the horizon, so re-evaluate afterwards
+                if py_ok:
+                    c.run(pk[0], pk[1], pk[2], pk[3])
+                else:
+                    # int(): window_end inherits float-ness from fractional
+                    # <shadow stoptime> configs
+                    c.run(int(window_end), _SENT_D, _SENT_D, _SENT_Q)
+                continue
+            if not py_ok:
+                return None
+            return q.pop_before(window_end)
+
+    def next_time(self) -> int:
+        t = super().next_time()
+        ck = self._plane.c.next_key()
+        if ck is not None and ck[0] < t:
+            t = ck[0]
+        return t
+
+    def pending_count(self) -> int:
+        return len(self.queue) + self._plane.c.pending()
+
+
+class NativePlane:
+    """Engine-side owner of the C data plane."""
+
+    def __init__(self, engine):
+        mod = _load_module()
+        if mod is None:
+            raise RuntimeError("native dataplane extension unavailable "
+                               "(make -C native)")
+        self.engine = engine
+        self.c = mod.Plane()
+        self.wrappers: List[Optional[NativeSocket]] = []
+        self._synced = {}           # hid -> last-synced C tracker tuple
+        topo = engine.topology
+        opts = engine.options
+        lat = topo.latency_ns
+        rel = topo.reliability
+        cnt = topo.path_packet_counts
+        self.c.configure(
+            lat.ctypes.data, rel.ctypes.data, cnt.ctypes.data,
+            int(lat.shape[0]), int(engine._drop_key),
+            int(engine.bootstrap_end), int(engine.end_time),
+            _CC_KINDS[getattr(opts, "tcp_congestion_control", "reno")],
+            int(getattr(opts, "tcp_ssthresh", 0)),
+            int(getattr(opts, "tcp_windows", 10)),
+            lat, rel, cnt)
+        self.c.set_callback(self._callback)
+        self._attach_hosts()
+
+    # -- host registration + counter proxying -----------------------------
+    def _attach_hosts(self) -> None:
+        from ..routing.address import LOCALHOST_IP
+        eng = self.engine
+        for hid in sorted(eng.hosts):
+            host = eng.hosts[hid]
+            p = host.params
+            self.c.add_host(
+                int(hid), int(host.ip), int(LOCALHOST_IP),
+                int(host.topo_row), int(p.bw_down_kibps), int(p.bw_up_kibps),
+                1 if p.qdisc == "rr" else 0, _RQ_KINDS[p.router_queue],
+                int(p.recv_buf_size), int(p.send_buf_size),
+                1 if p.autotune_recv else 0, 1 if p.autotune_send else 0,
+                int(host._next_handle), int(host._next_port),
+                int(host._event_seq), int(host._packet_counter),
+                int(host._packet_priority))
+            # the per-host deterministic counters move into C so both
+            # planes draw from the same sequence space, interleaved exactly
+            host.native_plane = self
+            host.next_event_sequence = \
+                (lambda c=self.c, h=hid: lambda: c.next_seq(h))()
+            host.allocate_handle = \
+                (lambda c=self.c, h=hid: lambda: c.alloc_handle(h))()
+            host.next_packet_uid = \
+                (lambda c=self.c, h=hid: lambda: c.next_packet_uid(h))()
+            host.next_packet_priority = \
+                (lambda c=self.c, h=hid: lambda: c.next_packet_priority(h))()
+            host.tracker._native = (self, hid)
+
+    # -- socket creation ---------------------------------------------------
+    def create_socket(self, host, kind: str) -> NativeSocket:
+        sid, handle = self.c.socket(host.id, K_TCP if kind == "tcp"
+                                    else K_UDP)
+        w = NativeSocket(self, sid, handle, host, kind)
+        while len(self.wrappers) <= sid:
+            self.wrappers.append(None)
+        self.wrappers[sid] = w
+        host.register_descriptor(w)
+        return w
+
+    # -- callback shim -----------------------------------------------------
+    def _callback(self, kind: int, hid: int, t: int, a: int, b: int) -> None:
+        """Invoked by C at listener/lifecycle points.  Mirrors the clock and
+        active host the way event.execute does, so any task a listener
+        schedules gets the same (time, dst, src, seq) tuple as on the
+        Python plane."""
+        eng = self.engine
+        host = eng.hosts[hid]
+        w = current_worker()
+        prev = (w.now, w.active_host, host.now) if w is not None else None
+        if w is not None:
+            w.now = t
+            w.active_host = host
+        host.now = t
+        try:
+            if kind == CB_STATUS:
+                wrap = self.wrappers[a]
+                if wrap is not None:
+                    wrap._notify(b)
+            elif kind == CB_CHILD:
+                # a LISTEN socket spawned a child (C allocated its handle):
+                # register the wrapper so accept()/digests see it
+                child = NativeSocket(self, a, b, host, "tcp")
+                while len(self.wrappers) <= a:
+                    self.wrappers.append(None)
+                self.wrappers[a] = child
+                host.register_descriptor(child)
+            elif kind == CB_CLOSED:
+                wrap = self.wrappers[a]
+                if wrap is not None:
+                    wrap.closed = True
+                    host.descriptor_table_remove(wrap.handle)
+        finally:
+            if prev is not None:
+                w.now, w.active_host, host.now = prev
+
+    # -- engine integration ------------------------------------------------
+    def set_window(self, window_end: int) -> None:
+        # window_end inherits float-ness from a fractional <shadow stoptime>
+        self.c.set_window(int(window_end))
+
+    def counters(self):
+        """(events_scheduled, events_executed, packet_drops, last_time)."""
+        return self.c.counters()
+
+    def sync_tracker(self, hid: int, tracker) -> None:
+        """Fold the C plane's counter DELTAS since the last sync into the
+        Python tracker.  Additive, not overwriting: other engine components
+        (the device-resident traffic plane's per-node byte feed) also add
+        into the same Python counters, exactly as on the Python plane."""
+        v = self.c.tracker(hid)
+        prev = self._synced.get(hid)
+        self._synced[hid] = v
+        names = ("packets_total", "bytes_total", "packets_control",
+                 "bytes_control", "packets_data", "bytes_data",
+                 "packets_retrans", "bytes_retrans")
+        k = 0
+        for ctr in (tracker.in_local, tracker.in_remote, tracker.out_local,
+                    tracker.out_remote):
+            for n in names:
+                delta = v[k] - (prev[k] if prev else 0)
+                if delta:
+                    setattr(ctr, n, getattr(ctr, n) + delta)
+                k += 1
+        drop_delta = v[k] - (prev[k] if prev else 0)
+        if drop_delta:
+            tracker.drops += drop_delta
+
+    def iface_digest(self, hid: int) -> dict:
+        """{ip: (send_remaining, recv_remaining)} for checkpoint."""
+        from ..routing.address import LOCALHOST_IP
+        host = self.engine.hosts[hid]
+        lo_s, lo_r, eth_s, eth_r = self.c.iface_state(hid)
+        return {LOCALHOST_IP: (lo_s, lo_r), host.ip: (eth_s, eth_r)}
+
+
+def eligible(engine, log_reason: bool = False) -> Optional[str]:
+    """None when the native plane can engage; otherwise the blocking reason
+    (auto mode logs and falls back; --dataplane=native raises it)."""
+    opts = engine.options
+    if opts.workers != 0:
+        return "threaded run (native plane is serial-only)"
+    if engine.scheduler.policy_name != "global":
+        return (f"policy {engine.scheduler.policy_name!r} "
+                "(native plane backs the serial global policy)")
+    if engine.shard_count > 1:
+        return "--processes sharding"
+    for host in engine.hosts.values():
+        if host.params.log_pcap:
+            return "pcap capture enabled"
+        if host.cpu is not None and host.cpu.enabled:
+            return "host CPU delay model enabled"
+    log = get_logger()
+    if log.would_log("debug"):
+        return "debug logging (per-packet audit trails are Python-plane)"
+    if not native_available():
+        return "extension not built (make -C native)"
+    return None
+
+
+def attach(engine) -> Optional[NativePlane]:
+    """Build the plane, swap in the merging policy, and mark the engine.
+    Returns the plane (None when ineligible in auto mode)."""
+    mode = getattr(engine.options, "dataplane", "auto")
+    if mode == "python":
+        return None
+    reason = eligible(engine)
+    if reason is not None:
+        if mode == "native":
+            raise RuntimeError(f"--dataplane=native unavailable: {reason}")
+        get_logger().message("engine",
+                             f"native dataplane off: {reason}")
+        return None
+    plane = NativePlane(engine)
+    policy = NativeGlobalPolicy(plane)
+    policy.hosts = engine.scheduler.policy.hosts
+    engine.scheduler.policy = policy
+    engine.native_plane = plane
+    get_logger().message(
+        "engine",
+        f"native C dataplane engaged: {len(engine.hosts)} hosts "
+        "(TCP/UDP pipeline + interface + router + hop in C)")
+    return plane

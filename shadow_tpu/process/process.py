@@ -348,6 +348,11 @@ class SyscallAPI:
     # -- sockets -----------------------------------------------------------
     def socket(self, kind: str) -> int:
         host = self.host
+        plane = getattr(host, "native_plane", None)
+        if plane is not None and kind in ("tcp", "udp"):
+            # C data plane: the socket state lives natively; the wrapper
+            # carries the descriptor surface (parallel/native_plane.py)
+            return plane.create_socket(host, kind).handle
         handle = host.allocate_handle()
         if kind == "udp":
             from ..descriptor.udp import UDPSocket
@@ -372,6 +377,10 @@ class SyscallAPI:
         sock = self._sock(fd)
         wildcard = addr[0] in ("", "0.0.0.0", None, 0)
         ip = self._resolve(addr[0])
+        if hasattr(sock, "bind_native"):
+            # C-plane socket: the binding tables live natively
+            sock.bind_native(ip, addr[1], wildcard)
+            return
         iface = self.host.interface_for_ip(ip)
         if iface is None:
             raise OSError("EADDRNOTAVAIL")
@@ -589,12 +598,17 @@ class SyscallAPI:
 
     def deliver_signal(self, signo: int) -> int:
         """Route a virtual signal raised by this process (raise()/kill() on
-        the virtual pid): queue it on every open matching signalfd; returns
-        the match count (0 = caller may fall back to its recorded handler,
-        which is what the shim does)."""
+        the virtual pid).  signalfd(2) semantics: a blocked pending signal
+        is ONE process-wide instance, consumed by a single read — so it is
+        queued on the FIRST open matching signalfd, not fanned out to all
+        of them.  Returns 1 on a match, 0 = caller may fall back to its
+        recorded handler (which is what the shim does)."""
         live = [s for s in self.process._signal_fds if not s.closed]
         self.process._signal_fds = live
-        return sum(1 for s in live if s.deliver(signo))
+        for s in live:
+            if s.deliver(signo):
+                return 1
+        return 0
 
     def timerfd_settime(self, fd: int, initial_sec: float, interval_sec: float = 0.0) -> None:
         self._sock(fd).arm(stime.from_seconds(initial_sec),

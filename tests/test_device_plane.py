@@ -303,3 +303,56 @@ def test_plane_refuses_sharded_engines():
 
     with pytest.raises(RuntimeError):
         DeviceTrafficPlane(FakeEngine(), [], mode="device")
+
+
+def test_parse_device_client_defaults_with_nstreams_omitted():
+    """ADVICE r4: 'client 9050 <path> dest 80 device' (nstreams omitted)
+    must fall back to the defaults, not crash on int('device')."""
+    from shadow_tpu.parallel.device_plane import parse_device_client
+    spec = parse_device_client(
+        "c0", ["client", "9050", "g0,m0,e0", "dest0", "80", "device"])
+    assert spec is not None
+    assert spec.cells_down > 0 and spec.cells_up > 0
+    assert spec.route_down == ["dest0", "e0", "m0", "g0", "c0"]
+
+
+def test_duplicate_device_clients_on_one_host_rejected():
+    """ADVICE r4 (medium): two device-mode clients on one host would
+    silently share a flow keyed by host name — must raise instead."""
+    from shadow_tpu.parallel.device_plane import (DeviceTrafficPlane,
+                                                  parse_device_client)
+
+    class FakeEngine:
+        shard_count = 1
+        options = Options = type("O", (), {})()
+
+    spec_a = parse_device_client(
+        "c0", ["client", "9050", "g0,m0,e0", "dest0", "80", "1",
+               "512:51200", "device"])
+    spec_b = parse_device_client(
+        "c0", ["client", "9051", "g1,m1,e1", "dest0", "80", "1",
+               "512:51200", "device"])
+    with pytest.raises(ValueError, match="multiple device-mode"):
+        DeviceTrafficPlane(FakeEngine(), [spec_a, spec_b], mode="numpy")
+
+
+def test_activate_zero_cells_rejected(tor200_like_plane=None):
+    """ADVICE r4: activate(cells=0) could never complete (target>0 gate) —
+    the joining client would hang to end_time; reject loudly instead."""
+    from shadow_tpu.core import configuration
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.core.options import Options
+    from shadow_tpu.parallel.device_plane import build_plane_from_engine
+    from shadow_tpu.tools import workloads
+
+    xml = workloads.tor_network(8, n_clients=2, n_servers=1, stoptime=10,
+                                stream_spec="512:5120", device_data=True)
+    cfg = configuration.parse_xml(xml)
+    ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                              stop_time_sec=10), cfg)
+    ctrl.setup()
+    plane = build_plane_from_engine(ctrl.engine, mode="numpy")
+    assert plane is not None
+    client = plane.specs[0].client_name
+    with pytest.raises(ValueError, match="at least 1 cell"):
+        plane.activate(client, cells=0)
