@@ -457,6 +457,12 @@ def bench_full_sims() -> dict:
     xml_star = workloads.star_bulk(100, stoptime=30,
                                    bulk_bytes=1024 * 1024)
     out["star100_serial"] = _run_sim(xml_star, "global", 0, 30)
+    # workload #2 on the device plane (2-hop star chains in HBM; VERDICT r4
+    # next #6b): device_traffic_fraction reports the on-device share
+    xml_star_d = workloads.star_bulk(100, stoptime=30,
+                                     bulk_bytes=1024 * 1024,
+                                     device_data=True)
+    out["star100_device_plane"] = _run_sim(xml_star_d, "tpu", 0, 30)
 
     # tor10k: workload #4 on the reference's Internet GraphML
     topo_path = "/root/reference/resource/topology.graphml.xml.xz"
@@ -610,6 +616,9 @@ def main() -> None:
         "tor10k_plane_device_sec": plane_long.get("plane_device_sec"),
         "tor10k_flush_sec": t10k_dev.get("flush_sec"),
         "tor10k_wall_sec": t10k_dev.get("wall_sec"),
+        "star100_device_traffic_fraction":
+            sims.get("star100_device_plane",
+                     {}).get("device_traffic_fraction"),
         "gates_enforced": True,
     }
     blob = json.dumps(summary)

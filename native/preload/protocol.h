@@ -72,6 +72,7 @@ enum shadow_tpu_op {
   SHD_OP_EVENTFD = 36,      /* a=initval b=bit0:semaphore -> fd */
   SHD_OP_SIGNALFD = 37,     /* a=mask bitmap (bit signo-1) -> fd */
   SHD_OP_KILL = 38,         /* a=signo (self) -> n signalfds matched */
+  SHD_OP_GETNAMEINFO = 39,  /* a=ipv4 host order -> payload hostname */
 };
 
 #define SHD_REQ_HDR_LEN 40u

@@ -1327,6 +1327,171 @@ extern "C" int gethostname(char *name, size_t len) {
   return 0;
 }
 
+/* reentrant resolver family (preload_defs.h carries gethostbyname_r /
+ * gethostbyname2_r; Tor-class apps use them through libevent) — same
+ * simulator lookup as gethostbyname, caller-provided buffers */
+static int shd_ghbn_r_fill(const char *name, struct hostent *ret, char *buf,
+                           size_t buflen, struct hostent **result,
+                           int *h_errnop) {
+  *result = NULL;
+  uint32_t ip_buf = 0;
+  uint32_t got = 0;
+  if (transact(SHD_OP_GETADDRINFO, 0, 0, 0, 0, name,
+               (uint32_t)strlen(name), &ip_buf, sizeof ip_buf, &got) < 0) {
+    if (h_errnop) *h_errnop = HOST_NOT_FOUND;
+    return ENOENT;
+  }
+  /* layout inside the caller buffer: name string, 4-byte address,
+   * NULL-terminated alias list, 2-entry address list */
+  size_t name_len = strlen(name) + 1;
+  size_t need = name_len + 4 + sizeof(char *) * 3;
+  need += 16;   /* alignment slack */
+  if (buflen < need) return ERANGE;
+  char *p = buf;
+  memcpy(p, name, name_len);
+  char *stored_name = p;
+  p += name_len;
+  p = (char *)(((uintptr_t)p + 7) & ~(uintptr_t)7);
+  uint32_t addr_net = htonl(ip_buf);
+  memcpy(p, &addr_net, 4);
+  char *stored_addr = p;
+  p += 8;
+  char **lists = (char **)p;
+  lists[0] = stored_addr;   /* addr_list[0] */
+  lists[1] = NULL;          /* addr_list terminator */
+  lists[2] = NULL;          /* empty alias list */
+  ret->h_name = stored_name;
+  ret->h_aliases = &lists[2];
+  ret->h_addrtype = AF_INET;
+  ret->h_length = 4;
+  ret->h_addr_list = &lists[0];
+  *result = ret;
+  if (h_errnop) *h_errnop = 0;
+  return 0;
+}
+
+extern "C" int gethostbyname_r(const char *name, struct hostent *ret,
+                               char *buf, size_t buflen,
+                               struct hostent **result, int *h_errnop) {
+  resolve_reals();
+  if (!g_active) {
+    static int (*real_fn)(const char *, struct hostent *, char *, size_t,
+                          struct hostent **, int *);
+    if (!real_fn) *(void **)(&real_fn) = dlsym(RTLD_NEXT, "gethostbyname_r");
+    return real_fn(name, ret, buf, buflen, result, h_errnop);
+  }
+  return shd_ghbn_r_fill(name, ret, buf, buflen, result, h_errnop);
+}
+
+extern "C" int gethostbyname2_r(const char *name, int af,
+                                struct hostent *ret, char *buf,
+                                size_t buflen, struct hostent **result,
+                                int *h_errnop) {
+  resolve_reals();
+  if (!g_active) {
+    static int (*real_fn)(const char *, int, struct hostent *, char *,
+                          size_t, struct hostent **, int *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "gethostbyname2_r");
+    return real_fn(name, af, ret, buf, buflen, result, h_errnop);
+  }
+  if (af != AF_INET) {   /* the simulated network is IPv4 */
+    *result = NULL;
+    if (h_errnop) *h_errnop = HOST_NOT_FOUND;
+    return ENOENT;
+  }
+  return shd_ghbn_r_fill(name, ret, buf, buflen, result, h_errnop);
+}
+
+extern "C" int getnameinfo(const struct sockaddr *sa, socklen_t salen,
+                           char *host, socklen_t hostlen, char *serv,
+                           socklen_t servlen, int flags) {
+  resolve_reals();
+  if (!g_active) {
+    static int (*real_fn)(const struct sockaddr *, socklen_t, char *,
+                          socklen_t, char *, socklen_t, int);
+    if (!real_fn) *(void **)(&real_fn) = dlsym(RTLD_NEXT, "getnameinfo");
+    return real_fn(sa, salen, host, hostlen, serv, servlen, flags);
+  }
+  if (!sa || salen < (socklen_t)sizeof(struct sockaddr_in) ||
+      sa->sa_family != AF_INET)
+    return EAI_FAMILY;
+  const struct sockaddr_in *sin = (const struct sockaddr_in *)sa;
+  if (host && hostlen) {
+    uint32_t ip = ntohl(sin->sin_addr.s_addr);
+    char namebuf[256];
+    uint32_t got = 0;
+    int have_name = 0;
+    if (!(flags & NI_NUMERICHOST)) {
+      /* reverse lookup through the simulator's DNS */
+      if (transact(SHD_OP_GETNAMEINFO, (int64_t)ip, 0, 0, 0, NULL, 0,
+                   namebuf, sizeof namebuf - 1, &got) >= 0 && got > 0) {
+        namebuf[got] = '\0';
+        have_name = 1;
+      } else if (flags & NI_NAMEREQD) {
+        return EAI_NONAME;
+      }
+    }
+    if (have_name)
+      snprintf(host, hostlen, "%s", namebuf);
+    else
+      snprintf(host, hostlen, "%u.%u.%u.%u", (ip >> 24) & 255,
+               (ip >> 16) & 255, (ip >> 8) & 255, ip & 255);
+  }
+  if (serv && servlen)
+    snprintf(serv, servlen, "%u", (unsigned)ntohs(sin->sin_port));
+  return 0;
+}
+
+/* ppoll/pselect (preload_defs.h rows): the sigmask swap is a no-op for the
+ * simulated plane — virtual signals are delivered through signalfds/handler
+ * records at transact boundaries, not async — so these reduce to their
+ * classic forms with ns-precision timeouts */
+extern "C" int ppoll(struct pollfd *fds, nfds_t nfds,
+                     const struct timespec *tmo_p, const sigset_t *sigmask) {
+  resolve_reals();
+  int any_sim = 0;
+  for (nfds_t i = 0; i < nfds; i++)
+    if (is_sim_fd(fds[i].fd)) any_sim = 1;
+  if (!any_sim) {
+    static int (*real_fn)(struct pollfd *, nfds_t, const struct timespec *,
+                          const sigset_t *);
+    if (!real_fn) *(void **)(&real_fn) = dlsym(RTLD_NEXT, "ppoll");
+    return real_fn(fds, nfds, tmo_p, sigmask);
+  }
+  int timeout_ms = -1;
+  if (tmo_p)
+    timeout_ms = (int)(tmo_p->tv_sec * 1000 +
+                       (tmo_p->tv_nsec + 999999) / 1000000);
+  return poll(fds, nfds, timeout_ms);
+}
+
+extern "C" int pselect(int nfds, fd_set *readfds, fd_set *writefds,
+                       fd_set *exceptfds, const struct timespec *tmo_p,
+                       const sigset_t *sigmask) {
+  resolve_reals();
+  int any_sim = 0;
+  for (int fd = 0; fd < nfds && fd < FD_SETSIZE; fd++) {
+    if ((readfds && FD_ISSET(fd, readfds)) ||
+        (writefds && FD_ISSET(fd, writefds)) ||
+        (exceptfds && FD_ISSET(fd, exceptfds)))
+      if (is_sim_fd(fd)) any_sim = 1;
+  }
+  if (!any_sim) {
+    static int (*real_fn)(int, fd_set *, fd_set *, fd_set *,
+                          const struct timespec *, const sigset_t *);
+    if (!real_fn) *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pselect");
+    return real_fn(nfds, readfds, writefds, exceptfds, tmo_p, sigmask);
+  }
+  struct timeval tv, *tvp = NULL;
+  if (tmo_p) {
+    tv.tv_sec = tmo_p->tv_sec;
+    tv.tv_usec = (tmo_p->tv_nsec + 999) / 1000;
+    tvp = &tv;
+  }
+  return select(nfds, readfds, writefds, exceptfds, tvp);
+}
+
 /* -------------------------------------------------------------- random -- */
 
 extern "C" ssize_t getrandom(void *buf, size_t buflen, unsigned int flags) {

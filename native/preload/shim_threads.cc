@@ -39,7 +39,7 @@ extern "C" int shd_pool_exit_hook(int status);
 
 enum { GT_RUNNABLE = 0, GT_BLOCKED = 1, GT_DONE = 2 };
 enum { W_NONE = 0, W_FD = 1, W_SLEEP = 2, W_JOIN = 3, W_MUTEX = 4,
-       W_COND = 5 };
+       W_COND = 5, W_RWLOCK = 6, W_BARRIER = 7 };
 
 struct gt_thread {
   int tid;
@@ -560,6 +560,303 @@ extern "C" int pthread_cond_broadcast(pthread_cond_t *c) {
   if (!g_engaged) return real_cond_broadcast(c);
   cond_wake(c, 1);
   return 0;
+}
+
+/* -- rwlocks (reference rpth covers the full surface, external/rpth/
+ * pthread.c rwlock sections; a contended pthread_rwlock_wrlock under
+ * cooperative ucontext threads would otherwise block the OS thread with
+ * the holder unable to run — deadlock.  Semantics follow glibc's default
+ * PREFER_READER: readers share whenever no writer HOLDS the lock; an
+ * unlock wakes every waiter and each re-checks its acquire condition in
+ * deterministic round-robin order.) -- */
+
+struct gt_rwlock_state {
+  int readers = 0;   /* active shared holders */
+  int writer = -1;   /* tid of exclusive holder, -1 none */
+};
+static std::map<const void *, gt_rwlock_state> *g_rwlocks;
+
+static gt_rwlock_state &rwlock_state(const void *rw) {
+  if (!g_rwlocks) g_rwlocks = new std::map<const void *, gt_rwlock_state>();
+  return (*g_rwlocks)[rw];   /* absent = unlocked (NSDMI defaults) */
+}
+
+static void rwlock_wake_all(const void *rw) {
+  for (int i = 0; i < g_nthreads; i++) {
+    gt_thread *t = g_threads[i];
+    if (t && t->state == GT_BLOCKED && t->wait_kind == W_RWLOCK &&
+        t->wait_obj == rw) {
+      t->state = GT_RUNNABLE;
+      t->wait_kind = W_NONE;
+    }
+  }
+}
+
+static void rwlock_park(const void *rw) {
+  g_current->state = GT_BLOCKED;
+  g_current->wait_kind = W_RWLOCK;
+  g_current->wait_obj = rw;
+  gt_switch_to_scheduler();
+}
+
+extern "C" int pthread_rwlock_rdlock(pthread_rwlock_t *rw) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_rwlock_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_rwlock_rdlock");
+    return real_fn(rw);
+  }
+  gt_rwlock_state &st = rwlock_state(rw);
+  while (st.writer != -1) rwlock_park(rw);
+  st.readers++;
+  return 0;
+}
+
+extern "C" int pthread_rwlock_tryrdlock(pthread_rwlock_t *rw) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_rwlock_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_rwlock_tryrdlock");
+    return real_fn(rw);
+  }
+  gt_rwlock_state &st = rwlock_state(rw);
+  if (st.writer != -1) return EBUSY;
+  st.readers++;
+  return 0;
+}
+
+extern "C" int pthread_rwlock_wrlock(pthread_rwlock_t *rw) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_rwlock_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_rwlock_wrlock");
+    return real_fn(rw);
+  }
+  gt_rwlock_state &st = rwlock_state(rw);
+  while (st.writer != -1 || st.readers > 0) rwlock_park(rw);
+  st.writer = g_current->tid;
+  return 0;
+}
+
+extern "C" int pthread_rwlock_trywrlock(pthread_rwlock_t *rw) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_rwlock_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_rwlock_trywrlock");
+    return real_fn(rw);
+  }
+  gt_rwlock_state &st = rwlock_state(rw);
+  if (st.writer != -1 || st.readers > 0) return EBUSY;
+  st.writer = g_current->tid;
+  return 0;
+}
+
+extern "C" int pthread_rwlock_unlock(pthread_rwlock_t *rw) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_rwlock_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_rwlock_unlock");
+    return real_fn(rw);
+  }
+  gt_rwlock_state &st = rwlock_state(rw);
+  if (st.writer == g_current->tid) st.writer = -1;
+  else if (st.readers > 0) st.readers--;
+  rwlock_wake_all(rw);
+  return 0;
+}
+
+extern "C" int pthread_rwlock_init(pthread_rwlock_t *rw,
+                                   const pthread_rwlockattr_t *attr) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_rwlock_t *, const pthread_rwlockattr_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_rwlock_init");
+    return real_fn(rw, attr);
+  }
+  if (g_rwlocks) g_rwlocks->erase(rw);
+  return 0;
+}
+
+extern "C" int pthread_rwlock_destroy(pthread_rwlock_t *rw) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_rwlock_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_rwlock_destroy");
+    return real_fn(rw);
+  }
+  if (g_rwlocks) g_rwlocks->erase(rw);
+  return 0;
+}
+
+/* -- barriers (rpth pthread.c barrier sections; pthread_barrier_wait from
+ * N cooperative threads must park N-1 and release them all when the last
+ * arrives — blocking the OS thread would hang the whole instance) -- */
+
+struct gt_barrier_state {
+  unsigned count;       /* required arrivals per phase */
+  unsigned arrived;     /* arrivals this phase */
+  unsigned generation;  /* bumps when a phase releases */
+};
+static std::map<const void *, gt_barrier_state> *g_barriers;
+
+extern "C" int pthread_barrier_init(pthread_barrier_t *b,
+                                    const pthread_barrierattr_t *attr,
+                                    unsigned count) {
+  if (count == 0) return EINVAL;
+  /* record the count in the side table UNCONDITIONALLY: barriers are
+   * typically initialized by the main thread BEFORE the first
+   * pthread_create engages green-thread mode, and the wait (which runs
+   * engaged) has no portable way to recover the count from the opaque
+   * glibc object */
+  if (!g_barriers) g_barriers = new std::map<const void *, gt_barrier_state>();
+  (*g_barriers)[b] = gt_barrier_state{count, 0, 0};
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_barrier_t *, const pthread_barrierattr_t *,
+                          unsigned);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_barrier_init");
+    return real_fn(b, attr, count);
+  }
+  return 0;
+}
+
+extern "C" int pthread_barrier_destroy(pthread_barrier_t *b) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_barrier_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_barrier_destroy");
+    return real_fn(b);
+  }
+  if (g_barriers) g_barriers->erase(b);
+  return 0;
+}
+
+extern "C" int pthread_barrier_wait(pthread_barrier_t *b) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_barrier_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_barrier_wait");
+    return real_fn(b);
+  }
+  if (!g_barriers || !g_barriers->count(b)) return EINVAL;
+  gt_barrier_state &st = (*g_barriers)[b];
+  unsigned gen = st.generation;
+  st.arrived++;
+  if (st.arrived == st.count) {
+    /* last arrival releases the phase: wake every parked waiter */
+    st.arrived = 0;
+    st.generation++;
+    for (int i = 0; i < g_nthreads; i++) {
+      gt_thread *t = g_threads[i];
+      if (t && t->state == GT_BLOCKED && t->wait_kind == W_BARRIER &&
+          t->wait_obj == b) {
+        t->state = GT_RUNNABLE;
+        t->wait_kind = W_NONE;
+      }
+    }
+    return PTHREAD_BARRIER_SERIAL_THREAD;
+  }
+  while (st.generation == gen) {
+    g_current->state = GT_BLOCKED;
+    g_current->wait_kind = W_BARRIER;
+    g_current->wait_obj = b;
+    gt_switch_to_scheduler();
+  }
+  return 0;
+}
+
+/* -- spinlocks: under cooperative green threads an actual spin would hang
+ * the only OS thread, so spinlocks park exactly like mutexes (same side
+ * table machinery, address-keyed — spinlock and mutex objects can never
+ * alias) -- */
+
+extern "C" int pthread_spin_init(pthread_spinlock_t *l, int pshared) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_spinlock_t *, int);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_spin_init");
+    return real_fn(l, pshared);
+  }
+  if (g_mutexes) g_mutexes->erase((const void *)(uintptr_t)l);
+  return 0;
+}
+
+extern "C" int pthread_spin_destroy(pthread_spinlock_t *l) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_spinlock_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_spin_destroy");
+    return real_fn(l);
+  }
+  if (g_mutexes) g_mutexes->erase((const void *)(uintptr_t)l);
+  return 0;
+}
+
+extern "C" int pthread_spin_lock(pthread_spinlock_t *l) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_spinlock_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_spin_lock");
+    return real_fn(l);
+  }
+  return pthread_mutex_lock((pthread_mutex_t *)l);
+}
+
+extern "C" int pthread_spin_trylock(pthread_spinlock_t *l) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_spinlock_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_spin_trylock");
+    return real_fn(l);
+  }
+  return pthread_mutex_trylock((pthread_mutex_t *)l);
+}
+
+extern "C" int pthread_spin_unlock(pthread_spinlock_t *l) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_spinlock_t *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_spin_unlock");
+    return real_fn(l);
+  }
+  return pthread_mutex_unlock((pthread_mutex_t *)l);
+}
+
+/* -- pthread_once: POSIX requires late arrivals to wait until the running
+ * init completes (the init routine may park cooperatively mid-run), so
+ * racers wait on the once address through the condvar machinery -- */
+
+static std::map<const void *, int> *g_once_state;   /* 0/absent, 1 run, 2 done */
+
+extern "C" int pthread_once(pthread_once_t *once, void (*init)(void)) {
+  if (!g_once_state) g_once_state = new std::map<const void *, int>();
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_once_t *, void (*)(void));
+    if (!real_fn) *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_once");
+    int rc = real_fn(once, init);
+    /* record pre-engage completions: glibc marked its opaque object done,
+     * and a later call AFTER green-thread mode engages consults only the
+     * side table — without this, the init would run a second time */
+    if (rc == 0) (*g_once_state)[once] = 2;
+    return rc;
+  }
+  for (;;) {
+    int &st = (*g_once_state)[once];
+    if (st == 2) return 0;
+    if (st == 0) {
+      st = 1;
+      init();
+      (*g_once_state)[once] = 2;
+      cond_wake(once, 1);
+      return 0;
+    }
+    /* another green thread is inside init(): wait for its completion */
+    cond_waiters(once).push_back(g_current->tid);
+    g_current->state = GT_BLOCKED;
+    g_current->wait_kind = W_COND;
+    g_current->wait_obj = once;
+    gt_switch_to_scheduler();
+  }
 }
 
 /* -- thread-specific data (keys shared with real impl before engage) -- */

@@ -25,10 +25,16 @@ def main(api, args):
         port = int(args[1]) if len(args) > 1 else 80
         yield from _server(api, port)
         return 0
+    device_mode = "device" in args
+    if device_mode:
+        args = [a for a in args if a != "device"]
     server = args[1]
     port = int(args[2]) if len(args) > 2 else 80
     specs = args[3:] if len(args) > 3 else ["1024:65536"]
-    ok = yield from _client(api, server, port, specs)
+    if device_mode:
+        ok = yield from _client_device(api, server, port, specs)
+    else:
+        ok = yield from _client(api, server, port, specs)
     return 0 if ok else 1
 
 
@@ -65,6 +71,24 @@ def _serve_stream(api, fd):
         yield from api.send(fd, b"d" * n)
         sent += n
     api.close(fd)
+
+
+def _client_device(api, server, port, specs):
+    """Device-plane bulk: the control plane still runs — a real TCP
+    connect + the tgen header handshake (0:0, so the server serves nothing
+    and closes) — then the bulk bytes advance in HBM
+    (parallel/device_plane.py) and the client blocks until the plane
+    reports completion."""
+    fd = api.socket("tcp")
+    yield from api.connect(fd, (server, port))
+    yield from api.send(fd, (0).to_bytes(8, "big") + (0).to_bytes(8, "big"))
+    api.close(fd)
+    handle = api.device_flow_start(route=[server])
+    done_ns = yield from api.device_flow_join(handle)
+    total_down = sum(int(s.partition(":")[2] or 0) for s in specs)
+    api.log(f"tgen client device flow complete at {done_ns / 1e9:.3f}s "
+            f"({total_down}B down, {len(specs)} streams)")
+    return True
 
 
 def _client(api, server, port, specs):

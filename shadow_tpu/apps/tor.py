@@ -165,15 +165,16 @@ def fetch_consensus(api, dirspec):
     return relays
 
 
-def pick_path(api, relays, n_hops=3):
-    """Bandwidth-weighted path selection without replacement, drawn from
-    the HOST's deterministic RNG (per-host stream: identical across
-    scheduler policies, so digests stay parity-comparable)."""
+def pick_weighted(rng, relays, n_hops=3):
+    """Bandwidth-weighted selection without replacement from an explicit
+    RandomSource.  Shared by the runtime client AND the device plane's
+    startup path prediction (parallel/device_plane.py replays the same
+    draws from the same derived stream)."""
     pool = list(relays)
     path = []
     for _ in range(min(n_hops, len(pool))):
         total = sum(w for _n, _p, w in pool)
-        draw = api.host.random.next_int(max(total, 1))
+        draw = rng.next_int(max(total, 1))
         acc = 0
         for i, (name, orport, w) in enumerate(pool):
             acc += w
@@ -185,6 +186,13 @@ def pick_path(api, relays, n_hops=3):
             path.append(pool[-1][:2])
             pool.pop()
     return path
+
+
+def pick_path(api, relays, n_hops=3):
+    """Bandwidth-weighted path selection without replacement, drawn from
+    the HOST's deterministic RNG (per-host stream: identical across
+    scheduler policies, so digests stay parity-comparable)."""
+    return pick_weighted(api.host.random, relays, n_hops)
 
 
 # ---------------------------------------------------------------------------
@@ -387,7 +395,15 @@ def client_main(api, args):
         if not consensus:
             api.log("tor client: empty consensus")
             return False
-        path = pick_path(api, consensus)
+        if device_mode:
+            # device plane: draw from a DERIVED stream (order-independent)
+            # so the plane can predict this exact path at startup from the
+            # config-determined consensus (parallel/device_plane.py); the
+            # consensus fetch above still exercised the real TCP bootstrap
+            path = pick_weighted(api.host.random.spawn("device-circuit"),
+                                 consensus)
+        else:
+            path = pick_path(api, consensus)
         api.log(f"tor client: consensus has {len(consensus)} relays, "
                 f"picked {'->'.join(h for h, _ in path)}")
     else:
@@ -420,7 +436,9 @@ def client_main(api, args):
 
     if device_mode:
         # control plane done — hand the bulk transfer to the device plane
-        handle = api.device_flow_start()
+        # (the route cross-check catches a consensus-prediction divergence
+        # for auto: clients; static paths trivially match)
+        handle = api.device_flow_start(route=[h for h, _p in path])
         done_ns = yield from api.device_flow_join(handle)
         for i in range(nstreams):
             spec = specs[i % len(specs)]

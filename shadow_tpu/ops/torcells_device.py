@@ -109,11 +109,25 @@ def torcells_run(queued0: jnp.ndarray,     # int64 [F] initial cells/flow
     size = jnp.int64(CELL_WIRE_BYTES)
     is_last = flow_succ < 0
 
+    # successor-space arrival latency: arr_lat[j] = onward latency of j's
+    # predecessor (succ is injective over chains, so scatter-add == set).
+    # Cells in flight live in a [L, F] HISTORY of per-step successor-space
+    # send vectors, consumed by a GATHER at hist[(t - arr_lat) mod L, j] —
+    # no full-buffer scatter per step.  (The previous formulation scattered
+    # into an arrival ring at computed (slot, succ) indices; XLA:CPU
+    # materializes a copy of the whole [L, F] operand per scatter, which
+    # was ~95% of the flagship device-plane's flush wall — VERDICT r4 weak
+    # #2.  The gather form writes one row per step via dynamic-update-slice,
+    # which aliases in place on every backend.)
+    arr_lat = jnp.zeros(f, jnp.int64).at[jnp.maximum(flow_succ, 0)].add(
+        jnp.where(is_last, jnp.int64(0), flow_lat))
+    cols = jnp.arange(f)
+
     def body(state):
-        t, queued, ring, tokens, delivered, forwards = state
-        # arrivals scheduled for this tick
-        arr = ring[jnp.mod(t, ring_len)]
-        ring = ring.at[jnp.mod(t, ring_len)].set(jnp.zeros(f, jnp.int64))
+        t, queued, hist, tokens, delivered, forwards = state
+        # arrivals: my predecessor's sends from arr_lat steps ago (columns
+        # with no predecessor are never written, so they gather zeros)
+        arr = hist[jnp.mod(t - arr_lat, ring_len), cols]
         queued = queued + arr
         # refill buckets
         tokens = jnp.minimum(capacity, tokens + refill)
@@ -132,11 +146,11 @@ def torcells_run(queued0: jnp.ndarray,     # int64 [F] initial cells/flow
         # departures: last stage delivers, others arrive at successor after
         # their edge latency
         delivered = delivered + jnp.where(is_last, served, 0)
-        slot = jnp.mod(t + flow_lat, ring_len)
-        fwd = jnp.where(is_last, jnp.int64(0), served)
-        ring = ring.at[slot, jnp.maximum(flow_succ, 0)].add(fwd)
+        v = jnp.zeros(f, jnp.int64).at[jnp.maximum(flow_succ, 0)].add(
+            jnp.where(is_last, jnp.int64(0), served))
+        hist = hist.at[jnp.mod(t, ring_len)].set(v)
         forwards = forwards + jnp.sum(served)
-        return t + 1, queued, ring, tokens, delivered, forwards
+        return t + 1, queued, hist, tokens, delivered, forwards
 
     total = jnp.sum(queued0)
 
@@ -200,15 +214,26 @@ def torcells_step_window(t0: jnp.ndarray,         # int64 scalar: next tick
     queued = queued + inject
     target = target + inject_target
     # fold skipped idle ticks (the plane had no cells anywhere, so the only
-    # state evolution was bucket refill — exact because refill is capped)
+    # state evolution was bucket refill — exact because refill is capped).
+    # The send history must be cleared across an idle jump: banking requires
+    # every cell delivered, so all past sends were consumed — but a jumped t
+    # would otherwise re-read stale rows on wrap (lax.cond: the zeroing pass
+    # only runs when ticks were actually banked).
     tokens = jnp.minimum(capacity, tokens + refill * idle_ticks)
+    ring = jax.lax.cond(idle_ticks > 0,
+                        lambda hh: jnp.zeros_like(hh),
+                        lambda hh: hh, ring)
+    # successor-space arrival latency (see torcells_run): hist rows are
+    # per-step send vectors; arrivals are a gather, the only write is one
+    # row DUS — nothing scatters into the big buffer
+    arr_lat = jnp.zeros(f, jnp.int64).at[jnp.maximum(flow_succ, 0)].add(
+        jnp.where(is_last, jnp.int64(0), flow_lat))
+    cols = jnp.arange(f)
 
     def body(state):
-        t, queued, ring, tokens, delivered, target, done_tick, node_sent, \
+        t, queued, hist, tokens, delivered, target, done_tick, node_sent, \
             forwards = state
-        row = jnp.mod(t, ring_len)
-        arr = ring[row]
-        ring = ring.at[row].set(jnp.zeros(f, jnp.int64))
+        arr = hist[jnp.mod(t - arr_lat, ring_len), cols]
         queued = queued + arr
         tokens = jnp.minimum(capacity, tokens + refill)
         cap_cells = tokens[flow_node] // size
@@ -226,11 +251,11 @@ def torcells_step_window(t0: jnp.ndarray,         # int64 scalar: next tick
         newly_done = (is_last & (target > 0) & (done_tick < 0)
                       & (delivered >= target))
         done_tick = jnp.where(newly_done, t, done_tick)
-        slot = jnp.mod(t + flow_lat, ring_len)
-        fwd = jnp.where(is_last, jnp.int64(0), served)
-        ring = ring.at[slot, jnp.maximum(flow_succ, 0)].add(fwd)
+        v = jnp.zeros(f, jnp.int64).at[jnp.maximum(flow_succ, 0)].add(
+            jnp.where(is_last, jnp.int64(0), served))
+        hist = hist.at[jnp.mod(t, ring_len)].set(v)
         forwards = forwards + jnp.sum(served)
-        return (t + 1, queued, ring, tokens, delivered, target, done_tick,
+        return (t + 1, queued, hist, tokens, delivered, target, done_tick,
                 node_sent, forwards)
 
     end = t0 + n_ticks
@@ -258,12 +283,16 @@ def torcells_step_window_numpy(t0, queued, ring, tokens, delivered, target,
     queued = queued + inject
     target = target + inject_target
     tokens = np.minimum(capacity, tokens + refill * int(idle_ticks))
+    if int(idle_ticks) > 0:
+        ring = np.zeros_like(ring)   # idle jump: stale send history cleared
+    arr_lat = np.zeros(f, dtype=np.int64)
+    np.add.at(arr_lat, np.maximum(flow_succ, 0),
+              np.where(is_last, 0, flow_lat))
+    cols = np.arange(f)
     forwards = 0
     t = int(t0)
     for _ in range(int(n_ticks)):
-        row = t % ring_len
-        arr = ring[row].copy()
-        ring[row] = 0
+        arr = ring[(t - arr_lat) % ring_len, cols]
         queued = queued + arr
         tokens = np.minimum(capacity, tokens + refill)
         cap_cells = tokens[flow_node] // size
@@ -281,9 +310,9 @@ def torcells_step_window_numpy(t0, queued, ring, tokens, delivered, target,
         newly_done = (is_last & (target > 0) & (done_tick < 0)
                       & (delivered >= target))
         done_tick = np.where(newly_done, t, done_tick)
-        slot = (t + flow_lat) % ring_len
-        fwd = np.where(is_last, 0, served)
-        np.add.at(ring, (slot, np.maximum(flow_succ, 0)), fwd)
+        v = np.zeros(f, dtype=np.int64)
+        np.add.at(v, np.maximum(flow_succ, 0), np.where(is_last, 0, served))
+        ring[t % ring_len] = v
         forwards += int(served.sum())
         t += 1
     return (np.int64(t), queued, ring, tokens, delivered, target, done_tick,
@@ -450,19 +479,27 @@ def make_torcells_sharded_window(mesh, axis: str, ring_len: int):
             queued = queued + inject
             target = target + inject_target
             tokens = jnp.minimum(capacity, tokens + refill * idle_ticks)
+            # idle jump: the replicated send history is stale (see the
+            # single-device window kernel) — clear it only when banked
+            ring = jax.lax.cond(idle_ticks > 0,
+                                lambda hh: jnp.zeros_like(hh),
+                                lambda hh: hh, ring)
             end = t0 + n_ticks
             size = jnp.int64(CELL_WIRE_BYTES)
             is_last = succ_global < 0
             base = shard_base[0]
             f_total = ring.shape[1]
+            # my columns' arrival latencies (arr_lat is replicated
+            # successor-space; this shard reads its own slice)
+            my_arr_lat = jax.lax.dynamic_slice(arr_lat, (base,), (fp,))
+            my_cols = base + jnp.arange(fp)
 
             def body(state):
                 t, queued, ring, tokens, delivered, target, done_tick, \
                     node_sent, forwards = state
-                row = jnp.mod(t, ring_len)
-                arr = jax.lax.dynamic_slice(ring[row], (base,), (fp,))
-                # every shard clears the consumed row identically (replica)
-                ring = ring.at[row].set(jnp.zeros(f_total, jnp.int64))
+                # arrivals: gather my columns' sends from arr_lat steps ago
+                # out of the replicated history (identical on every shard)
+                arr = ring[jnp.mod(t - my_arr_lat, ring_len), my_cols]
                 queued = queued + arr
                 tokens = jnp.minimum(capacity, tokens + refill)
                 cap_cells = tokens[flow_node_local] // size
@@ -481,16 +518,14 @@ def make_torcells_sharded_window(mesh, axis: str, ring_len: int):
                 newly = (is_last & (target > 0) & (done_tick < 0)
                          & (delivered >= target))
                 done_tick = jnp.where(newly, t, done_tick)
-                # successor-space forward vector: my flows' served cells
-                # land at succ_global; ONE psum per tick assembles the full
-                # [F] vector, then every shard applies the identical update
-                # to its ring replica
+                # successor-space send vector: my flows' served cells land
+                # at succ_global; ONE psum per tick assembles the full [F]
+                # row, then every shard writes the identical history row
                 fwd = jnp.where(is_last, jnp.int64(0), served)
                 v = jnp.zeros(f_total, jnp.int64).at[
                     jnp.maximum(succ_global, 0)].add(fwd)
                 v = jax.lax.psum(v, axis)
-                slot = jnp.mod(t + arr_lat, ring_len)
-                ring = ring.at[slot, jnp.arange(f_total)].add(v)
+                ring = ring.at[jnp.mod(t, ring_len)].set(v)
                 forwards = forwards + jax.lax.psum(jnp.sum(served), axis)
                 return (t + 1, queued, ring, tokens, delivered, target,
                         done_tick, node_sent, forwards)
@@ -532,12 +567,15 @@ def torcells_run_numpy(queued0, flow_node, flow_lat, flow_succ, seg_start,
     ring = np.zeros((ring_len, f), dtype=np.int64)
     tokens = capacity.astype(np.int64).copy()
     delivered = np.zeros(f, dtype=np.int64)
+    arr_lat = np.zeros(f, dtype=np.int64)
+    np.add.at(arr_lat, np.maximum(flow_succ, 0),
+              np.where(is_last, 0, flow_lat))
+    cols = np.arange(f)
     forwards = 0
     t = 0
     total = int(queued0.sum())
     while delivered.sum() < total and t < max_ticks:
-        arr = ring[t % ring_len].copy()
-        ring[t % ring_len] = 0
+        arr = ring[(t - arr_lat) % ring_len, cols]
         queued += arr
         tokens = np.minimum(capacity, tokens + refill)
         cap_cells = tokens[flow_node] // size
@@ -551,9 +589,9 @@ def torcells_run_numpy(queued0, flow_node, flow_lat, flow_succ, seg_start,
                             minlength=h).astype(np.int64)
         tokens -= spent
         delivered += np.where(is_last, served, 0)
-        slot = (t + flow_lat) % ring_len
-        fwd = np.where(is_last, 0, served)
-        np.add.at(ring, (slot, np.maximum(flow_succ, 0)), fwd)
+        v = np.zeros(f, dtype=np.int64)
+        np.add.at(v, np.maximum(flow_succ, 0), np.where(is_last, 0, served))
+        ring[t % ring_len] = v
         forwards += int(served.sum())
         t += 1
     return delivered, t, forwards

@@ -60,28 +60,50 @@ TICK_NS = 1_000_000          # 1 ms, = the interface refill interval
 
 
 class _FlowSpec:
-    """One device-mode client = TWO independent cell chains: the download
-    (server -> exit -> middle -> guard -> client) and the upload
-    (client -> guard -> middle -> exit -> server).  The client's flow is
-    complete when BOTH chains have delivered."""
+    """One device-mode client = TWO independent cell chains, e.g. a tor
+    download (server -> exit -> middle -> guard -> client) and upload
+    (client -> guard -> middle -> exit -> server), or a star-bulk pair
+    (server -> client / client -> server).  Chains may have different hop
+    counts per spec — the flow table is built from the actual routes.  The
+    client's flow is complete when BOTH chains have delivered.
+
+    ``route_down`` may be None for an auto: consensus client; the plane
+    resolves it at startup by replaying the client's derived path draw over
+    the config-predicted consensus (resolve_auto_routes)."""
 
     __slots__ = ("client_name", "route_down", "route_up", "cells_down",
-                 "cells_up", "circuit")
+                 "cells_up", "circuit", "dirspec", "dest")
 
-    def __init__(self, client_name: str, route_down: List[str],
-                 route_up: List[str], cells_down: int, cells_up: int):
+    def __init__(self, client_name: str, route_down: Optional[List[str]],
+                 route_up: Optional[List[str]], cells_down: int,
+                 cells_up: int, dirspec: Optional[str] = None,
+                 dest: Optional[str] = None):
         self.client_name = client_name
         self.route_down = route_down
         self.route_up = route_up
         self.cells_down = cells_down
         self.cells_up = cells_up
         self.circuit = -1
+        self.dirspec = dirspec
+        self.dest = dest
+
+
+def _cells_for(nstreams: int, specs: List[str]):
+    from ..apps.tor import PAYLOAD_MAX
+    cells_down = cells_up = 0
+    for i in range(nstreams):
+        up, down = (int(x) for x in specs[i % len(specs)].split(":"))
+        cells_down += max(1, math.ceil(down / PAYLOAD_MAX))
+        cells_up += max(1, math.ceil(up / PAYLOAD_MAX))
+    return cells_down, cells_up
 
 
 def parse_device_client(host_name: str, args: List[str]) -> Optional[_FlowSpec]:
     """Recognize a tor client process configured for device-plane data
     ('device' flag in its args).  args layout (apps/tor.py client role):
-    client <socksport> <path> <dest> <destport> <nstreams> <spec...> device"""
+    client <socksport> <path> <dest> <destport> <nstreams> <spec...> device
+    <path> is a static 3-hop list or 'auto:<dirhost>[:<dirport>]' (the
+    consensus route is predicted at startup — resolve_auto_routes)."""
     if not args or args[0] != "client" or "device" not in args:
         return None
     # strip the mode token BEFORE positional parsing (client_main does the
@@ -89,35 +111,85 @@ def parse_device_client(host_name: str, args: List[str]) -> Optional[_FlowSpec]:
     # falls back to the defaults instead of int("device") crashing
     args = [a for a in args if a != "device"]
     path_s = args[2]
-    if path_s.startswith("auto:"):
-        raise ValueError(
-            f"{host_name}: device-plane clients need a static path (the "
-            "flow table is built at startup); consensus path selection "
-            "('auto:') is a Python-plane feature")
-    path = [h.partition(":")[0] for h in path_s.split(",")]
-    if len(path) != 3:
-        raise ValueError(f"{host_name}: device-plane needs a 3-hop path")
     dest = args[3]
     nstreams = int(args[5]) if len(args) > 5 else 1
     specs = args[6:] or ["100:10000"]
-    from ..apps.tor import PAYLOAD_MAX
-    cells_down = cells_up = 0
-    for i in range(nstreams):
-        up, down = (int(x) for x in specs[i % len(specs)].split(":"))
-        cells_down += max(1, math.ceil(down / PAYLOAD_MAX))
-        cells_up += max(1, math.ceil(up / PAYLOAD_MAX))
+    cells_down, cells_up = _cells_for(nstreams, specs)
+    if path_s.startswith("auto:"):
+        return _FlowSpec(host_name, None, None, cells_down, cells_up,
+                         dirspec=path_s[len("auto:"):], dest=dest)
+    path = [h.partition(":")[0] for h in path_s.split(",")]
+    if len(path) != 3:
+        raise ValueError(f"{host_name}: device-plane needs a 3-hop path")
     guard, middle, exit_ = path[0], path[1], path[2]
     return _FlowSpec(host_name,
                      [dest, exit_, middle, guard, host_name],
                      [host_name, guard, middle, exit_, dest],
-                     cells_down, cells_up)
+                     cells_down, cells_up, dest=dest)
+
+
+def parse_device_tgen(host_name: str, args: List[str]) -> Optional[_FlowSpec]:
+    """Recognize a tgen client configured for device-plane data (workload
+    #2, star bulk): client <server> <port> <spec...> device.  The flow is a
+    2-hop pair: server->client download and client->server upload, paced by
+    the two hosts' own up/down buckets."""
+    if not args or args[0] != "client" or "device" not in args:
+        return None
+    args = [a for a in args if a != "device"]
+    server = args[1]
+    specs = args[3:] if len(args) > 3 else ["1024:65536"]
+    cells_down, cells_up = _cells_for(len(specs), specs)
+    return _FlowSpec(host_name, [server, host_name], [host_name, server],
+                     cells_down, cells_up, dest=server)
+
+
+def resolve_auto_routes(engine, specs: List[_FlowSpec]) -> None:
+    """Fill in auto: specs' routes at startup by replaying each client's
+    path draw: the consensus is config-determined (every relay publishes
+    its name/orport/bw from its own args, and the authority serves them
+    sorted by name), and device-mode clients draw from the DERIVED
+    per-host stream host.random.spawn('device-circuit') — independent of
+    execution order, so the replay here is exact.  The runtime cross-check
+    (DeviceTrafficPlane.check_route via api.device_flow_start) fails
+    loudly if the fetched consensus ever diverges from this prediction."""
+    autos = [s for s in specs if s.route_down is None]
+    if not autos:
+        return
+    from ..apps.tor import pick_weighted
+    relays = {}
+    for hid in sorted(engine.hosts):
+        host = engine.hosts[hid]
+        for proc in host.processes:
+            if not str(getattr(proc, "app_path", "")).endswith("tor"):
+                continue
+            a = proc.args
+            # relay <orport> <dirauth_host:port> <bw>: publishes into the
+            # consensus (apps/tor.py relay role)
+            if a and a[0] == "relay" and len(a) > 2 and a[2]:
+                orport = int(a[1]) if len(a) > 1 else 9001
+                bw = int(a[3]) if len(a) > 3 else 100
+                relays[host.name] = (orport, bw)
+    consensus = [(n, p, w) for n, (p, w) in sorted(relays.items())]
+    if not consensus:
+        raise ValueError(
+            "device plane: auto: clients configured but no publishing "
+            "relays found (no dirauth-registered relay processes)")
+    for s in autos:
+        host = engine.host_by_name(s.client_name)
+        rng = host.random.spawn("device-circuit")
+        path = [name for name, _port in pick_weighted(rng, consensus)]
+        if len(path) != 3:
+            raise ValueError(
+                f"{s.client_name}: consensus has only {len(path)} usable "
+                "relays; device-plane circuits need 3 hops")
+        guard, middle, exit_ = path[0], path[1], path[2]
+        s.route_down = [s.dest, exit_, middle, guard, s.client_name]
+        s.route_up = [s.client_name, guard, middle, exit_, s.dest]
 
 
 class DeviceTrafficPlane:
     """Owns the device-resident state for all registered bulk flows and the
     engine-side activation/wake bookkeeping."""
-
-    STAGES = 5
 
     def __init__(self, engine, specs: List[_FlowSpec], mode: str = "device"):
         if engine.shard_count > 1:
@@ -215,14 +287,14 @@ class DeviceTrafficPlane:
                 names.append(key)
             return name_idx[key]
 
-        c = 2 * len(self.specs)                # two chains per client
-        st = self.STAGES
-        route = np.empty((c, st), dtype=np.int64)
+        # chains: 2 per spec (download then upload), VARIABLE hop counts —
+        # a tor circuit is 5 stages, a star-bulk pair is 2 (the flow table
+        # is built from the actual routes, not a fixed grid)
+        chains: List[List[int]] = []
         for s in self.specs:
-            for k, rt in ((2 * s.circuit, s.route_down),
-                          (2 * s.circuit + 1, s.route_up)):
-                route[k] = [node_of(nm, "tx") for nm in rt[:-1]] + \
-                           [node_of(rt[-1], "rx")]
+            for rt in (s.route_down, s.route_up):
+                chains.append([node_of(nm, "tx") for nm in rt[:-1]] +
+                              [node_of(rt[-1], "rx")])
         self.node_names = names
         self.node_hosts = []
         self.node_kind = [k for (_nm, k) in names]
@@ -242,24 +314,32 @@ class DeviceTrafficPlane:
         refill, capacity = bucket_params(rates)
         self.refill = refill.astype(np.int64)
         self.capacity = capacity.astype(np.int64)
-        flow_circ = np.repeat(np.arange(c, dtype=np.int64), st)
-        flow_stage = np.tile(np.arange(st, dtype=np.int64), c)
-        flow_node = route[flow_circ, flow_stage]
-        order = np.lexsort((flow_stage, flow_circ, flow_node))
-        flow_circ, flow_stage, flow_node = (flow_circ[order],
-                                            flow_stage[order],
-                                            flow_node[order])
-        nxt = np.where(flow_stage < st - 1,
-                       route[flow_circ, np.minimum(flow_stage + 1, st - 1)],
-                       route[flow_circ, flow_stage])
+        # flatten chains into pre-sort flow arrays (chain-contiguous)
+        c = len(chains)
+        chain_len = np.array([len(rt) for rt in chains], dtype=np.int64)
+        n_flows = int(chain_len.sum())
+        flow_chain = np.repeat(np.arange(c, dtype=np.int64), chain_len)
+        flow_stage = np.concatenate(
+            [np.arange(m, dtype=np.int64) for m in chain_len])
+        flow_node = np.concatenate(
+            [np.asarray(rt, dtype=np.int64) for rt in chains])
+        is_last_pre = flow_stage == chain_len[flow_chain] - 1
+        nxt = np.where(is_last_pre, flow_node,
+                       np.roll(flow_node, -1))       # next stage, same chain
+        pre_succ = np.where(is_last_pre, -1,
+                            np.arange(n_flows, dtype=np.int64) + 1)
         lat_ns = np.asarray(topo.latency_ns)[rows[flow_node], rows[nxt]]
-        lat = np.maximum(lat_ns // TICK_NS, 1)
-        lat = np.where(flow_stage < st - 1, lat, 0)
-        flat_id = flow_circ * st + flow_stage
-        pos_of = np.empty(c * st, dtype=np.int64)
-        pos_of[flat_id] = np.arange(c * st)
-        succ = np.where(flow_stage < st - 1,
-                        pos_of[np.minimum(flat_id + 1, c * st - 1)], -1)
+        lat_pre = np.where(is_last_pre, 0,
+                           np.maximum(lat_ns // TICK_NS, 1))
+        # sort by (paced node, chain, stage): the per-tick allocation is a
+        # segment cumsum in this order (exact greedy per node)
+        order = np.lexsort((flow_stage, flow_chain, flow_node))
+        pos_of = np.empty(n_flows, dtype=np.int64)
+        pos_of[order] = np.arange(n_flows)
+        flow_node = flow_node[order]
+        lat = lat_pre[order]
+        succ = np.where(pre_succ[order] >= 0,
+                        pos_of[np.maximum(pre_succ[order], 0)], -1)
         starts = np.flatnonzero(np.r_[True, flow_node[1:] != flow_node[:-1]])
         seg_id = np.cumsum(np.r_[0, (flow_node[1:] != flow_node[:-1])
                                  .astype(np.int64)])
@@ -267,11 +347,12 @@ class DeviceTrafficPlane:
         self.flow_lat = lat.astype(np.int64)
         self.flow_succ = succ
         self.seg_start = starts[seg_id]
-        self.flow_circ = flow_circ
-        self.flow_stage = flow_stage
-        # per-circuit entry (stage 0) and exit (stage 4) flow positions
-        self.first_flow = pos_of[np.arange(c) * st + 0]
-        self.last_flow = pos_of[np.arange(c) * st + (st - 1)]
+        self.flow_circ = flow_chain[order]
+        self.flow_stage = flow_stage[order]
+        # per-chain entry (stage 0) and exit (last stage) flow positions
+        chain_base = np.r_[0, np.cumsum(chain_len)[:-1]]
+        self.first_flow = pos_of[chain_base]
+        self.last_flow = pos_of[chain_base + chain_len - 1]
         # Step granulation: the kernel's loop iteration covers ``granule``
         # milliseconds.  Chosen so the arrival ring stays <= ~64 slots even
         # on multi-second-latency topologies (the reference GraphML has
@@ -296,7 +377,7 @@ class DeviceTrafficPlane:
         # rate preservation: a backlogged node must be able to spend a full
         # step's refill; burst capacity otherwise keeps the 1 ms bucket's
         self.capacity_step = np.maximum(self.capacity, self.refill_step)
-        self.n_flows = c * st
+        self.n_flows = n_flows
         self.n_nodes = len(names)
 
     # -- state ------------------------------------------------------------
@@ -406,6 +487,24 @@ class DeviceTrafficPlane:
             self._inject_buf.append((2 * spec.circuit + 1, up))
         self.total_injected_cells += down + up
         return spec.circuit
+
+    def check_route(self, client_name: str, hops: List[str]) -> None:
+        """Cross-check the client's RUNTIME route (hop host names in
+        client-side order, e.g. [guard, middle, exit] for tor or [server]
+        for star bulk) against the spec the flow table was built from.  A
+        mismatch means an auto: client's fetched consensus diverged from
+        the startup prediction — the flows would silently ride the wrong
+        links, so fail loudly instead."""
+        spec = self._by_client.get(client_name)
+        if spec is None:
+            raise ValueError(f"{client_name} has no device flow spec")
+        expect = spec.route_up[1:-1] if len(spec.route_up) > 2 \
+            else [spec.route_up[-1]]
+        if list(hops) != expect:
+            raise RuntimeError(
+                f"device plane: {client_name}'s runtime route {hops} != "
+                f"predicted route {expect} (the consensus diverged from "
+                "the startup prediction — e.g. a relay published late)")
 
     def is_done(self, circuit: int) -> bool:
         return circuit in self._done
@@ -643,19 +742,24 @@ def _device_wake_task(args, _unused) -> None:
 
 
 def build_plane_from_engine(engine, mode: str = "device"):
-    """Scan the engine's processes for device-mode tor clients; returns a
-    DeviceTrafficPlane or None if the workload has none."""
+    """Scan the engine's processes for device-mode clients (tor circuits
+    AND tgen star-bulk flows); returns a DeviceTrafficPlane or None if the
+    workload has none."""
     specs = []
     for hid in sorted(engine.hosts):
         host = engine.hosts[hid]
         for proc in host.processes:
-            if not str(getattr(proc, "app_path", "")).endswith("tor"):
-                continue
-            spec = parse_device_client(host.name, proc.args)
+            app = str(getattr(proc, "app_path", ""))
+            spec = None
+            if app.endswith("tor"):
+                spec = parse_device_client(host.name, proc.args)
+            elif app.endswith("tgen"):
+                spec = parse_device_tgen(host.name, proc.args)
             if spec is not None:
                 specs.append(spec)
     if not specs:
         return None
+    resolve_auto_routes(engine, specs)
     plane = DeviceTrafficPlane(engine, specs, mode=mode)
     get_logger().message(
         "device-plane",

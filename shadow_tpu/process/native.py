@@ -75,6 +75,7 @@ OP_SOCKETPAIR = 35
 OP_EVENTFD = 36
 OP_SIGNALFD = 37
 OP_KILL = 38
+OP_GETNAMEINFO = 39
 
 REQ_HDR = struct.Struct("<IIqqqq")
 RESP_HDR = struct.Struct("<IIqq")
@@ -572,6 +573,15 @@ class NativeKernel:
         return 0, struct.pack("<I", ip & 0xFFFFFFFF)
         yield  # pragma: no cover
 
+    def op_getnameinfo(self, a, b, c, d, payload):
+        """Reverse lookup (getnameinfo without NI_NUMERICHOST): ip -> the
+        simulated host's name through the engine DNS."""
+        addr = self.host.engine.dns.resolve_ip(int(a))
+        if addr is None:
+            return -errno_mod.ENOENT, b""
+        return 0, addr.name.encode()
+        yield  # pragma: no cover
+
     def op_gethostname(self, a, b, c, d, payload):
         return 0, self.api.gethostname().encode()
         yield  # pragma: no cover
@@ -653,6 +663,7 @@ class NativeKernel:
         OP_TIMERFD_SETTIME: op_timerfd_settime, OP_PIPE: op_pipe,
         OP_SOCKETPAIR: op_socketpair, OP_EVENTFD: op_eventfd,
         OP_SIGNALFD: op_signalfd, OP_KILL: op_kill,
+        OP_GETNAMEINFO: op_getnameinfo,
     }
 
 

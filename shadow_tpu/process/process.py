@@ -660,15 +660,22 @@ class SyscallAPI:
         yield None
 
     # -- device traffic plane ---------------------------------------------
-    def device_flow_start(self, cells: Optional[int] = None) -> int:
+    def device_flow_start(self, cells: Optional[int] = None,
+                          route=None) -> int:
         """Hand this host's registered bulk transfer to the device traffic
         plane (parallel/device_plane.py); returns the flow handle.  The
         flow's route/size come from the process's own config args — apps
         call this once their control-plane setup (e.g. circuit build) is
-        done, which is the moment the cells start moving on-device."""
+        done, which is the moment the cells start moving on-device.
+        ``route`` (hop host names, client-side order) cross-checks the
+        runtime path against the plane's startup prediction for auto:
+        consensus clients — a mismatch means the predicted consensus
+        diverged from the fetched one, and must fail loudly."""
         plane = getattr(self.host.engine, "device_plane", None)
         if plane is None:
             raise RuntimeError("no device traffic plane in this simulation")
+        if route is not None:
+            plane.check_route(self.host.name, list(route))
         return plane.activate(self.host.name, cells)
 
     def device_flow_join(self, circuit: int):

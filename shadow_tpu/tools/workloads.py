@@ -41,8 +41,12 @@ def two_host_echo(stoptime: int = 60) -> str:
 
 
 def star_bulk(n_clients: int = 100, stoptime: int = 600,
-              bulk_bytes: int = 10 * 1024 * 1024) -> str:
-    """Single-AS star: one big server, n clients each pulling bulk_bytes."""
+              bulk_bytes: int = 10 * 1024 * 1024,
+              device_data: bool = False) -> str:
+    """Single-AS star: one big server, n clients each pulling bulk_bytes.
+    ``device_data=True`` promotes the bulk phase to the device-resident
+    traffic plane (the tgen handshake still runs over real TCP)."""
+    dev = " device" if device_data else ""
     lines = [f'<shadow stoptime="{stoptime}">',
              '  <plugin id="tgen" path="python:tgen" />',
              '  <host id="server" bandwidthdown="1048576" bandwidthup="1048576">',
@@ -52,7 +56,7 @@ def star_bulk(n_clients: int = 100, stoptime: int = 600,
         lines.append(
             f'  <host id="client{i}" bandwidthdown="102400" bandwidthup="51200">\n'
             f'    <process plugin="tgen" starttime="2" '
-            f'arguments="client server 80 256:{bulk_bytes}" />\n'
+            f'arguments="client server 80 256:{bulk_bytes}{dev}" />\n'
             '  </host>')
     lines.append('</shadow>')
     return "\n".join(lines) + "\n"
@@ -77,8 +81,11 @@ def tor_network(n_relays: int = 1000, n_clients: Optional[int] = None,
     plane (circuit build stays on the Python control plane; the bulk
     download advances in HBM — parallel/device_plane.py).  Requires static
     paths, so it's mutually exclusive with dirauth."""
-    if device_data and dirauth:
-        raise ValueError("device_data needs static paths (dirauth=False)")
+    # dirauth + device_data now compose: the device plane predicts each
+    # auto: client's consensus path at startup from the config-determined
+    # consensus and the client's derived path stream, and the runtime
+    # cross-checks the fetched route (parallel/device_plane.py
+    # resolve_auto_routes / check_route)
     rng = np.random.default_rng(seed)
     n_clients = n_clients if n_clients is not None else max(1, n_relays)
     n_servers = n_servers if n_servers is not None else max(1, n_relays // 20)

@@ -1178,9 +1178,201 @@ static int cmd_sigdfl(void) {
   return 0;                                  /* reached = failure */
 }
 
+/* ---- rwlock / barrier / spinlock / once under contention (dual-exec: the
+ * cooperative shim must survive exactly the case that would deadlock a
+ * naive green-thread layer — a writer arriving while readers HOLD the lock
+ * across a virtual-time sleep, and four threads meeting at a barrier).
+ * Reference surface: rpth's pthread.c rwlock/barrier sections. ---- */
+
+static pthread_rwlock_t rws_lock = PTHREAD_RWLOCK_INITIALIZER;
+static pthread_barrier_t rws_barrier;
+static pthread_spinlock_t rws_spin;
+static pthread_once_t rws_once = PTHREAD_ONCE_INIT;
+static int rws_once_runs = 0;
+static long rws_shared[2] = {0, 0};   /* invariant: [0] == [1] */
+static long rws_reads_ok = 0, rws_spin_counter = 0, rws_serial_seen = 0;
+static pthread_mutex_t rws_tally = PTHREAD_MUTEX_INITIALIZER;
+
+static void rws_once_init(void) {
+  usleep(2000);                       /* init parks mid-run (racers wait) */
+  rws_once_runs++;
+}
+
+#define RWS_PHASES 6
+
+static void *rws_worker(void *argp) {
+  long id = (long)argp;
+  pthread_once(&rws_once, rws_once_init);
+  for (int phase = 0; phase < RWS_PHASES; phase++) {
+    if (id < 2) {
+      /* readers: take the lock, HOLD it across a virtual-time sleep (this
+       * is the contended case: writers arrive while we sleep holding it) */
+      pthread_rwlock_rdlock(&rws_lock);
+      long a = rws_shared[0];
+      usleep(3000);
+      long b = rws_shared[1];
+      pthread_rwlock_unlock(&rws_lock);
+      pthread_mutex_lock(&rws_tally);
+      if (a == b) rws_reads_ok++;
+      pthread_mutex_unlock(&rws_tally);
+    } else {
+      /* writers: stagger in behind the sleeping readers, then mutate both
+       * halves non-atomically with a sleep in between — a read slipping
+       * inside would observe the broken invariant */
+      usleep(1000);
+      pthread_rwlock_wrlock(&rws_lock);
+      rws_shared[0]++;
+      usleep(2000);
+      rws_shared[1]++;
+      pthread_rwlock_unlock(&rws_lock);
+    }
+    /* spin-guarded tally crossing the phase */
+    pthread_spin_lock(&rws_spin);
+    rws_spin_counter++;
+    pthread_spin_unlock(&rws_spin);
+    /* all four meet; exactly one gets PTHREAD_BARRIER_SERIAL_THREAD */
+    int r = pthread_barrier_wait(&rws_barrier);
+    if (r == PTHREAD_BARRIER_SERIAL_THREAD) {
+      pthread_mutex_lock(&rws_tally);
+      rws_serial_seen++;
+      pthread_mutex_unlock(&rws_tally);
+    } else if (r != 0) {
+      return (void *)1L;
+    }
+  }
+  return (void *)0L;
+}
+
+static int cmd_rwsync(void) {
+  if (pthread_barrier_init(&rws_barrier, NULL, 4) != 0) return 1;
+  if (pthread_spin_init(&rws_spin, PTHREAD_PROCESS_PRIVATE) != 0) return 2;
+  /* trylock surface: uncontended succeeds, then conflicts report EBUSY */
+  if (pthread_rwlock_tryrdlock(&rws_lock) != 0) return 3;
+  if (pthread_rwlock_trywrlock(&rws_lock) != EBUSY) return 4;
+  pthread_rwlock_unlock(&rws_lock);
+  if (pthread_rwlock_trywrlock(&rws_lock) != 0) return 5;
+  if (pthread_rwlock_tryrdlock(&rws_lock) != EBUSY) return 6;
+  pthread_rwlock_unlock(&rws_lock);
+  pthread_t th[4];
+  for (long i = 0; i < 4; i++)
+    if (pthread_create(&th[i], NULL, rws_worker, (void *)i) != 0) return 7;
+  long bad = 0;
+  for (int i = 0; i < 4; i++) {
+    void *rv = NULL;
+    if (pthread_join(th[i], &rv) != 0) return 8;
+    bad += (long)rv;
+  }
+  if (bad) return 9;
+  if (rws_once_runs != 1) return 10;
+  if (rws_reads_ok != 2L * RWS_PHASES) {
+    printf("rwsync: only %ld/%d consistent reads\n", rws_reads_ok,
+           2 * RWS_PHASES);
+    return 11;
+  }
+  if (rws_shared[0] != 2L * RWS_PHASES || rws_shared[1] != rws_shared[0])
+    return 12;
+  if (rws_spin_counter != 4L * RWS_PHASES) return 13;
+  if (rws_serial_seen != RWS_PHASES) {
+    printf("rwsync: %ld serial threads over %d phases\n", rws_serial_seen,
+           RWS_PHASES);
+    return 14;
+  }
+  if (pthread_barrier_destroy(&rws_barrier) != 0) return 15;
+  if (pthread_spin_destroy(&rws_spin) != 0) return 16;
+  printf("rwsync OK writes=%ld reads_ok=%ld spins=%ld\n", rws_shared[0],
+         rws_reads_ok, rws_spin_counter);
+  return 0;
+}
+
+/* ---- ppoll/pselect + reentrant resolver family (dual-exec; reference
+ * preload_defs.h carries ppoll/pselect/gethostbyname_r/gethostbyname2_r/
+ * getnameinfo — libevent-based apps like Tor reach all of them) ---- */
+#include <netdb.h>
+
+static int cmd_resolvers(const char *expected_host) {
+  /* gethostbyname_r of our own name (in-sim: the engine DNS) */
+  struct hostent he, *result = NULL;
+  char buf[1024];
+  int herr = 0;
+  char self_name[256];
+  if (gethostname(self_name, sizeof self_name) != 0) return 1;
+  if (gethostbyname_r(self_name, &he, buf, sizeof buf, &result, &herr) != 0
+      || result == NULL)
+    return 2;
+  if (result->h_addrtype != AF_INET || result->h_length != 4) return 3;
+  uint32_t ip_net;
+  memcpy(&ip_net, result->h_addr_list[0], 4);
+  if (ip_net == 0) return 4;
+  struct hostent he2, *result2 = NULL;
+  char buf2[1024];
+  if (gethostbyname2_r(self_name, AF_INET, &he2, buf2, sizeof buf2,
+                       &result2, &herr) != 0 || result2 == NULL)
+    return 5;
+  /* ERANGE on a too-small buffer */
+  char tiny[8];
+  struct hostent he3, *result3 = NULL;
+  if (gethostbyname_r(self_name, &he3, tiny, sizeof tiny, &result3,
+                      &herr) != ERANGE)
+    return 6;
+  /* getnameinfo: reverse of our own address must produce our hostname
+   * in-sim (the engine DNS holds the reverse map); numeric form always */
+  struct sockaddr_in sin;
+  memset(&sin, 0, sizeof sin);
+  sin.sin_family = AF_INET;
+  sin.sin_addr.s_addr = ip_net;
+  sin.sin_port = htons(1234);
+  char hostbuf[256], servbuf[32];
+  if (getnameinfo((struct sockaddr *)&sin, sizeof sin, hostbuf,
+                  sizeof hostbuf, servbuf, sizeof servbuf, 0) != 0)
+    return 7;
+  if (under_sim() && strcmp(hostbuf, expected_host) != 0) {
+    printf("getnameinfo: %s != %s\n", hostbuf, expected_host);
+    return 8;
+  }
+  if (strcmp(servbuf, "1234") != 0) return 9;
+  if (getnameinfo((struct sockaddr *)&sin, sizeof sin, hostbuf,
+                  sizeof hostbuf, NULL, 0, NI_NUMERICHOST) != 0)
+    return 10;
+  if (strchr(hostbuf, '.') == NULL) return 11;   /* dotted quad */
+
+  /* ppoll/pselect over a sim socketpair: writable immediately; readable
+   * only after data; a ppoll with a timeout must consume VIRTUAL time */
+  int sv[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, sv) != 0) return 12;
+  struct pollfd pf = {sv[0], POLLIN, 0};
+  struct timespec ts = {0, 200 * 1000000};   /* 200 ms */
+  int64_t t0 = now_ns();
+  int r = ppoll(&pf, 1, &ts, NULL);
+  int64_t waited = now_ns() - t0;
+  if (r != 0) return 13;                     /* nothing readable yet */
+  if (under_sim() && waited < 150 * 1000000LL) return 14;
+  if (send(sv[1], "x", 1, 0) != 1) return 15;
+  pf.revents = 0;
+  if (ppoll(&pf, 1, NULL, NULL) != 1 || !(pf.revents & POLLIN)) return 16;
+  char c;
+  if (recv(sv[0], &c, 1, 0) != 1 || c != 'x') return 17;
+  /* pselect: write side writable; read side not readable */
+  fd_set rfds, wfds;
+  FD_ZERO(&rfds);
+  FD_ZERO(&wfds);
+  FD_SET(sv[0], &rfds);
+  FD_SET(sv[1], &wfds);
+  struct timespec pts = {0, 50 * 1000000};
+  int n = pselect((sv[0] > sv[1] ? sv[0] : sv[1]) + 1, &rfds, &wfds, NULL,
+                  &pts, NULL);
+  if (n != 1 || FD_ISSET(sv[0], &rfds) || !FD_ISSET(sv[1], &wfds))
+    return 18;
+  close(sv[0]);
+  close(sv[1]);
+  printf("resolvers OK host=%s\n", hostbuf);
+  return 0;
+}
+
 int main(int argc, char **argv) {
   if (argc < 2) return 64;
   const char *cmd = argv[1];
+  if (!strcmp(cmd, "rwsync")) return cmd_rwsync();
+  if (!strcmp(cmd, "resolvers") && argc >= 3) return cmd_resolvers(argv[2]);
   if (!strcmp(cmd, "efdsem")) return cmd_efdsem();
   if (!strcmp(cmd, "sighandler")) return cmd_sighandler();
   if (!strcmp(cmd, "sigdfl")) return cmd_sigdfl();
