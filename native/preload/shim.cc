@@ -1432,14 +1432,20 @@ extern "C" int getnameinfo(const struct sockaddr *sa, socklen_t salen,
         return EAI_NONAME;
       }
     }
+    int need;
     if (have_name)
-      snprintf(host, hostlen, "%s", namebuf);
+      need = snprintf(host, hostlen, "%s", namebuf);
     else
-      snprintf(host, hostlen, "%u.%u.%u.%u", (ip >> 24) & 255,
-               (ip >> 16) & 255, (ip >> 8) & 255, ip & 255);
+      need = snprintf(host, hostlen, "%u.%u.%u.%u", (ip >> 24) & 255,
+                      (ip >> 16) & 255, (ip >> 8) & 255, ip & 255);
+    if (need < 0 || (socklen_t)need >= hostlen)
+      return EAI_OVERFLOW;   /* glibc: truncation is an error, not silent */
   }
-  if (serv && servlen)
-    snprintf(serv, servlen, "%u", (unsigned)ntohs(sin->sin_port));
+  if (serv && servlen) {
+    int need = snprintf(serv, servlen, "%u",
+                        (unsigned)ntohs(sin->sin_port));
+    if (need < 0 || (socklen_t)need >= servlen) return EAI_OVERFLOW;
+  }
   return 0;
 }
 
@@ -1460,9 +1466,11 @@ extern "C" int ppoll(struct pollfd *fds, nfds_t nfds,
     return real_fn(fds, nfds, tmo_p, sigmask);
   }
   int timeout_ms = -1;
-  if (tmo_p)
-    timeout_ms = (int)(tmo_p->tv_sec * 1000 +
-                       (tmo_p->tv_nsec + 999999) / 1000000);
+  if (tmo_p) {
+    long long ms = (long long)tmo_p->tv_sec * 1000 +
+                   (tmo_p->tv_nsec + 999999) / 1000000;
+    timeout_ms = ms > 0x7FFFFFFF ? 0x7FFFFFFF : (int)ms;  /* no wrap to <0 */
+  }
   return poll(fds, nfds, timeout_ms);
 }
 

@@ -584,10 +584,14 @@ static gt_rwlock_state &rwlock_state(const void *rw) {
 static void rwlock_wake_all(const void *rw) {
   for (int i = 0; i < g_nthreads; i++) {
     gt_thread *t = g_threads[i];
-    if (t && t->state == GT_BLOCKED && t->wait_kind == W_RWLOCK &&
+    if (t && t->state == GT_BLOCKED &&
+        (t->wait_kind == W_RWLOCK || t->wait_kind == W_SLEEP) &&
         t->wait_obj == rw) {
+      /* W_SLEEP with a rwlock wait_obj = a timed variant's deadline park
+       * (same dual-wake pattern cond_timedwait uses) */
       t->state = GT_RUNNABLE;
       t->wait_kind = W_NONE;
+      t->deadline_fired = 0;
     }
   }
 }
@@ -662,6 +666,56 @@ extern "C" int pthread_rwlock_unlock(pthread_rwlock_t *rw) {
   if (st.writer == g_current->tid) st.writer = -1;
   else if (st.readers > 0) st.readers--;
   rwlock_wake_all(rw);
+  return 0;
+}
+
+/* timed variants: MUST be interposed too — falling through to glibc would
+ * lock the REAL object, which the interposed calls never touch, silently
+ * breaking mutual exclusion with them.  The park carries the deadline as a
+ * W_SLEEP with the rwlock as wait_obj (woken by unlock OR expiry). */
+static int rwlock_timed_park(const void *rw, const struct timespec *abstime) {
+  extern int64_t shd_epoch_ns(void);
+  int64_t deadline = (int64_t)abstime->tv_sec * 1000000000LL +
+                     abstime->tv_nsec - shd_epoch_ns();
+  if (shd_vtime_ns() >= deadline) return ETIMEDOUT;
+  g_current->state = GT_BLOCKED;
+  g_current->wait_kind = W_SLEEP;
+  g_current->wait_obj = rw;
+  g_current->wait_deadline = deadline;
+  g_current->deadline_fired = 0;
+  gt_switch_to_scheduler();
+  return g_current->deadline_fired ? ETIMEDOUT : 0;
+}
+
+extern "C" int pthread_rwlock_timedrdlock(pthread_rwlock_t *rw,
+                                          const struct timespec *abstime) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_rwlock_t *, const struct timespec *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_rwlock_timedrdlock");
+    return real_fn(rw, abstime);
+  }
+  gt_rwlock_state &st = rwlock_state(rw);
+  while (st.writer != -1) {
+    if (rwlock_timed_park(rw, abstime) == ETIMEDOUT) return ETIMEDOUT;
+  }
+  st.readers++;
+  return 0;
+}
+
+extern "C" int pthread_rwlock_timedwrlock(pthread_rwlock_t *rw,
+                                          const struct timespec *abstime) {
+  if (!g_engaged) {
+    static int (*real_fn)(pthread_rwlock_t *, const struct timespec *);
+    if (!real_fn)
+      *(void **)(&real_fn) = dlsym(RTLD_NEXT, "pthread_rwlock_timedwrlock");
+    return real_fn(rw, abstime);
+  }
+  gt_rwlock_state &st = rwlock_state(rw);
+  while (st.writer != -1 || st.readers > 0) {
+    if (rwlock_timed_park(rw, abstime) == ETIMEDOUT) return ETIMEDOUT;
+  }
+  st.writer = g_current->tid;
   return 0;
 }
 

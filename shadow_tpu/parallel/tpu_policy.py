@@ -97,13 +97,19 @@ class _TPUBatchMixin:
         src_host = worker.active_host
         seq_owner = src_host if src_host is not None else dst_host
         seq = seq_owner.next_event_sequence()
-        with self._batch_lock:
-            self._p_rows.append(
-                (packet, src_host, dst_host, seq,
-                 src_host.topo_row if src_host is not None
-                 else dst_host.topo_row,
-                 dst_host.topo_row, packet.uid, worker.now))
+        row = (packet, src_host, dst_host, seq,
+               src_host.topo_row if src_host is not None
+               else dst_host.topo_row,
+               dst_host.topo_row, packet.uid, worker.now)
+        if self.serial:
+            # workers == 0: the lock is pure overhead on the hottest
+            # capture path (the CPU-time gate's margin lives here)
+            self._p_rows.append(row)
             n = len(self._p_rows)
+        else:
+            with self._batch_lock:
+                self._p_rows.append(row)
+                n = len(self._p_rows)
         self.packets_batched += 1
         if self._chunk is None:
             self._chunk = getattr(engine.options, "tpu_chunk", 0)

@@ -78,9 +78,10 @@ def tor_network(n_relays: int = 1000, n_clients: Optional[int] = None,
     (instead of config-assigned ones) — real Tor's startup behavior.
 
     ``device_data=True`` marks every client for the device-resident traffic
-    plane (circuit build stays on the Python control plane; the bulk
-    download advances in HBM — parallel/device_plane.py).  Requires static
-    paths, so it's mutually exclusive with dirauth."""
+    plane (circuit build stays on the simulated control plane; the bulk
+    download advances in HBM — parallel/device_plane.py).  Composes with
+    ``dirauth=True``: auto: consensus paths are predicted at startup and
+    cross-checked at runtime (resolve_auto_routes/check_route)."""
     # dirauth + device_data now compose: the device plane predicts each
     # auto: client's consensus path at startup from the config-determined
     # consensus and the client's derived path stream, and the runtime

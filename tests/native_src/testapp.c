@@ -885,6 +885,14 @@ static struct {
   long served;      /* completed connections (mu-protected) */
 } g_pool;
 
+/* the daemon's "consensus": version/checksum pair guarded by a rwlock the
+ * way real tor's tor_rwlock guards its routerlist — workers HOLD the read
+ * lock across cell echoes (which park on fd I/O), the main loop write-locks
+ * on every heartbeat tick, so the lock is genuinely contended across parks */
+static pthread_rwlock_t g_cons_lock = PTHREAD_RWLOCK_INITIALIZER;
+static struct { long version; long checksum; } g_cons;
+static long g_cons_reads = 0;   /* mu-protected tally */
+
 static void *tor_worker(void *arg) {
   (void)arg;
   char cell[TOR_CELL];
@@ -900,8 +908,12 @@ static void *tor_worker(void *arg) {
     g_pool.head++;
     pthread_mutex_unlock(&g_pool.mu);
 
-    int quit = 0;
+    int quit = 0, broken = 0;
     for (;;) {
+      /* daemon-realistic read timeout via ppoll (preload ppoll surface) */
+      struct pollfd pf = {fd, POLLIN, 0};
+      struct timespec ts = {25, 0};
+      if (ppoll(&pf, 1, &ts, NULL) <= 0) goto conn_done;
       size_t got = 0;
       while (got < TOR_CELL) {
         ssize_t r = recv(fd, cell + got, TOR_CELL - got, 0);
@@ -911,17 +923,29 @@ static void *tor_worker(void *arg) {
       uint32_t type;
       memcpy(&type, cell, 4);
       if (type == TOR_QUIT) { quit = 1; goto conn_done; }
+      /* consult the consensus under rdlock and HOLD it across the echo
+       * (the send can park): a torn version/checksum pair would mean the
+       * rwlock failed to exclude the heartbeat's write */
+      pthread_rwlock_rdlock(&g_cons_lock);
+      long v0 = g_cons.version, c0 = g_cons.checksum;
       size_t sent = 0;          /* echo the cell (relay hop) */
       while (sent < TOR_CELL) {
         ssize_t w = send(fd, cell + sent, TOR_CELL - sent, 0);
-        if (w <= 0) goto conn_done;
+        if (w <= 0) break;
         sent += (size_t)w;
       }
+      long v1 = g_cons.version, c1 = g_cons.checksum;
+      pthread_rwlock_unlock(&g_cons_lock);
+      if (c0 != v0 * 7 || v1 != v0 || c1 != c0) broken = 1;
+      pthread_mutex_lock(&g_pool.mu);
+      g_cons_reads++;
+      pthread_mutex_unlock(&g_pool.mu);
+      if (sent < TOR_CELL || broken) goto conn_done;
     }
   conn_done:
     close(fd);
     pthread_mutex_lock(&g_pool.mu);
-    g_pool.served++;
+    g_pool.served += broken ? 0 : 1;   /* a torn read fails the audit */
     pthread_mutex_unlock(&g_pool.mu);
     uint64_t one = 1;           /* wake the event loop */
     if (write(g_pool.efd, &one, 8) != 8) return NULL;
@@ -1000,6 +1024,12 @@ static int cmd_torserver(uint16_t port, int nworkers, long expect_conns) {
       } else if (fd == tfd) {
         uint64_t exp;
         if (read(tfd, &exp, 8) == 8) ticks += (long)exp;
+        /* heartbeat publishes a new consensus under the WRITE lock while
+         * workers may be holding read locks across parked echoes */
+        pthread_rwlock_wrlock(&g_cons_lock);
+        g_cons.version++;
+        g_cons.checksum = g_cons.version * 7;
+        pthread_rwlock_unlock(&g_cons_lock);
       } else if (fd == sfd) {
         struct signalfd_siginfo si;
         if (read(sfd, &si, sizeof si) != sizeof si) return 19;
