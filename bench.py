@@ -607,7 +607,7 @@ def main() -> None:
             sims["tor200_serial_python"]["sim_sec_per_wall_sec"],
         "tor200_native_vs_python_serial":
             sims.get("tor200_native_vs_python_serial"),
-        "tor200_tpu": tor200,
+        "tor200_tpu": sims["tor200_tpu"]["sim_sec_per_wall_sec"],
         "tor200_device_plane":
             sims.get("tor200_device_plane", {}).get("sim_sec_per_wall_sec"),
         "tor200_gate_pass": sims.get("tor200_gate_pass"),

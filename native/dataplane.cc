@@ -625,6 +625,7 @@ struct HostS {
   int32_t id = 0;
   int64_t ip = 0;       // default (eth) address
   int64_t lo_ip = 0;    // LOCALHOST
+  bool owned = true;    // this engine executes its events (--processes)
   int32_t topo_row = 0;
   Iface lo, eth;
   // deterministic counters (mirror host/host.py)
@@ -708,6 +709,7 @@ struct Plane {
   std::vector<HostS *> *hosts;                    // index = hid (dense)
   std::unordered_map<int64_t, int32_t> *ip2host;  // eth ip -> hid
   PyObject *cb;             // status/lifecycle callback into Python
+  PyObject *xshard_cb;      // cross-shard outbox callback (--processes)
   PyObject *lat_arr;        // borrowed refs kept alive: numpy arrays
   PyObject *rel_arr;
   PyObject *cnt_arr;
@@ -1855,6 +1857,48 @@ bool iface_on_refill(Plane *pl, Iface *f) {
 }
 
 // ---- the inter-host hop (core/worker.py send_packet) -----------------------
+// cross-shard ship (--processes): build the python wire tuple (the EXACT
+// Packet.to_wire format) and hand it to the outbox callback
+bool plane_xshard_send(Plane *pl, HostS *dst_host, int64_t t, Pkt *p) {
+  if (t >= pl->end_time) { delete p; return true; }
+  HostS *src = pl->H(pl->active_host);
+  int64_t seq = src->next_event_sequence();
+  pl->events_scheduled++;   // mirrors worker.counters.count_new("event")
+  PyObject *sacks = PyTuple_New(p->nsack);
+  if (!sacks) { delete p; return false; }
+  for (int i = 0; i < p->nsack; i++)
+    PyTuple_SET_ITEM(sacks, i,
+                     Py_BuildValue("(LL)", (long long)p->sack[i][0],
+                                   (long long)p->sack[i][1]));
+  PyObject *hdr;
+  if (p->is_tcp)
+    hdr = Py_BuildValue("(sLLLLLLLLNLL)", "t", (long long)p->src_ip,
+                        (long long)p->src_port, (long long)p->dst_ip,
+                        (long long)p->dst_port, (long long)p->flags,
+                        (long long)p->seq, (long long)p->ack,
+                        (long long)p->window, sacks, (long long)p->ts,
+                        (long long)p->ts_echo);
+  else {
+    Py_DECREF(sacks);
+    hdr = Py_BuildValue("(sLLLL)", "u", (long long)p->src_ip,
+                        (long long)p->src_port, (long long)p->dst_ip,
+                        (long long)p->dst_port);
+  }
+  if (!hdr) { delete p; return false; }
+  PyObject *wire = Py_BuildValue(
+      "(LLNy#i())", (long long)p->uid, (long long)p->priority, hdr,
+      p->payload.data(), (Py_ssize_t)p->payload.size(),
+      p->retransmit ? 1 : 0);
+  if (!wire) { delete p; return false; }
+  PyObject *r = PyObject_CallFunction(
+      pl->xshard_cb, "LLLiLN", (long long)t, (long long)dst_host->id,
+      (long long)src->id, 0 /*unused*/, (long long)seq, wire);
+  delete p;
+  if (!r) return false;
+  Py_DECREF(r);
+  return true;
+}
+
 bool plane_send_packet(Plane *pl, Pkt *p) {
   int64_t src_row = -1, dst_row = -1;
   {
@@ -1884,6 +1928,12 @@ bool plane_send_packet(Plane *pl, Pkt *p) {
   // latency_ns_ip: lookup + per-path packet count (topology.py:394-398)
   pl->path_counts[src_row * pl->A + dst_row] += 1;
   int64_t latency = pl->lat[src_row * pl->A + dst_row];
+  if (!dst_host->owned) {
+    // --processes shard boundary: claim the seq exactly where the local
+    // path would, then ship the finished hop to the owner shard
+    // (core/worker.py:129-141)
+    return plane_xshard_send(pl, dst_host, pl->now + latency, p);
+  }
   // INET_SENT; schedule the delivery on the destination host
   plane_schedule(pl, EV_DELIVER, latency, dst_host->id, 0, 0, p);
   return true;
@@ -2034,6 +2084,7 @@ PyObject *Plane_py_new(PyTypeObject *type, PyObject *, PyObject *) {
   pl->hosts = new std::vector<HostS *>();
   pl->ip2host = new std::unordered_map<int64_t, int32_t>();
   pl->cb = nullptr;
+  pl->xshard_cb = nullptr;
   pl->lat_arr = pl->rel_arr = pl->cnt_arr = nullptr;
   pl->lat = nullptr;
   pl->rel = nullptr;
@@ -2064,6 +2115,7 @@ void Plane_dealloc(PyObject *self) {
   delete pl->hosts;
   delete pl->ip2host;
   Py_XDECREF(pl->cb);
+  Py_XDECREF(pl->xshard_cb);
   Py_XDECREF(pl->lat_arr);
   Py_XDECREF(pl->rel_arr);
   Py_XDECREF(pl->cnt_arr);
@@ -2108,6 +2160,90 @@ PyObject *Plane_set_callback(PyObject *self, PyObject *cb) {
   Py_RETURN_NONE;
 }
 
+PyObject *Plane_set_xshard_callback(PyObject *self, PyObject *cb) {
+  Plane *pl = SELF;
+  Py_INCREF(cb);
+  Py_XDECREF(pl->xshard_cb);
+  pl->xshard_cb = cb;
+  Py_RETURN_NONE;
+}
+
+// push_deliver(t, dst_hid, src_hid, seq, wire) — ingest a finished hop
+// shipped from another shard (parallel/procs.py inbox): allocates the
+// packet from the EXACT Packet.to_wire tuple and pushes the delivery event
+// with the sender-claimed identity.  No event-scheduled count: the sender's
+// engine counted it (the owner only counts the free at execution).
+PyObject *Plane_push_deliver(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long t, dst_hid, src_hid, seq;
+  PyObject *wire;
+  if (!PyArg_ParseTuple(args, "LLLLO", &t, &dst_hid, &src_hid, &seq, &wire))
+    return nullptr;
+  PyObject *hdr = PyTuple_GetItem(wire, 2);
+  if (!hdr) return nullptr;
+  const char *kind = PyUnicode_AsUTF8(PyTuple_GetItem(hdr, 0));
+  if (!kind) return nullptr;
+  Pkt *p = new Pkt();
+  p->uid = PyLong_AsLongLong(PyTuple_GetItem(wire, 0));
+  p->priority = PyLong_AsLongLong(PyTuple_GetItem(wire, 1));
+  {
+    char *buf = nullptr;
+    Py_ssize_t blen = 0;
+    if (PyBytes_AsStringAndSize(PyTuple_GetItem(wire, 3), &buf, &blen) < 0) {
+      delete p;
+      return nullptr;
+    }
+    p->payload.assign(buf, (size_t)blen);
+  }
+  p->retransmit = PyObject_IsTrue(PyTuple_GetItem(wire, 4)) ? 1 : 0;
+  p->src_ip = PyLong_AsLongLong(PyTuple_GetItem(hdr, 1));
+  p->src_port = (int32_t)PyLong_AsLongLong(PyTuple_GetItem(hdr, 2));
+  p->dst_ip = PyLong_AsLongLong(PyTuple_GetItem(hdr, 3));
+  p->dst_port = (int32_t)PyLong_AsLongLong(PyTuple_GetItem(hdr, 4));
+  if (kind[0] == 't') {
+    p->is_tcp = 1;
+    p->header_size = HDR_TCP;
+    p->flags = (uint8_t)PyLong_AsLongLong(PyTuple_GetItem(hdr, 5));
+    p->seq = PyLong_AsLongLong(PyTuple_GetItem(hdr, 6));
+    p->ack = PyLong_AsLongLong(PyTuple_GetItem(hdr, 7));
+    p->window = PyLong_AsLongLong(PyTuple_GetItem(hdr, 8));
+    PyObject *sacks = PyTuple_GetItem(hdr, 9);
+    Py_ssize_t ns = PySequence_Length(sacks);
+    p->nsack = (int)(ns > MAX_SACK_BLOCKS ? MAX_SACK_BLOCKS : ns);
+    for (int i = 0; i < p->nsack; i++) {
+      PyObject *blk = PySequence_GetItem(sacks, i);   // new ref
+      PyObject *b0 = blk ? PySequence_GetItem(blk, 0) : nullptr;
+      PyObject *b1 = blk ? PySequence_GetItem(blk, 1) : nullptr;
+      p->sack[i][0] = b0 ? PyLong_AsLongLong(b0) : 0;
+      p->sack[i][1] = b1 ? PyLong_AsLongLong(b1) : 0;
+      Py_XDECREF(b0);
+      Py_XDECREF(b1);
+      Py_XDECREF(blk);
+    }
+    p->ts = PyLong_AsLongLong(PyTuple_GetItem(hdr, 10));
+    p->ts_echo = PyLong_AsLongLong(PyTuple_GetItem(hdr, 11));
+  } else {
+    p->is_tcp = 0;
+    p->header_size = HDR_UDP;
+  }
+  if (PyErr_Occurred()) {
+    delete p;
+    return nullptr;
+  }
+  Ev ev;
+  ev.time = t;
+  ev.dst = (int32_t)dst_hid;
+  ev.src = (int32_t)src_hid;
+  ev.seq = seq;
+  ev.type = EV_DELIVER;
+  ev.pkt = p;
+  // the push clamp (still this round's barrier) matches what the serial
+  // run applied when the hop was scheduled (procs.py:132-134)
+  plane_push_ev(pl, ev);
+  pl->events_scheduled--;   // plane_push_ev counted; the sender already did
+  Py_RETURN_NONE;
+}
+
 PyObject *Plane_set_window(PyObject *self, PyObject *arg) {
   SELF->window_end = PyLong_AsLongLong(arg);
   if (PyErr_Occurred()) return nullptr;
@@ -2117,18 +2253,18 @@ PyObject *Plane_set_window(PyObject *self, PyObject *arg) {
 // add_host(hid, ip, lo_ip, topo_row, bw_down, bw_up, qdisc_rr, router_kind,
 //          recv_buf, send_buf, autotune_recv, autotune_send,
 //          next_handle, next_port, event_seq, packet_counter,
-//          packet_priority)
+//          packet_priority, owned)
 PyObject *Plane_add_host(PyObject *self, PyObject *args) {
   Plane *pl = SELF;
   long long hid, ip, lo_ip, topo_row, bw_down, bw_up, recv_buf, send_buf;
   long long next_handle, next_port, event_seq, packet_counter,
       packet_priority;
-  int qdisc_rr, router_kind, at_recv, at_send;
-  if (!PyArg_ParseTuple(args, "LLLLLLiiLLiiLLLLL", &hid, &ip, &lo_ip,
+  int qdisc_rr, router_kind, at_recv, at_send, owned = 1;
+  if (!PyArg_ParseTuple(args, "LLLLLLiiLLiiLLLLL|i", &hid, &ip, &lo_ip,
                         &topo_row, &bw_down, &bw_up, &qdisc_rr, &router_kind,
                         &recv_buf, &send_buf, &at_recv, &at_send,
                         &next_handle, &next_port, &event_seq,
-                        &packet_counter, &packet_priority))
+                        &packet_counter, &packet_priority, &owned))
     return nullptr;
   if ((size_t)hid >= pl->hosts->size()) pl->hosts->resize(hid + 1, nullptr);
   HostS *h = new HostS();
@@ -2136,6 +2272,7 @@ PyObject *Plane_add_host(PyObject *self, PyObject *args) {
   h->id = (int32_t)hid;
   h->ip = ip;
   h->lo_ip = lo_ip;
+  h->owned = owned != 0;
   h->topo_row = (int32_t)topo_row;
   h->recv_buf_size = recv_buf;
   h->send_buf_size = send_buf;
@@ -2601,6 +2738,8 @@ PyObject *Plane_lower_limit(PyObject *self, PyObject *args) {
 PyMethodDef Plane_methods[] = {
     {"configure", Plane_configure, METH_VARARGS, nullptr},
     {"set_callback", Plane_set_callback, METH_O, nullptr},
+    {"set_xshard_callback", Plane_set_xshard_callback, METH_O, nullptr},
+    {"push_deliver", Plane_push_deliver, METH_VARARGS, nullptr},
     {"set_window", Plane_set_window, METH_O, nullptr},
     {"add_host", Plane_add_host, METH_VARARGS, nullptr},
     {"next_seq", Plane_next_seq, METH_O, nullptr},

@@ -209,6 +209,7 @@ extern "C" void gt_park_fd(int64_t handle, short ev) {
   gt_thread *t = g_current;
   t->state = GT_BLOCKED;
   t->wait_kind = W_FD;
+  t->wait_obj = NULL;
   t->wait_handles[0] = handle;
   t->wait_events[0] = ev;
   t->wait_nfds = 1;
@@ -222,6 +223,7 @@ extern "C" int gt_park_fd_deadline(int64_t handle, short ev,
   gt_thread *t = g_current;
   t->state = GT_BLOCKED;
   t->wait_kind = W_FD;
+  t->wait_obj = NULL;
   t->wait_handles[0] = handle;
   t->wait_events[0] = ev;
   t->wait_nfds = 1;
@@ -237,6 +239,7 @@ extern "C" void gt_park_fds(const int64_t *handles, const short *events,
   if (n > GT_MAX_WAIT_FDS) n = GT_MAX_WAIT_FDS;
   t->state = GT_BLOCKED;
   t->wait_kind = W_FD;
+  t->wait_obj = NULL;
   for (int i = 0; i < n; i++) {
     t->wait_handles[i] = handles[i];
     t->wait_events[i] = events[i];
@@ -251,6 +254,7 @@ extern "C" void gt_park_sleep(int64_t deadline_ns) {
   gt_thread *t = g_current;
   t->state = GT_BLOCKED;
   t->wait_kind = W_SLEEP;
+  t->wait_obj = NULL;
   t->wait_nfds = 0;
   t->wait_deadline = deadline_ns;
   t->deadline_fired = 0;

@@ -308,6 +308,17 @@ class NativePlane:
             int(getattr(opts, "tcp_windows", 10)),
             lat, rel, cnt)
         self.c.set_callback(self._callback)
+        if engine.shard_count > 1:
+            # --processes: finished cross-shard hops land in the engine's
+            # outboxes exactly where the Python plane appends them
+            # (core/worker.py:129-141); the unused slot keeps the C
+            # signature uniform
+            def _xshard(t, dst_hid, src_hid, _unused, seq, wire,
+                        _eng=engine):
+                dst = _eng.hosts[dst_hid]
+                _eng.shard_outboxes[_eng.shard_of(dst)].append(
+                    (t, dst_hid, src_hid, seq, wire))
+            self.c.set_xshard_callback(_xshard)
         self._attach_hosts()
 
     # -- host registration + counter proxying -----------------------------
@@ -325,7 +336,8 @@ class NativePlane:
                 1 if p.autotune_recv else 0, 1 if p.autotune_send else 0,
                 int(host._next_handle), int(host._next_port),
                 int(host._event_seq), int(host._packet_counter),
-                int(host._packet_priority))
+                int(host._packet_priority),
+                1 if eng.owns_host(host) else 0)
             # the per-host deterministic counters move into C so both
             # planes draw from the same sequence space, interleaved exactly
             host.native_plane = self
@@ -435,8 +447,6 @@ def eligible(engine, log_reason: bool = False) -> Optional[str]:
     if engine.scheduler.policy_name != "global":
         return (f"policy {engine.scheduler.policy_name!r} "
                 "(native plane backs the serial global policy)")
-    if engine.shard_count > 1:
-        return "--processes sharding"
     for host in engine.hosts.values():
         if host.params.log_pcap:
             return "pcap capture enabled"

@@ -118,11 +118,21 @@ def _shard_body(conn, options, config) -> None:
             scheduler.window_start = ws
             scheduler.window_end = we
             worker.round_end = we
+            if engine.native_plane is not None:
+                engine.native_plane.set_window(we)
             worker.run_round()
             engine._flush_round()
             conn.send(("out", engine.drain_outboxes()))
             inbox = conn.recv()[1]
             for t, dst_id, src_id, seq, wire in inbox:
+                if engine.native_plane is not None:
+                    # C-plane shard: the hop lands straight in the C event
+                    # heap (all TCP/UDP sockets live there); same clamp,
+                    # same sender-claimed identity
+                    engine.native_plane.c.push_deliver(int(t), int(dst_id),
+                                                       int(src_id),
+                                                       int(seq), wire)
+                    continue
                 dst_host = hosts_by_id[dst_id]
                 src_host = hosts_by_id[src_id]
                 pkt = Packet.from_wire(wire)
@@ -145,6 +155,17 @@ def _shard_body(conn, options, config) -> None:
         set_current_worker(None)
 
     events = worker.counters._free.get("event", 0)
+    if engine.native_plane is not None:
+        # fold the C plane's event lifecycle into this shard's totals
+        # (mirrors Engine._run_serial's accounting)
+        sched, execd, drops, _last = engine.native_plane.counters()
+        events += execd
+        worker.counters.count_new("event", sched)
+        worker.counters.count_free("event", execd)
+        if drops:
+            worker.counters.count_new("packet_drop", drops)
+        for host in engine.hosts.values():
+            engine.native_plane.sync_tracker(host.id, host.tracker)
     worker.finish()
     host_states = {hid: _host_state(h) for hid, h in hosts_by_id.items()
                    if engine.owns_host(h)}

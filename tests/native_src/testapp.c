@@ -910,10 +910,12 @@ static void *tor_worker(void *arg) {
 
     int quit = 0, broken = 0;
     for (;;) {
-      /* daemon-realistic read timeout via ppoll (preload ppoll surface) */
+      /* daemon-realistic read timeout via ppoll (preload ppoll surface);
+       * a timeout means the transfer HUNG — that must fail the served
+       * audit, not silently count as a completed connection */
       struct pollfd pf = {fd, POLLIN, 0};
       struct timespec ts = {25, 0};
-      if (ppoll(&pf, 1, &ts, NULL) <= 0) goto conn_done;
+      if (ppoll(&pf, 1, &ts, NULL) <= 0) { broken = 1; goto conn_done; }
       size_t got = 0;
       while (got < TOR_CELL) {
         ssize_t r = recv(fd, cell + got, TOR_CELL - got, 0);
@@ -1047,6 +1049,9 @@ static int cmd_torserver(uint16_t port, int nworkers, long expect_conns) {
   if (g_pool.served < expect_conns + 1) return 21;  /* +1 = the QUIT conn */
   if (wakeups < expect_conns) return 22;
   if (ticks < 1) return 23;
+  /* the rwlock audit only means something if reads actually happened:
+   * every data connection consults the consensus at least once per cell */
+  if (g_cons_reads < expect_conns) return 24;
   return 0;
 }
 

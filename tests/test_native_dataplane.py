@@ -150,6 +150,28 @@ def test_native_wrapper_errors():
         b.bind_native(host.ip, 5555, False)
 
 
+def test_native_shards_match_serial_native():
+    """--processes with C-plane shards: every shard runs the native data
+    plane (cross-shard hops ship through the C outbox callback and land in
+    the owner's C event heap), and the 3-shard digest equals the serial
+    native digest bit-for-bit — the multicore scaling configuration at C
+    speed."""
+    from shadow_tpu.parallel.procs import ProcsController
+    xml = workloads.tor_network(12, n_clients=6, n_servers=1, stoptime=40,
+                                stream_spec="512:20480")
+    rc, eng = _run(xml, "native", 40)
+    assert rc == 0
+    serial_digest = state_digest(eng)
+    set_logger(SimLogger(level="warning"))
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = 40
+    pc = ProcsController(Options(scheduler_policy="global", workers=0,
+                                 stop_time_sec=40, seed=42, processes=3,
+                                 log_level="warning"), cfg)
+    assert pc.run() == 0
+    assert pc.digest == serial_digest
+
+
 def test_native_digest_matches_threaded_python_policies():
     """The strongest cross-plane claim: a native serial run digests
     identically to a THREADED python-plane run under another policy (the
