@@ -541,13 +541,17 @@ def main() -> None:
 
     import jax
 
+    # the tracked full-simulation numbers run FIRST: the kernel/phold
+    # stages allocate large cached device arrays whose memory pressure
+    # measurably slows the engine runs on a small box (observed 82k vs
+    # 145k events/s on tor200_serial depending on order)
+    sims = bench_full_sims()
     topo = build_topology(256)
     cpu_rate = bench_cpu_scalar(topo, 200_000)
     dev_rate = bench_device(topo, batch=1 << 20, iters=8)
     dev_compute = bench_device_compute(topo, batch=1 << 20, rounds=64)
     chot = bench_c_hotloop()
     phold = bench_phold()
-    sims = bench_full_sims()
     # the tracked value is the DEFAULT engine configuration on tor200:
     # serial run, C data plane auto-engaged (r1-r4 tracked the tpu-policy
     # run, reported alongside as tor200_tpu for continuity)

@@ -341,6 +341,9 @@ class Engine:
         self._last_heartbeat_wall = now_wall
         policy = self.scheduler.policy
         extra = ""
+        if self.native_plane is not None:
+            _sched, execd, drops, _last = self.native_plane.counters()
+            extra = f" native_events={execd} native_drops={drops}"
         kern = getattr(policy, "_kernel", None)
         if kern is not None:
             extra = (f" device_ms={policy.device_ns / 1e6:.1f}"
