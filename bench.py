@@ -321,6 +321,13 @@ def _run_sim(xml, policy: str, workers: int, stop: int, **opt_kw) -> dict:
         total = st["forwards"] + eng.events_executed
         out["device_traffic_fraction"] = round(st["forwards"] / total, 4) \
             if total else 0.0
+        # pipeline columns (ISSUE 1): wall the in-flight dispatch computed
+        # behind host round work, and transfer chatter per dispatch
+        # (kernel call + flush read + at most one inject upload => <= 3)
+        out["pipeline_overlap_sec"] = st["pipeline_overlap_sec"]
+        out["plane_device_calls"] = st["device_calls"]
+        out["plane_calls_per_dispatch"] = round(
+            st["device_calls"] / max(st["dispatches"], 1), 2)
     return out
 
 
@@ -632,6 +639,15 @@ def main() -> None:
         "tor10k_plane_device_sec": plane_long.get("plane_device_sec"),
         "tor10k_flush_sec": t10k_dev.get("flush_sec"),
         "tor10k_wall_sec": t10k_dev.get("wall_sec"),
+        # flagship-config pipeline columns (tor10k_device_plane_native_long)
+        "tor10k_native_flush_sec":
+            sims.get("tor10k_device_plane_native_long", {}).get("flush_sec"),
+        "tor10k_native_overlap_sec":
+            sims.get("tor10k_device_plane_native_long",
+                     {}).get("pipeline_overlap_sec"),
+        "tor10k_plane_calls_per_dispatch":
+            sims.get("tor10k_device_plane_native_long",
+                     {}).get("plane_calls_per_dispatch"),
         "star100_device_traffic_fraction":
             sims.get("star100_device_plane",
                      {}).get("device_traffic_fraction"),

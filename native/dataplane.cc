@@ -2230,7 +2230,8 @@ PyObject *Plane_push_deliver(PyObject *self, PyObject *args) {
     delete p;
     return nullptr;
   }
-  Ev ev;
+  Ev ev{};   // value-init: every field zeroed before the explicit assigns
+             // (a/b stay 0 — EV_DELIVER carries no aux words)
   ev.time = t;
   ev.dst = (int32_t)dst_hid;
   ev.src = (int32_t)src_hid;

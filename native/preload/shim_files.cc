@@ -136,6 +136,51 @@ extern "C" const char *shd_resolve_path(const char *path, char *buf,
   if (!real_##name)                                       \
     *(void **)(&real_##name) = dlsym(RTLD_NEXT, #name)
 
+/* glibc < 2.33 exports the stat family only through the __xstat compat
+ * names (the plain symbols live in libc_nonshared.a), and a FAILED
+ * dlsym(RTLD_NEXT) inside a shadow_pool dlmopen namespace is fatal on
+ * those glibcs (dlerror machinery is per-namespace there; glibc bug
+ * #24773) — so resolve the compat name FIRST and only look up the modern
+ * name when the compat one is absent (glibc >= 2.33, where the failed
+ * compat lookup is also non-fatal). */
+#define SHD_STAT_VER 1 /* _STAT_VER_LINUX on x86-64 */
+
+#define SHD_REAL_STATLIKE(fn, compat, st_t)                          \
+  static int shd_real_##fn(const char *path, st_t *st) {             \
+    static int (*xs)(int, const char *, st_t *);                     \
+    static int (*plain)(const char *, st_t *);                       \
+    static int init;                                                 \
+    if (!init) {                                                     \
+      *(void **)(&xs) = dlsym(RTLD_NEXT, #compat);                   \
+      if (!xs) *(void **)(&plain) = dlsym(RTLD_NEXT, #fn);           \
+      init = 1;                                                      \
+    }                                                                \
+    return xs ? xs(SHD_STAT_VER, path, st) : plain(path, st);        \
+  }
+
+SHD_REAL_STATLIKE(stat, __xstat, struct stat)
+SHD_REAL_STATLIKE(lstat, __lxstat, struct stat)
+SHD_REAL_STATLIKE(stat64, __xstat64, struct stat64)
+SHD_REAL_STATLIKE(lstat64, __lxstat64, struct stat64)
+
+#define SHD_REAL_FSTATAT(fn, compat, st_t)                               \
+  static int shd_real_##fn(int dirfd, const char *path, st_t *st,        \
+                           int flags) {                                  \
+    static int (*xs)(int, int, const char *, st_t *, int);               \
+    static int (*plain)(int, const char *, st_t *, int);                 \
+    static int init;                                                     \
+    if (!init) {                                                         \
+      *(void **)(&xs) = dlsym(RTLD_NEXT, #compat);                       \
+      if (!xs) *(void **)(&plain) = dlsym(RTLD_NEXT, #fn);               \
+      init = 1;                                                          \
+    }                                                                    \
+    return xs ? xs(SHD_STAT_VER, dirfd, path, st, flags)                 \
+              : plain(dirfd, path, st, flags);                           \
+  }
+
+SHD_REAL_FSTATAT(fstatat, __fxstatat, struct stat)
+SHD_REAL_FSTATAT(fstatat64, __fxstatat64, struct stat64)
+
 /* open/open64/openat live in shim.cc (they also serve the /dev/*random
  * family); they call shd_resolve_path for everything else. */
 
@@ -148,9 +193,8 @@ extern "C" int creat(const char *path, mode_t mode) {
 /* ------------------------------------------------------------ stat etc -- */
 
 extern "C" int stat(const char *path, struct stat *st) {
-  REALF(int, stat, const char *, struct stat *);
   RESOLVE(path, 0);
-  return real_stat(rpath, st);
+  return shd_real_stat(rpath, st);
 }
 
 /* Shim-created absolute symlinks store their target vfs-RESOLVED (see
@@ -171,9 +215,8 @@ static void shd_fix_link_size(const char *rpath, long long *size) {
 }
 
 extern "C" int lstat(const char *path, struct stat *st) {
-  REALF(int, lstat, const char *, struct stat *);
   RESOLVE(path, 0);
-  int r = real_lstat(rpath, st);
+  int r = shd_real_lstat(rpath, st);
   if (r == 0 && S_ISLNK(st->st_mode)) {
     long long sz = (long long)st->st_size;
     shd_fix_link_size(rpath, &sz);
@@ -184,10 +227,9 @@ extern "C" int lstat(const char *path, struct stat *st) {
 
 extern "C" int fstatat(int dirfd, const char *path, struct stat *st,
                        int flags) {
-  REALF(int, fstatat, int, const char *, struct stat *, int);
   if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
     RESOLVE(path, 0);
-    int r = real_fstatat(dirfd, rpath, st, flags);
+    int r = shd_real_fstatat(dirfd, rpath, st, flags);
     if (r == 0 && (flags & AT_SYMLINK_NOFOLLOW) && S_ISLNK(st->st_mode)) {
       long long sz = (long long)st->st_size;
       shd_fix_link_size(rpath, &sz);
@@ -195,7 +237,7 @@ extern "C" int fstatat(int dirfd, const char *path, struct stat *st,
     }
     return r;
   }
-  return real_fstatat(dirfd, path, st, flags);
+  return shd_real_fstatat(dirfd, path, st, flags);
 }
 
 extern "C" int access(const char *path, int mode) {
@@ -311,8 +353,7 @@ extern "C" int chdir(const char *path) {
   if (rpath == rbuf) real_mkdir_(rbuf, 0755);  /* leaf too; EEXIST is fine */
   if (g_vroot_len && shd_active() && shd_pooled()) {
     struct stat st;
-    REALF(int, stat, const char *, struct stat *);
-    if (real_stat(rpath, &st) != 0) return -1;          /* sets errno */
+    if (shd_real_stat(rpath, &st) != 0) return -1;      /* sets errno */
     if (!S_ISDIR(st.st_mode)) { errno = ENOTDIR; return -1; }
     if (strlen(rpath) >= sizeof g_vcwd) { errno = ENAMETOOLONG; return -1; }
     strcpy(g_vcwd, rpath);
@@ -349,15 +390,13 @@ extern "C" char *getcwd(char *buf, size_t size) {
  * half-applied (write through open64 lands in vfs, stat64 misses it). */
 
 extern "C" int stat64(const char *path, struct stat64 *st) {
-  REALF(int, stat64, const char *, struct stat64 *);
   RESOLVE(path, 0);
-  return real_stat64(rpath, st);
+  return shd_real_stat64(rpath, st);
 }
 
 extern "C" int lstat64(const char *path, struct stat64 *st) {
-  REALF(int, lstat64, const char *, struct stat64 *);
   RESOLVE(path, 0);
-  int r = real_lstat64(rpath, st);
+  int r = shd_real_lstat64(rpath, st);
   if (r == 0 && S_ISLNK(st->st_mode)) {
     long long sz = (long long)st->st_size;
     shd_fix_link_size(rpath, &sz);
@@ -368,12 +407,11 @@ extern "C" int lstat64(const char *path, struct stat64 *st) {
 
 extern "C" int fstatat64(int dirfd, const char *path, struct stat64 *st,
                          int flags) {
-  REALF(int, fstatat64, int, const char *, struct stat64 *, int);
   if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
     RESOLVE(path, 0);
-    return real_fstatat64(dirfd, rpath, st, flags);
+    return shd_real_fstatat64(dirfd, rpath, st, flags);
   }
-  return real_fstatat64(dirfd, path, st, flags);
+  return shd_real_fstatat64(dirfd, path, st, flags);
 }
 
 extern "C" int openat64(int dirfd, const char *path, int flags, ...) {
@@ -569,7 +607,18 @@ extern "C" int __xstat(int ver, const char *path, struct stat *st) {
 extern "C" int __lxstat(int ver, const char *path, struct stat *st) {
   REALF(int, __lxstat, int, const char *, struct stat *);
   RESOLVE(path, 0);
-  if (real___lxstat) return real___lxstat(ver, rpath, st);
+  if (real___lxstat) {
+    /* same app-visible link-size fix as the plain lstat interposer —
+     * binaries built against glibc < 2.33 reach lstat THROUGH this
+     * symbol, so skipping it here would half-apply the namespace */
+    int r = real___lxstat(ver, rpath, st);
+    if (r == 0 && S_ISLNK(st->st_mode)) {
+      long long sz = (long long)st->st_size;
+      shd_fix_link_size(rpath, &sz);
+      st->st_size = (off_t)sz;
+    }
+    return r;
+  }
   return lstat(rpath, st);
 }
 
@@ -585,8 +634,21 @@ extern "C" int __fxstatat(int ver, int dirfd, const char *path,
   REALF(int, __fxstatat, int, int, const char *, struct stat *, int);
   const char *p = path;
   char rbuf[4096];
-  if (dirfd == AT_FDCWD || (path && path[0] == '/'))
+  int resolved = 0;   /* branch flag, not pointer identity: resolve may
+                       * return the input pointer for in-namespace paths */
+  if (dirfd == AT_FDCWD || (path && path[0] == '/')) {
     p = shd_resolve_path(path, rbuf, sizeof rbuf, 0);
-  if (real___fxstatat) return real___fxstatat(ver, dirfd, p, st, flags);
+    resolved = 1;
+  }
+  if (real___fxstatat) {
+    int r = real___fxstatat(ver, dirfd, p, st, flags);
+    if (r == 0 && (flags & AT_SYMLINK_NOFOLLOW) && S_ISLNK(st->st_mode)
+        && resolved) {
+      long long sz = (long long)st->st_size;   /* see __lxstat note */
+      shd_fix_link_size(p, &sz);
+      st->st_size = (off_t)sz;
+    }
+    return r;
+  }
   return fstatat(dirfd, p, st, flags);
 }

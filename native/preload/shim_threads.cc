@@ -31,6 +31,10 @@ extern "C" int64_t shd_transact(uint32_t op, int64_t a, int64_t b, int64_t c,
                                 uint32_t payload_len, void *resp_buf,
                                 uint32_t resp_cap, uint32_t *resp_len);
 extern "C" int64_t shd_vtime_ns(void);
+/* file scope + explicit "C": older g++ (<= 10) gives a bare extern
+ * declaration inside a function C++ linkage, emitting an undefined mangled
+ * reference that RTLD_NOW dlmopen (shadow_pool) refuses to load */
+extern "C" int64_t shd_epoch_ns(void);
 extern "C" int shd_pool_exit_hook(int status);
 
 #define GT_MAX_THREADS 256
@@ -510,7 +514,6 @@ extern "C" int pthread_cond_timedwait(pthread_cond_t *c, pthread_mutex_t *m,
     return real_tw(c, m, abstime);
   }
   /* abstime is CLOCK_REALTIME = emulated epoch + vtime */
-  extern int64_t shd_epoch_ns(void);
   int64_t deadline =
       (int64_t)abstime->tv_sec * 1000000000LL + abstime->tv_nsec -
       shd_epoch_ns();
@@ -678,7 +681,6 @@ extern "C" int pthread_rwlock_unlock(pthread_rwlock_t *rw) {
  * breaking mutual exclusion with them.  The park carries the deadline as a
  * W_SLEEP with the rwlock as wait_obj (woken by unlock OR expiry). */
 static int rwlock_timed_park(const void *rw, const struct timespec *abstime) {
-  extern int64_t shd_epoch_ns(void);
   int64_t deadline = (int64_t)abstime->tv_sec * 1000000000LL +
                      abstime->tv_nsec - shd_epoch_ns();
   if (shd_vtime_ns() >= deadline) return ETIMEDOUT;

@@ -293,12 +293,12 @@ class Engine:
         step for the packets sent this round.  In async mode the results are
         materialized by _consume_flush at the top of the next loop iteration
         (always before the next window is computed), so the device computes
-        through the logger flush / heartbeat / window bookkeeping."""
+        through the logger flush / heartbeat / window bookkeeping.  (The
+        device traffic plane launches EARLIER — _launch_plane at the top of
+        the round — so its dispatch overlaps the whole round's host work.)"""
         flush = getattr(self.scheduler.policy, "flush_round", None)
         if flush is not None:
             flush(self)
-        if self.device_plane is not None:
-            self.device_plane.advance(self)
         if self._checkpointer is not None and self._checkpointer.due(self):
             # snapshots must include every in-flight delivery: consume first
             # (only on rounds that actually write — an unconditional consume
@@ -316,6 +316,19 @@ class Engine:
             consume(self)
         if self.device_plane is not None:
             self.device_plane.consume(self)
+
+    def _launch_plane(self) -> None:
+        """Pipeline stage boundary: launch the device traffic plane's window
+        dispatch at the TOP of the round, right after the window is
+        computed — the dispatch then computes while the host drains the
+        round's arrivals (plugin execution + the native C plane), and
+        _consume_flush collects it at the next loop iteration, always
+        before the next window.  The previous dispatch was committed by
+        that same _consume_flush, so round N's state is final before round
+        N+1's staged injections are folded in (the determinism contract
+        tests/test_device_pipeline.py pins)."""
+        if self.device_plane is not None:
+            self.device_plane.advance(self)
 
     def _advance_window(self, lookahead: int) -> bool:
         nxt = self.scheduler.next_event_time()
@@ -375,6 +388,9 @@ class Engine:
                 self.flush_ns += perf() - tc
                 if not self._advance_window(lookahead):
                     break
+                tl = perf()
+                self._launch_plane()
+                self.flush_ns += perf() - tl
                 worker.round_end = self.scheduler.window_end
                 t0 = perf()
                 worker.run_round()
@@ -437,6 +453,9 @@ class Engine:
                 self.flush_ns += perf() - tc
                 if not self._advance_window(lookahead):
                     break
+                tl = perf()
+                self._launch_plane()
+                self.flush_ns += perf() - tl
                 t0 = perf()
                 start_latch.count_down_await()
                 start_latch.reset()

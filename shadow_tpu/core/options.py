@@ -78,7 +78,10 @@ class Options:
                                          # plane for eligible serial runs
                                          # (parallel/native_plane.py)
     device_plane_granule_ms: int = 0     # step size override (0 = auto)
-    device_plane_batch_steps: int = 4    # min steps per kernel dispatch
+    device_plane_batch_steps: int = 8    # min steps per kernel dispatch
+    device_plane_sync: bool = False      # block on the dispatch at launch
+                                         # (serial oracle; digests identical
+                                         # to the pipelined default)
     # Checkpointing (new capability; absent in the reference — SURVEY.md §5)
     checkpoint_interval_sec: int = 0     # --checkpoint-interval (0 = off)
     checkpoint_dir: str = "shadow-checkpoints"  # --checkpoint-dir
@@ -158,11 +161,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="device-plane step size in ms (0 = auto-sized from "
                         "the topology's max latency; bandwidth stays exact, "
                         "per-hop latency rounds up to the step)")
-    p.add_argument("--device-plane-batch-steps", type=int, default=4,
+    p.add_argument("--device-plane-sync", action="store_true",
+                   dest="device_plane_sync",
+                   help="block on each device-plane dispatch at launch "
+                        "instead of overlapping it with the round's host "
+                        "work (the serial oracle: digests are identical to "
+                        "the pipelined default, only wall time differs)")
+    p.add_argument("--device-plane-batch-steps", type=int, default=8,
                    dest="device_plane_batch_steps",
                    help="accumulate at least N plane steps per kernel "
-                        "dispatch (amortizes per-dispatch cost on backends "
-                        "without buffer donation)")
+                        "dispatch (amortizes the per-dispatch state copy "
+                        "on backends where the carried state cannot alias)")
     p.add_argument("--tpu-chunk", type=int, default=0, dest="tpu_chunk",
                    help="launch a device step as soon as N packet hops "
                         "accumulate mid-round, overlapping device compute "

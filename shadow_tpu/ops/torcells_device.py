@@ -44,6 +44,15 @@ from .bandwidth import bucket_params
 
 CELL_WIRE_BYTES = 512 + defs.CONFIG_HEADER_SIZE_TCPIPETH
 
+# Arrival-ring element dtype for the execution plane: per-step per-flow cell
+# counts (bounded by bucket capacity / cell size — a 10 Gbit/s host at a
+# 100 ms granule is ~230k cells, nowhere near 2**31).  int32 halves the
+# [ring_len, F] state bytes, which is the fixed per-dispatch copy cost on
+# backends where the carried state cannot alias (PJRT CPU).  The kernels are
+# dtype-polymorphic over the ring argument, so int64 callers (older tests,
+# external users) keep working.
+RING_DTYPE = np.int32
+
 
 def build_flows(route: np.ndarray,          # int32 [C, 5] node per stage
                 latency_ticks: np.ndarray,  # int64 [H, H]
@@ -167,25 +176,23 @@ def torcells_run(queued0: jnp.ndarray,     # int64 [F] initial cells/flow
     return delivered, t, forwards
 
 
-@partial(jax.jit, static_argnames=("ring_len",),
-         donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
-def torcells_step_window(t0: jnp.ndarray,         # int64 scalar: next tick
-                         queued: jnp.ndarray,     # int64 [F]
-                         ring: jnp.ndarray,       # int64 [L, F]
-                         tokens: jnp.ndarray,     # int64 [H]
-                         delivered: jnp.ndarray,  # int64 [F]
-                         target: jnp.ndarray,     # int64 [F] (last-stage rows)
-                         done_tick: jnp.ndarray,  # int64 [F], -1 = not done
-                         node_sent: jnp.ndarray,  # int64 [H] cumulative bytes
-                         inject: jnp.ndarray,     # int64 [F] new cells @ t0
-                         inject_target: jnp.ndarray,  # int64 [F] target adds
-                         n_ticks: jnp.ndarray,    # int64 scalar (dynamic)
-                         idle_ticks: jnp.ndarray,  # int64 scalar: skipped
-                                                   # empty ticks to fold in
-                         flow_node: jnp.ndarray, flow_lat: jnp.ndarray,
-                         flow_succ: jnp.ndarray, seg_start: jnp.ndarray,
-                         refill: jnp.ndarray, capacity: jnp.ndarray,
-                         ring_len: int):
+def _step_window_impl(t0: jnp.ndarray,         # int64 scalar: next tick
+                      queued: jnp.ndarray,     # int64 [F]
+                      ring: jnp.ndarray,       # int64 [L, F]
+                      tokens: jnp.ndarray,     # int64 [H]
+                      delivered: jnp.ndarray,  # int64 [F]
+                      target: jnp.ndarray,     # int64 [F] (last-stage rows)
+                      done_tick: jnp.ndarray,  # int64 [F], -1 = not done
+                      node_sent: jnp.ndarray,  # int64 [H] cumulative bytes
+                      inject: jnp.ndarray,     # int64 [F] new cells @ t0
+                      inject_target: jnp.ndarray,  # int64 [F] target adds
+                      n_ticks: jnp.ndarray,    # int64 scalar (dynamic)
+                      idle_ticks: jnp.ndarray,  # int64 scalar: skipped
+                                                # empty ticks to fold in
+                      flow_node: jnp.ndarray, flow_lat: jnp.ndarray,
+                      flow_succ: jnp.ndarray, seg_start: jnp.ndarray,
+                      refill: jnp.ndarray, capacity: jnp.ndarray,
+                      ring_len: int):
     """Advance the cell model by EXACTLY n_ticks, carrying ALL state in HBM
     across dispatches — the execution-plane variant of torcells_run (state
     tensors are donated, so each round's dispatch updates in place; the host
@@ -253,7 +260,11 @@ def torcells_step_window(t0: jnp.ndarray,         # int64 scalar: next tick
         done_tick = jnp.where(newly_done, t, done_tick)
         v = jnp.zeros(f, jnp.int64).at[jnp.maximum(flow_succ, 0)].add(
             jnp.where(is_last, jnp.int64(0), served))
-        hist = hist.at[jnp.mod(t, ring_len)].set(v)
+        # cast to the carried ring dtype: DeviceTrafficPlane keeps the ring
+        # int32 (RING_DTYPE) — per-step per-flow cell counts are bounded by
+        # bucket capacity / cell size, far below 2**31 — which halves the
+        # per-dispatch state-copy bytes, the fixed cost of every dispatch
+        hist = hist.at[jnp.mod(t, ring_len)].set(v.astype(hist.dtype))
         forwards = forwards + jnp.sum(served)
         return (t + 1, queued, hist, tokens, delivered, target, done_tick,
                 node_sent, forwards)
@@ -266,6 +277,174 @@ def torcells_step_window(t0: jnp.ndarray,         # int64 scalar: next tick
     state = (t0, queued, ring, tokens, delivered, target, done_tick,
              node_sent, jnp.int64(0))
     return jax.lax.while_loop(cond, body, state)
+
+
+@partial(jax.jit, static_argnames=("ring_len",),
+         donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def torcells_step_window(t0, queued, ring, tokens, delivered, target,
+                         done_tick, node_sent, inject, inject_target,
+                         n_ticks, idle_ticks, flow_node, flow_lat,
+                         flow_succ, seg_start, refill, capacity,
+                         ring_len: int):
+    """The jitted windowed step (see _step_window_impl for the contract)."""
+    return _step_window_impl(t0, queued, ring, tokens, delivered, target,
+                             done_tick, node_sent, inject, inject_target,
+                             n_ticks, idle_ticks, flow_node, flow_lat,
+                             flow_succ, seg_start, refill, capacity,
+                             ring_len)
+
+
+# ---------------------------------------------------------------------------
+# Packed flush buffer: the dispatch's ENTIRE host-facing summary in one
+# int64 vector, so collect is ONE device->host transfer instead of four
+# (delivered + done_tick + node_sent + forwards).  Delta-compacted with a
+# device-side cursor: only chains that completed THIS window and only nodes
+# whose sent-byte counter moved occupy slots; the header carries the counts.
+#
+# Layout ([4 + 2C + 2H] int64, C = chains, H = nodes):
+#   [0] forwards this window
+#   [1] cumulative delivered cells summed over chain-exit flows
+#   [2] n_done   — chains newly completed this window
+#   [3] n_nodes  — nodes with a nonzero sent-byte delta this window
+#   [4        : 4+n_done]        newly-done chain indices (ascending)
+#   [4+C      : 4+C+n_done]      their completion steps
+#   [4+2C     : 4+2C+n_nodes]    touched node indices (ascending)
+#   [4+2C+H   : 4+2C+H+n_nodes]  their sent-byte deltas
+# ---------------------------------------------------------------------------
+
+FLUSH_HEADER = 4
+
+
+def flush_len(n_chains: int, n_nodes: int) -> int:
+    return FLUSH_HEADER + 2 * n_chains + 2 * n_nodes
+
+
+def _pack_flush_jnp(forwards, delivered_sum, newly, done_last, sent_delta):
+    """newly bool [C], done_last int64 [C], sent_delta int64 [H] -> packed
+    buffer.  Compaction is a cumsum-cursor scatter; out-of-range slots (the
+    unselected lanes) are dropped on device."""
+    c = newly.shape[0]
+    h = sent_delta.shape[0]
+    length = flush_len(c, h)
+    touched = sent_delta != 0
+    pos_c = jnp.cumsum(newly.astype(jnp.int64)) - 1
+    pos_h = jnp.cumsum(touched.astype(jnp.int64)) - 1
+    oob = jnp.int64(length)
+    buf = jnp.zeros(length, jnp.int64)
+    buf = buf.at[0].set(forwards)
+    buf = buf.at[1].set(delivered_sum)
+    buf = buf.at[2].set(jnp.sum(newly.astype(jnp.int64)))
+    buf = buf.at[3].set(jnp.sum(touched.astype(jnp.int64)))
+    base = jnp.int64(FLUSH_HEADER)
+    buf = buf.at[jnp.where(newly, base + pos_c, oob)].set(
+        jnp.arange(c, dtype=jnp.int64), mode="drop")
+    buf = buf.at[jnp.where(newly, base + c + pos_c, oob)].set(
+        done_last, mode="drop")
+    buf = buf.at[jnp.where(touched, base + 2 * c + pos_h, oob)].set(
+        jnp.arange(h, dtype=jnp.int64), mode="drop")
+    buf = buf.at[jnp.where(touched, base + 2 * c + h + pos_h, oob)].set(
+        sent_delta, mode="drop")
+    return buf
+
+
+def pack_flush_np(forwards, delivered_sum, newly, done_last, sent_delta):
+    """Bit-identical host twin of _pack_flush_jnp."""
+    c = len(newly)
+    h = len(sent_delta)
+    buf = np.zeros(flush_len(c, h), np.int64)
+    buf[0] = forwards
+    buf[1] = delivered_sum
+    ci = np.flatnonzero(newly)
+    ni = np.flatnonzero(sent_delta)
+    buf[2] = len(ci)
+    buf[3] = len(ni)
+    base = FLUSH_HEADER
+    buf[base:base + len(ci)] = ci
+    buf[base + c:base + c + len(ci)] = np.asarray(done_last)[ci]
+    buf[base + 2 * c:base + 2 * c + len(ni)] = ni
+    buf[base + 2 * c + h:base + 2 * c + h + len(ni)] = \
+        np.asarray(sent_delta)[ni]
+    return buf
+
+
+def parse_flush(buf: np.ndarray, n_chains: int, n_nodes: int):
+    """(forwards, delivered_sum, done_chains, done_steps, node_idx,
+    node_delta) from a packed flush buffer — the ONE host-side reader."""
+    base = FLUSH_HEADER
+    n_done = int(buf[2])
+    n_touch = int(buf[3])
+    return (int(buf[0]), int(buf[1]),
+            buf[base:base + n_done],
+            buf[base + n_chains:base + n_chains + n_done],
+            buf[base + 2 * n_chains:base + 2 * n_chains + n_touch],
+            buf[base + 2 * n_chains + n_nodes:
+                base + 2 * n_chains + n_nodes + n_touch])
+
+
+def _step_window_flush_impl(t0, queued, ring, tokens, delivered, target,
+                            done_tick, node_sent, inject, inject_target,
+                            n_ticks, idle_ticks, flow_node, flow_lat,
+                            flow_succ, seg_start, refill, capacity,
+                            last_flow, ring_len: int):
+    """Windowed step + packed flush in ONE dispatch: returns the 9-tuple of
+    torcells_step_window with the packed flush buffer appended as [9].
+    ``last_flow`` [C] maps each chain to its exit flow row."""
+    done_in_last = done_tick[last_flow]
+    node_sent_in = node_sent
+    out = _step_window_impl(t0, queued, ring, tokens, delivered, target,
+                            done_tick, node_sent, inject, inject_target,
+                            n_ticks, idle_ticks, flow_node, flow_lat,
+                            flow_succ, seg_start, refill, capacity,
+                            ring_len)
+    done_last = out[6][last_flow]
+    newly = (done_last >= 0) & (done_in_last < 0)
+    flush = _pack_flush_jnp(out[8], jnp.sum(out[4][last_flow]), newly,
+                            done_last, out[7] - node_sent_in)
+    return (*out, flush)
+
+
+# Two jit wrappers over the SAME flush program, picked by backend
+# (step_window_flush_for_backend): donation aliases the carried state in
+# place on TPU/GPU, but on the PJRT CPU client a donated call executes
+# SYNCHRONOUSLY (measured: 114 ms launch vs 0.33 ms undonated for the same
+# kernel) AND still copies the buffers — so the CPU backend uses the
+# non-donating variant, which is what lets the dispatch actually compute
+# behind the round's host work.
+torcells_step_window_flush = partial(
+    jax.jit, static_argnames=("ring_len",),
+    donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))(_step_window_flush_impl)
+
+torcells_step_window_flush_nodonate = partial(
+    jax.jit, static_argnames=("ring_len",))(_step_window_flush_impl)
+
+
+def step_window_flush_for_backend():
+    """The flush-step jit appropriate for the default backend (see note
+    above): donating on accelerators, non-donating on CPU."""
+    if jax.default_backend() == "cpu":
+        return torcells_step_window_flush_nodonate
+    return torcells_step_window_flush
+
+
+def torcells_step_window_numpy_flush(t0, queued, ring, tokens, delivered,
+                                     target, done_tick, node_sent, inject,
+                                     inject_target, n_ticks, idle_ticks,
+                                     flow_node, flow_lat, flow_succ,
+                                     seg_start, refill, capacity, last_flow,
+                                     ring_len: int):
+    """Host twin of torcells_step_window_flush (same 10-tuple contract)."""
+    done_in_last = np.asarray(done_tick)[last_flow].copy()
+    node_sent_in = np.asarray(node_sent).copy()
+    out = torcells_step_window_numpy(t0, queued, ring, tokens, delivered,
+                                     target, done_tick, node_sent, inject,
+                                     inject_target, n_ticks, idle_ticks,
+                                     flow_node, flow_lat, flow_succ,
+                                     seg_start, refill, capacity, ring_len)
+    done_last = out[6][last_flow]
+    newly = (done_last >= 0) & (done_in_last < 0)
+    flush = pack_flush_np(int(out[8]), int(out[4][last_flow].sum()), newly,
+                          done_last, out[7] - node_sent_in)
+    return (*out, flush)
 
 
 def torcells_step_window_numpy(t0, queued, ring, tokens, delivered, target,
@@ -446,6 +625,46 @@ def pad_state(layout: dict, a, fill: int = 0) -> np.ndarray:
     return out
 
 
+def make_torcells_sharded_window_flush(mesh, axis: str, ring_len: int,
+                                       last_flow_pad: np.ndarray,
+                                       node_src: np.ndarray,
+                                       n_nodes: int):
+    """Sharded windowed step + packed flush in ONE dispatch (the sharded
+    analog of torcells_step_window_flush): same arguments as the step built
+    by make_torcells_sharded_window, returns its 9-tuple with the packed
+    flush buffer appended as [9].  ``last_flow_pad`` [C] holds chain-exit
+    rows in PADDED flow space; ``node_src`` maps padded local-node slots to
+    global nodes (-1 = padding); the flush is expressed in the ORIGINAL
+    chain/node spaces, identical to the single-device layout's."""
+    raw = _make_sharded_window_raw(mesh, axis, ring_len)
+    lf = np.asarray(last_flow_pad, dtype=np.int64)
+    nsrc = np.asarray(node_src, dtype=np.int64)
+
+    def global_sent(ns_padded):
+        # padding slots (node_src < 0) scatter out of range and drop
+        idx = jnp.where(nsrc >= 0, nsrc, jnp.int64(n_nodes))
+        return jnp.zeros(n_nodes, jnp.int64).at[idx].add(ns_padded,
+                                                         mode="drop")
+
+    def step_flush(t0, queued, ring, tokens, delivered, target, done_tick,
+                   node_sent, inject, inject_target, n_ticks, idle_ticks,
+                   flow_node_local, succ_global, seg_start_local,
+                   refill, capacity, arr_lat, shard_base):
+        done_in_last = done_tick[lf]
+        sent_in = global_sent(node_sent)
+        out = raw(t0, queued, ring, tokens, delivered, target, done_tick,
+                  node_sent, inject, inject_target, n_ticks, idle_ticks,
+                  flow_node_local, succ_global, seg_start_local,
+                  refill, capacity, arr_lat, shard_base)
+        done_last = out[6][lf]
+        newly = (done_last >= 0) & (done_in_last < 0)
+        flush = _pack_flush_jnp(out[8], jnp.sum(out[4][lf]), newly,
+                                done_last, global_sent(out[7]) - sent_in)
+        return (*out, flush)
+
+    return jax.jit(step_flush)
+
+
 def make_torcells_sharded_window(mesh, axis: str, ring_len: int):
     """Build the shard_map-ed windowed step over ``mesh``.
 
@@ -454,6 +673,12 @@ def make_torcells_sharded_window(mesh, axis: str, ring_len: int):
     way (a node's flows all live on its shard); the arrival ring and the
     successor-space tables (arr_slot_lat, has_pred) are REPLICATED so every
     shard applies the identical ring update after the per-tick psum."""
+    return jax.jit(_make_sharded_window_raw(mesh, axis, ring_len))
+
+
+def _make_sharded_window_raw(mesh, axis: str, ring_len: int):
+    """The un-jitted shard_map step make_torcells_sharded_window wraps —
+    shared with the flush variant so the tick loop exists once."""
     import jax
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
@@ -525,7 +750,8 @@ def make_torcells_sharded_window(mesh, axis: str, ring_len: int):
                 v = jnp.zeros(f_total, jnp.int64).at[
                     jnp.maximum(succ_global, 0)].add(fwd)
                 v = jax.lax.psum(v, axis)
-                ring = ring.at[jnp.mod(t, ring_len)].set(v)
+                # same RING_DTYPE cast as the single-device kernel
+                ring = ring.at[jnp.mod(t, ring_len)].set(v.astype(ring.dtype))
                 forwards = forwards + jax.lax.psum(jnp.sum(served), axis)
                 return (t + 1, queued, ring, tokens, delivered, target,
                         done_tick, node_sent, forwards)
@@ -553,7 +779,7 @@ def make_torcells_sharded_window(mesh, axis: str, ring_len: int):
             flow_node_local, succ_global, seg_start_local,
             refill, capacity, arr_lat, shard_base)
 
-    return jax.jit(step, static_argnames=())
+    return step
 
 
 def torcells_run_numpy(queued0, flow_node, flow_lat, flow_succ, seg_start,

@@ -17,17 +17,32 @@ as a device flow.  From that point the cells live in device tensors:
 * a [ring_len, F] arrival ring indexed by tick (the device analog of the
   delivery event queue).
 
-Each engine round launches ONE windowed dispatch advancing the plane to the
-round barrier (ops/torcells_device.torcells_step_window; state donated, so
-it never leaves HBM); the engine consumes the small summaries (per-flow
-delivered counts + completion ticks + per-node sent bytes) at the next
-round boundary — the same async launch/consume contract as the tpu
-scheduler policy.  Completed flows wake their client process through an
-ordinary scheduled event, so determinism is exact: completion ticks are
-device-computed, wake times are their tick times clamped to the consuming
-round's barrier, and digests are identical across scheduler policies and
-across the device/numpy execution modes (--device-plane=numpy runs the
-bit-identical host twin; tests/test_device_plane.py pins both).
+The device plane is a two-stage pipeline over the engine's round loop
+(stage -> launch -> collect):
+
+* **stage** — client activations buffer injections host-side
+  (``activate``) during a round;
+* **launch** — at the TOP of the next dispatching round (right after the
+  engine computes the window), ONE windowed dispatch advances the plane
+  to the round barrier (ops/torcells_device.torcells_step_window_flush;
+  state donated, so it never leaves HBM).  The dispatch is asynchronous:
+  it computes while the host drains the round's arrivals (plugin
+  execution + the native C plane);
+* **collect** — at the next loop iteration, before the next window is
+  computed, the engine materializes the dispatch's ONE packed flush
+  buffer (forwards + delivered cursor + newly-completed chains +
+  per-node byte deltas, delta-compacted on device) and wakes completed
+  flows.
+
+Completed flows wake their client process through an ordinary scheduled
+event, so determinism is exact: completion ticks are device-computed, wake
+times are their tick times clamped to the launching round's barrier, and
+digests are identical across scheduler policies, across the device/numpy
+execution modes (--device-plane=numpy runs the bit-identical host twin;
+tests/test_device_plane.py pins both), and across pipelined vs serial
+(--device-plane-sync) execution — the engine commits round N's plane
+state before round N+1's staged injections are folded in, so overlap
+never reorders anything (tests/test_device_pipeline.py).
 
 What is and is NOT modeled (honesty contract, same spirit as
 ops/bandwidth.py's docstring): the plane models BOTH directions of each
@@ -209,7 +224,7 @@ class DeviceTrafficPlane:
         # execution modes follow the identical cadence, so digests stay
         # parity-comparable.
         self.min_dispatch_steps = max(
-            1, int(getattr(engine.options, "device_plane_batch_steps", 4)))
+            1, int(getattr(engine.options, "device_plane_batch_steps", 8)))
         self._mesh = None
         self._shard = None           # layout dict when sharded
         self._sharded_step = None
@@ -243,18 +258,32 @@ class DeviceTrafficPlane:
                 self._setup_sharding(n_dev)
         self._state = None           # lazy: built at first activation
         self._inflight = False
+        self._flush_handle = None    # in-flight packed flush (1-deep slot)
+        self._flush_step = None      # backend-selected flush kernel (lazy)
         self._ticks_synced = 0
         self._inject_buf: List[Tuple[int, int]] = []   # (circuit, cells)
         self._waiters: Dict[int, Tuple[object, object]] = {}
         self._done: Dict[int, int] = {}   # circuit -> wake sim time ns
         self._woken: set = set()
-        self._prev_node_sent: Optional[np.ndarray] = None
+        self._chain_done: Optional[np.ndarray] = None  # [C] step or -1
         self._flow_args_cached = None
+        self._zero_inject_cached = None   # device-resident, reused when the
+                                          # staged inject buffer is empty
         self.total_forwards = 0
         self.total_injected_cells = 0
         self.dispatches = 0
         self.device_ns = 0
         self.host_ns = 0
+        # pipeline introspection: actual host<->device interactions (kernel
+        # dispatch + inject upload + flush read) and the wall the in-flight
+        # dispatch had to compute behind host round work
+        self.device_calls = 0
+        self.pipeline_overlap_ns = 0
+        self._launch_wall = 0
+        # --device-plane-sync: block on the dispatch at launch time (the
+        # serial oracle the pipelined run is digest-compared against)
+        self._sync = bool(getattr(engine.options, "device_plane_sync",
+                                  False))
         # idle fast path: when the plane provably has no cells anywhere
         # (every dispatched cell delivered, nothing buffered), rounds only
         # bank refill ticks instead of spinning the kernel; the next real
@@ -353,6 +382,7 @@ class DeviceTrafficPlane:
         chain_base = np.r_[0, np.cumsum(chain_len)[:-1]]
         self.first_flow = pos_of[chain_base]
         self.last_flow = pos_of[chain_base + chain_len - 1]
+        self.n_chains = len(chains)
         # Step granulation: the kernel's loop iteration covers ``granule``
         # milliseconds.  Chosen so the arrival ring stays <= ~64 slots even
         # on multi-second-latency topologies (the reference GraphML has
@@ -377,6 +407,15 @@ class DeviceTrafficPlane:
         # rate preservation: a backlogged node must be able to spend a full
         # step's refill; burst capacity otherwise keeps the 1 ms bucket's
         self.capacity_step = np.maximum(self.capacity, self.refill_step)
+        from ..ops.torcells_device import CELL_WIRE_BYTES
+        if int(self.capacity_step.max()) // CELL_WIRE_BYTES >= 2 ** 31:
+            # the int32 arrival ring (ops/torcells_device.RING_DTYPE) holds
+            # per-step cell counts bounded by capacity/cell-size; a config
+            # that could overflow it must fail loudly, not wrap
+            raise ValueError(
+                "device plane: a node's per-step burst capacity exceeds "
+                "2**31 cells — the int32 arrival ring would overflow "
+                "(lower --device-plane-granule-ms or the host bandwidth)")
         self.n_flows = n_flows
         self.n_nodes = len(names)
 
@@ -389,10 +428,11 @@ class DeviceTrafficPlane:
         else:
             f, h = self.n_flows, self.n_nodes
             tokens0 = self.capacity_step
+        from ..ops.torcells_device import RING_DTYPE
         zeros_f = np.zeros(f, dtype=np.int64)
         state = (np.int64(self._ticks_synced),
                  zeros_f.copy(),                                   # queued
-                 np.zeros((self.ring_len, f), dtype=np.int64),     # ring
+                 np.zeros((self.ring_len, f), dtype=RING_DTYPE),   # ring
                  tokens0.copy(),                                   # tokens
                  zeros_f.copy(),                                   # delivered
                  zeros_f.copy(),                                   # target
@@ -403,13 +443,14 @@ class DeviceTrafficPlane:
             state = tuple(jnp.asarray(a) for a in state)
         self._state = state
         self._flow_args_cached = None
-        self._prev_node_sent = np.zeros(self.n_nodes, dtype=np.int64)
+        self._zero_inject_cached = None
+        self._chain_done = np.full(self.n_chains, -1, dtype=np.int64)
 
     def _setup_sharding(self, n_dev: int) -> None:
         import jax
         from jax.sharding import Mesh
-        from ..ops.torcells_device import (build_sharded_layout,
-                                           make_torcells_sharded_window)
+        from ..ops.torcells_device import (
+            build_sharded_layout, make_torcells_sharded_window_flush)
         pool = jax.devices()
         if len(pool) < n_dev:
             try:
@@ -427,8 +468,10 @@ class DeviceTrafficPlane:
         self._shard = build_sharded_layout(
             self.flow_node, self.flow_lat_steps, self.flow_succ,
             self.seg_start, self.refill_step, self.capacity_step, n_dev)
-        self._sharded_step = make_torcells_sharded_window(
-            self._mesh, "flows", self.ring_len)
+        self._sharded_step = make_torcells_sharded_window_flush(
+            self._mesh, "flows", self.ring_len,
+            self._shard["inv"][self.last_flow], self._shard["node_src"],
+            self.n_nodes)
         get_logger().message(
             "device-plane",
             f"flow table sharded over {n_dev} devices "
@@ -437,7 +480,11 @@ class DeviceTrafficPlane:
 
     def _read_summaries(self):
         """(delivered, done_tick, node_sent) in the ORIGINAL flow/node
-        space, whatever the execution layout."""
+        space, whatever the execution layout.  Final-state reader for
+        tests/tooling (e.g. the conservation gate) — the engine hot path
+        never calls this; consume() reads the packed flush buffer, and
+        materializing full state tensors here would forfeit the pipeline
+        if it ever crept into a per-round path."""
         delivered = np.asarray(self._state[4])
         done_tick = np.asarray(self._state[6])
         node_sent = np.asarray(self._state[7])
@@ -457,12 +504,28 @@ class DeviceTrafficPlane:
         bandwidth every round), plain numpy for the twin."""
         if self._flow_args_cached is None:
             args = (self.flow_node, self.flow_lat_steps, self.flow_succ,
-                    self.seg_start, self.refill_step, self.capacity_step)
+                    self.seg_start, self.refill_step, self.capacity_step,
+                    self.last_flow)
             if self.mode == "device":
                 import jax.numpy as jnp
                 args = tuple(jnp.asarray(a) for a in args)
             self._flow_args_cached = args
         return self._flow_args_cached
+
+    def _zero_inject(self):
+        """A reusable (device-resident in device mode) zero inject vector in
+        the execution layout — most dispatches carry no injections, and
+        re-uploading two [F] int64 zero vectors per dispatch is exactly the
+        per-round transfer chatter the pipeline exists to cut."""
+        if self._zero_inject_cached is None:
+            f = len(self._shard["src"]) if self._shard is not None \
+                else self.n_flows
+            z = np.zeros(f, dtype=np.int64)
+            if self.mode == "device":
+                import jax.numpy as jnp
+                z = jnp.asarray(z)
+            self._zero_inject_cached = z
+        return self._zero_inject_cached
 
     # -- app-facing -------------------------------------------------------
     def activate(self, client_name: str, cells: Optional[int] = None) -> int:
@@ -522,13 +585,16 @@ class DeviceTrafficPlane:
         if self.mode != "device":
             return
         import jax.numpy as jnp
-        from ..ops.torcells_device import torcells_step_window
+        from ..ops.torcells_device import (RING_DTYPE,
+                                           step_window_flush_for_backend)
+        if self._flush_step is None:
+            self._flush_step = step_window_flush_for_backend()
         if self._shard is not None:
             lay = self._shard
             fp, hp = len(lay["src"]), len(lay["refill"])
             zp = np.zeros(fp, dtype=np.int64)
             state = (np.int64(0), jnp.zeros(fp, jnp.int64),
-                     jnp.zeros((self.ring_len, fp), jnp.int64),
+                     jnp.zeros((self.ring_len, fp), RING_DTYPE),
                      jnp.asarray(lay["capacity"]),
                      jnp.zeros(fp, jnp.int64), jnp.zeros(fp, jnp.int64),
                      jnp.full(fp, -1, jnp.int64), jnp.zeros(hp, jnp.int64))
@@ -537,29 +603,36 @@ class DeviceTrafficPlane:
                 lay["flow_node_local"], lay["succ_global"],
                 lay["seg_start_local"], lay["refill"], lay["capacity"],
                 lay["arr_lat"], lay["shard_base"])
-            np.asarray(out[8])
+            np.asarray(out[9])
             return
         f, h = self.n_flows, self.n_nodes
         z = np.zeros(f, dtype=np.int64)
         state = (np.int64(0), jnp.zeros(f, jnp.int64),
-                 jnp.zeros((self.ring_len, f), jnp.int64),
+                 jnp.zeros((self.ring_len, f), RING_DTYPE),
                  jnp.asarray(self.capacity_step),
                  jnp.zeros(f, jnp.int64), jnp.zeros(f, jnp.int64),
                  jnp.full(f, -1, jnp.int64), jnp.zeros(h, jnp.int64))
-        out = torcells_step_window(*state, z, z, np.int64(1), np.int64(0),
-                                   self.flow_node, self.flow_lat_steps,
-                                   self.flow_succ, self.seg_start,
-                                   self.refill_step, self.capacity_step,
-                                   ring_len=self.ring_len)
-        np.asarray(out[8])
+        out = self._flush_step(
+            *state, z, z, np.int64(1), np.int64(0),
+            self.flow_node, self.flow_lat_steps, self.flow_succ,
+            self.seg_start, self.refill_step, self.capacity_step,
+            self.last_flow, ring_len=self.ring_len)
+        np.asarray(out[9])
 
     # -- engine-facing ----------------------------------------------------
     def advance(self, engine) -> None:
-        """Launch the window dispatch advancing the plane to the round
-        barrier (called from the engine's flush hook).  Async in device
-        mode — consume() materializes at the next loop iteration."""
+        """LAUNCH: dispatch the window step advancing the plane to the
+        current round's barrier.  Called at the TOP of the round (right
+        after the engine computes the window), so the dispatch computes
+        while the host drains the round's arrivals; consume() collects at
+        the next loop iteration, always before the next window.  Staged
+        injections (activations from earlier rounds) are folded in at the
+        dispatch's base step — the engine has already committed the
+        previous dispatch, so the one-deep in-flight slot is free here."""
         import time as _wt
         t0 = _wt.perf_counter_ns()
+        assert not self._inflight, \
+            "device plane: launch with an uncollected dispatch in flight"
         target_ticks = engine.scheduler.window_end // (TICK_NS * self.granule)
         n = target_ticks - self._ticks_synced
         if n <= 0 and not self._inject_buf:
@@ -571,7 +644,7 @@ class DeviceTrafficPlane:
                 self._ticks_synced = target_ticks
                 return
             self._init_state()
-        elif (not self._inject_buf and not self._inflight
+        elif (not self._inject_buf
               and self._cells_delivered_seen >= self._cells_dispatched):
             # plane is empty: bank the ticks, skip the dispatch
             self._idle_ticks_banked += n
@@ -583,14 +656,23 @@ class DeviceTrafficPlane:
             # rounds before paying a dispatch; next_time() keeps the engine
             # window loop coming back even when the Python plane idles
             return
-        f = self.n_flows
-        inject = np.zeros(f, dtype=np.int64)
-        inject_target = np.zeros(f, dtype=np.int64)
-        for circ, cells in self._inject_buf:
-            inject[self.first_flow[circ]] += cells
-            inject_target[self.last_flow[circ]] += cells
-            self._cells_dispatched += cells
-        self._inject_buf.clear()
+        if self._inject_buf:
+            f = self.n_flows
+            inject = np.zeros(f, dtype=np.int64)
+            inject_target = np.zeros(f, dtype=np.int64)
+            for circ, cells in self._inject_buf:
+                inject[self.first_flow[circ]] += cells
+                inject_target[self.last_flow[circ]] += cells
+                self._cells_dispatched += cells
+            self._inject_buf.clear()
+            if self._shard is not None:
+                from ..ops.torcells_device import pad_state
+                inject = pad_state(self._shard, inject)
+                inject_target = pad_state(self._shard, inject_target)
+            if self.mode == "device":
+                self.device_calls += 1          # inject upload
+        else:
+            inject = inject_target = self._zero_inject()
         idle = self._idle_ticks_banked
         self._idle_ticks_banked = 0
         # Step continuity: the kernel's carried t equals the last dispatch's
@@ -603,57 +685,79 @@ class DeviceTrafficPlane:
         # now pinned by test_varying_dispatch_sizes_preserve_arrivals.)
         state = (np.int64(self._ticks_synced), *self._state[1:])
         if self._shard is not None:
-            from ..ops.torcells_device import pad_state
             lay = self._shard
             out = self._sharded_step(
-                *state, pad_state(lay, inject), pad_state(lay, inject_target),
+                *state, inject, inject_target,
                 np.int64(n), np.int64(idle), lay["flow_node_local"],
                 lay["succ_global"], lay["seg_start_local"],
                 lay["refill"], lay["capacity"], lay["arr_lat"],
                 lay["shard_base"])
         elif self.mode == "device":
-            from ..ops.torcells_device import torcells_step_window
-            out = torcells_step_window(*state, inject, inject_target,
-                                       np.int64(n), np.int64(idle),
-                                       *self._flow_args(),
-                                       ring_len=self.ring_len)
+            if self._flush_step is None:
+                from ..ops.torcells_device import (
+                    step_window_flush_for_backend)
+                self._flush_step = step_window_flush_for_backend()
+            out = self._flush_step(*state, inject, inject_target,
+                                   np.int64(n), np.int64(idle),
+                                   *self._flow_args(),
+                                   ring_len=self.ring_len)
         else:
-            from ..ops.torcells_device import torcells_step_window_numpy
-            out = torcells_step_window_numpy(*state, inject,
-                                            inject_target, n, idle,
-                                            *self._flow_args(),
-                                            self.ring_len)
+            from ..ops.torcells_device import torcells_step_window_numpy_flush
+            out = torcells_step_window_numpy_flush(*state, inject,
+                                                   inject_target, n, idle,
+                                                   *self._flow_args(),
+                                                   self.ring_len)
         self._state = out[:8]
-        self._forwards_handle = out[8]
+        self._flush_handle = out[9]
         self._ticks_synced = target_ticks
         self._inflight = True
         self.dispatches += 1
-        self.host_ns += _wt.perf_counter_ns() - t0
+        if self.mode == "device":
+            self.device_calls += 1              # the dispatch itself
+            if self._sync:
+                # serial oracle: idle through the kernel instead of
+                # overlapping — everything else is identical, so digests
+                # must match the pipelined run bit for bit
+                import jax
+                jax.block_until_ready(self._flush_handle)
+        self._launch_wall = _wt.perf_counter_ns()
+        self.host_ns += self._launch_wall - t0
 
     def consume(self, engine) -> None:
-        """Materialize the last dispatch's summaries, wake completed flows,
-        and feed the per-node byte counters to the trackers.  Runs before
-        the engine computes the next window (same contract as the tpu
-        policy's consume_flush)."""
+        """COLLECT: materialize the in-flight dispatch's packed flush
+        buffer (ONE device->host transfer), wake completed flows, and feed
+        the per-node byte deltas to the trackers.  Runs before the engine
+        computes the next window (same contract as the tpu policy's
+        consume_flush).  An exception raised inside the in-flight dispatch
+        surfaces HERE, at materialization — nothing is caught."""
         if not self._inflight:
             return
         import time as _wt
         t0 = _wt.perf_counter_ns()
-        delivered, done_tick, node_sent = self._read_summaries()
-        self.total_forwards += int(np.asarray(self._forwards_handle))
-        self._cells_delivered_seen = int(delivered[self.last_flow].sum())
-        self._inflight = False
+        self.pipeline_overlap_ns += t0 - self._launch_wall
+        try:
+            # blocks iff still computing; a failure inside the in-flight
+            # dispatch RAISES here (nothing downstream catches it) — the
+            # slot is released either way so state stays consistent
+            flush = np.asarray(self._flush_handle)
+        finally:
+            self._flush_handle = None
+            self._inflight = False
         t1 = _wt.perf_counter_ns()
         self.device_ns += t1 - t0
+        if self.mode == "device":
+            self.device_calls += 1              # the flush read
+        from ..ops.torcells_device import CELL_WIRE_BYTES, parse_flush
+        (forwards, delivered_sum, done_chains, done_steps, node_idx,
+         node_delta) = parse_flush(flush, self.n_chains, self.n_nodes)
+        self.total_forwards += forwards
+        self._cells_delivered_seen = delivered_sum
 
-        # trackers: per-node spent-byte deltas — an egress node's spend is
-        # the host's tx, an ingress (stage-4) node's spend is its rx
-        sent_delta = node_sent - self._prev_node_sent
-        self._prev_node_sent = node_sent
-        from ..ops.torcells_device import CELL_WIRE_BYTES
-        for i in np.flatnonzero(sent_delta):
+        # trackers: per-node spent-byte deltas, delta-compacted on device —
+        # an egress node's spend is the host's tx, an ingress (delivering
+        # hop) node's spend is its rx
+        for i, nbytes in zip(node_idx.tolist(), node_delta.tolist()):
             tr = self.node_hosts[i].tracker
-            nbytes = int(sent_delta[i])
             ncells = nbytes // CELL_WIRE_BYTES
             c = tr.out_remote if self.node_kind[i] == "tx" else tr.in_remote
             c.packets_total += ncells
@@ -664,20 +768,22 @@ class DeviceTrafficPlane:
         # wake completed clients: BOTH chains (download 2c, upload 2c+1)
         # must have delivered; wake at the later completion step
         # (deterministic: ticks from the kernel, clamped to the barrier).
-        # Mask in numpy first — Python iterations only for newly complete
-        # circuits, not O(circuits) per round.
-        barrier = engine.scheduler.window_end
-        done_last = done_tick[self.last_flow]
-        d_steps, u_steps = done_last[0::2], done_last[1::2]
-        ready = (d_steps >= 0) & ((u_steps >= 0) | ~self._has_upload)
-        for circ in np.flatnonzero(ready):
-            circ = int(circ)
-            if circ in self._done:
-                continue
-            step = max(int(d_steps[circ]), int(u_steps[circ]))
-            wake = max((step + 1) * TICK_NS * self.granule, barrier)
-            self._done[circ] = wake
-            self._schedule_wake(engine, circ, wake)
+        # Only the chains that newly completed THIS dispatch arrive in the
+        # flush buffer — O(completions), not O(circuits), per collect.
+        if len(done_chains):
+            barrier = engine.scheduler.window_end
+            self._chain_done[done_chains] = done_steps
+            for circ in sorted({int(ch) >> 1 for ch in done_chains}):
+                if circ in self._done:
+                    continue
+                d = int(self._chain_done[2 * circ])
+                u = int(self._chain_done[2 * circ + 1])
+                if d < 0 or (u < 0 and self._has_upload[circ]):
+                    continue
+                step = max(d, u)
+                wake = max((step + 1) * TICK_NS * self.granule, barrier)
+                self._done[circ] = wake
+                self._schedule_wake(engine, circ, wake)
         self.host_ns += _wt.perf_counter_ns() - t1
 
     def _schedule_wake(self, engine, circuit: int, when: int) -> None:
@@ -724,6 +830,11 @@ class DeviceTrafficPlane:
             # device_sec = blocking materialization of dispatch summaries
             "plane_host_sec": round(self.host_ns / 1e9, 3),
             "plane_device_sec": round(self.device_ns / 1e9, 3),
+            # pipeline introspection: host<->device interactions (dispatch +
+            # inject upload + flush read; <= 3 per dispatch) and the wall
+            # the in-flight dispatch computed behind host round work
+            "device_calls": self.device_calls,
+            "pipeline_overlap_sec": round(self.pipeline_overlap_ns / 1e9, 3),
         }
 
 

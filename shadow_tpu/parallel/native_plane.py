@@ -48,14 +48,23 @@ _MOD_TRIED = False
 
 
 def _load_module():
-    """Import the extension from shadow_tpu/native/, building on demand."""
+    """Import the extension from shadow_tpu/native/, building on demand.
+
+    A committed-but-stale .so is rebuilt, not silently loaded: when
+    native/dataplane.cc is newer than the extension, ``make`` runs (a no-op
+    when the artifact is actually current) so a source edit can never be
+    masked by an old binary.  If the rebuild fails while a stale .so
+    exists, loading it would silently execute outdated code — refuse."""
     global _MOD, _MOD_TRIED
     if _MOD_TRIED:
         return _MOD
     _MOD_TRIED = True
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(here, "native", "_shadow_dataplane.so")
-    if not os.path.exists(path):
+    src = os.path.join(here, "..", "native", "dataplane.cc")
+    stale = (os.path.exists(path) and os.path.exists(src)
+             and os.path.getmtime(src) > os.path.getmtime(path))
+    if not os.path.exists(path) or stale:
         try:
             subprocess.run(["make", "-s", os.path.join("..", "shadow_tpu",
                                                        "native",
@@ -63,18 +72,47 @@ def _load_module():
                            cwd=os.path.join(here, "..", "native"),
                            check=True, timeout=120)
         except Exception:
+            if not os.path.exists(path):
+                return None
+            # staleness is LOUD but not fatal when the rebuild is
+            # impossible (no toolchain / read-only checkout): git does not
+            # preserve mtimes, so a fresh clone can look "stale" while the
+            # committed extension is perfectly good — losing the native
+            # plane over that would be worse than warning
+            get_logger().warning(
+                "native-plane",
+                "_shadow_dataplane.so is older than dataplane.cc and the "
+                "rebuild failed; loading the existing extension anyway "
+                "(run `make -C native` to be sure it is current)")
+    _MOD = _try_import(path)
+    if _MOD is None:
+        # a committed .so built on another box may not load here (e.g. a
+        # newer libstdc++ than this container ships): force-rebuild from
+        # source (make -B: mtimes say "current" but the binary is unusable)
+        # and retry — same never-trust-a-stale-binary rule as above.  The
+        # existing file is only replaced if the build succeeds, so a box
+        # without a toolchain keeps its checkout intact.
+        try:
+            subprocess.run(["make", "-s", "-B",
+                            os.path.join("..", "shadow_tpu", "native",
+                                         "_shadow_dataplane.so")],
+                           cwd=os.path.join(here, "..", "native"),
+                           check=True, timeout=120)
+        except Exception:
             return None
-    if not os.path.exists(path):
-        return None
+        _MOD = _try_import(path)
+    return _MOD
+
+
+def _try_import(path: str):
     try:
         spec = importlib.util.spec_from_file_location("_shadow_dataplane",
                                                       path)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        _MOD = mod
+        return mod
     except Exception:
-        _MOD = None
-    return _MOD
+        return None
 
 
 def native_available() -> bool:
@@ -431,9 +469,20 @@ class NativePlane:
             tracker.drops += drop_delta
 
     def iface_digest(self, hid: int) -> dict:
-        """{ip: (send_remaining, recv_remaining)} for checkpoint."""
+        """{ip: (send_remaining, recv_remaining)} for checkpoint.
+
+        The C plane models exactly two interfaces per host (lo + eth, the
+        reference's layout); if the Python host ever grows more, this digest
+        would silently omit them and diverge from the Python plane's — fail
+        loudly instead."""
         from ..routing.address import LOCALHOST_IP
         host = self.engine.hosts[hid]
+        if len(host.interfaces) != 2:
+            raise RuntimeError(
+                f"native plane: host {host.name!r} has "
+                f"{len(host.interfaces)} interfaces; the C plane digests "
+                "exactly two (lo + eth) — a topology change here needs a "
+                "matching dataplane.cc iface_state extension")
         lo_s, lo_r, eth_s, eth_r = self.c.iface_state(hid)
         return {LOCALHOST_IP: (lo_s, lo_r), host.ip: (eth_s, eth_r)}
 

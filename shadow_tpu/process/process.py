@@ -110,6 +110,10 @@ class Process:
         self.app_state: Any = None  # apps may park observable state here (tests)
         self._continue_scheduled = False
         self._signal_fds: List = []   # open SignalFD descriptors (delivery)
+        # the kernel's per-process pending-signal set, shared by every
+        # signalfd this process opens (descriptor/signalfd.py)
+        from ..descriptor.signalfd import SharedSignalPending
+        self._signal_pending = SharedSignalPending()
         host.add_process(self)
 
     # -- lifecycle ---------------------------------------------------------
@@ -591,7 +595,7 @@ class SyscallAPI:
         from ..descriptor.signalfd import SignalFD
         host = self.host
         handle = host.allocate_handle()
-        sfd = SignalFD(host, handle, mask)
+        sfd = SignalFD(host, handle, mask, shared=self.process._signal_pending)
         host.register_descriptor(sfd)
         self.process._signal_fds.append(sfd)
         return handle
@@ -599,16 +603,14 @@ class SyscallAPI:
     def deliver_signal(self, signo: int) -> int:
         """Route a virtual signal raised by this process (raise()/kill() on
         the virtual pid).  signalfd(2) semantics: a blocked pending signal
-        is ONE process-wide instance, consumed by a single read — so it is
-        queued on the FIRST open matching signalfd, not fanned out to all
-        of them.  Returns 1 on a match, 0 = caller may fall back to its
-        recorded handler (which is what the shim does)."""
-        live = [s for s in self.process._signal_fds if not s.closed]
-        self.process._signal_fds = live
-        for s in live:
-            if s.deliver(signo):
-                return 1
-        return 0
+        is ONE process-wide instance visible on EVERY open matching
+        signalfd (all of them become readable — two epoll loops with
+        overlapping masks both wake), and the FIRST read consumes it.
+        Returns the number of matching signalfds; 0 = caller may fall back
+        to its recorded handler (which is what the shim does).  Routing and
+        liveness pruning live in the shared store (SharedSignalPending) —
+        the process's _signal_fds list is just the descriptor registry."""
+        return self.process._signal_pending.deliver(signo)
 
     def timerfd_settime(self, fd: int, initial_sec: float, interval_sec: float = 0.0) -> None:
         self._sock(fd).arm(stime.from_seconds(initial_sec),

@@ -1,0 +1,190 @@
+"""Async device-pipeline gates (stage -> launch -> collect).
+
+1. The pipelined plane (default: dispatch launched at the top of the round,
+   collected at the next loop iteration while the host executes the round
+   in between) produces BIT-IDENTICAL digests to the serial plane
+   (--device-plane-sync blocks on the dispatch at launch) across 2+
+   overlapped dispatch rounds — the engine commits round N's plane state
+   before round N+1's staged injections are folded in, so overlap can never
+   reorder anything.
+2. An exception raised inside an in-flight dispatch surfaces at COLLECT
+   time (consume materializes the flush buffer), not swallowed.
+3. The packed flush buffer drives consume: exactly one small device read
+   per dispatch (device_calls <= 3 including the dispatch and any inject
+   upload).
+4. signalfd fan-out (satellite): a blocked pending signal wakes EVERY
+   matching signalfd; the first read consumes the shared instance.
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.checkpoint import state_digest
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+from shadow_tpu.tools import workloads
+
+
+def _run(sync: bool, stop: int = 60, mode: str = "device"):
+    cfg = configuration.parse_xml(workloads.tor_network(
+        8, n_clients=5, n_servers=2, stoptime=stop,
+        stream_spec="512:20200", device_data=True))
+    cfg.stop_time_sec = stop
+    ctrl = Controller(Options(scheduler_policy="global", workers=0, seed=3,
+                              stop_time_sec=stop, log_level="warning",
+                              device_plane=mode, device_plane_sync=sync),
+                      cfg)
+    assert ctrl.run() == 0
+    return ctrl
+
+
+def test_pipelined_vs_serial_digest_parity():
+    """Pipelined vs serial device plane: identical digests and identical
+    plane summaries, with at least two dispatches in flight across round
+    boundaries (the 2+-round overlap depth the launch/collect split
+    creates)."""
+    piped = _run(sync=False)
+    serial = _run(sync=True)
+    pa = piped.engine.device_plane
+    pb = serial.engine.device_plane
+    assert pa.dispatches >= 2, "workload too small to overlap dispatches"
+    assert pa.dispatches == pb.dispatches
+    assert pa.total_forwards == pb.total_forwards
+    assert pa.stats()["completed"] == pb.stats()["completed"] == 5
+    # the async run actually overlapped (wall elapsed between launch and
+    # collect); the sync run blocked at launch by definition
+    assert pa.pipeline_overlap_ns > 0
+    assert state_digest(piped.engine) == state_digest(serial.engine)
+
+
+def test_collect_is_one_packed_read_per_dispatch():
+    """Transfer-chatter gate: the plane's host<->device interactions are
+    bounded by 3 per dispatch (kernel call + flush read + at most one
+    inject upload)."""
+    ctrl = _run(sync=False, stop=120)
+    plane = ctrl.engine.device_plane
+    st = plane.stats()
+    assert st["completed"] == st["circuits"]
+    assert plane.dispatches > 0
+    assert plane.device_calls <= 3 * plane.dispatches, \
+        (f"{plane.device_calls} device calls for {plane.dispatches} "
+         "dispatches (> 3 per dispatch)")
+
+
+class _PoisonFlush:
+    """Materializes like an in-flight device array whose computation
+    failed."""
+
+    def __array__(self, *a, **k):
+        raise RuntimeError("boom-in-flight")
+
+
+def test_inflight_exception_surfaces_at_collect(monkeypatch):
+    """A failure inside the launched dispatch must raise at consume()
+    (where the flush buffer materializes) — never be swallowed."""
+    xml = workloads.tor_network(8, n_clients=2, n_servers=1, stoptime=10,
+                                stream_spec="512:5120", device_data=True)
+    cfg = configuration.parse_xml(xml)
+    ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                              stop_time_sec=10, log_level="warning",
+                              tpu_devices=1), cfg)
+    ctrl.setup()
+    from shadow_tpu.parallel.device_plane import build_plane_from_engine
+    plane = build_plane_from_engine(ctrl.engine, mode="device")
+    assert plane is not None and plane._shard is None
+    eng = ctrl.engine
+    eng.device_plane = plane
+
+    import shadow_tpu.ops.torcells_device as td
+    real = td.step_window_flush_for_backend()
+
+    def poisoned(*args, **kw):
+        out = real(*args, **kw)
+        return (*out[:9], _PoisonFlush())
+
+    monkeypatch.setattr(td, "step_window_flush_for_backend",
+                        lambda: poisoned)
+    plane.activate(plane.specs[0].client_name)
+    eng.scheduler.window_end = 10 ** 9
+    plane.advance(eng)
+    assert plane._inflight
+    with pytest.raises(RuntimeError, match="boom-in-flight"):
+        plane.consume(eng)
+    assert not plane._inflight
+
+
+def test_signalfd_shared_pending_fanout():
+    """satellite: deliver_signal semantics — ALL matching signalfds become
+    readable on a blocked pending signal; the first read consumes the ONE
+    process-wide instance and the others stop being readable."""
+    from shadow_tpu.descriptor.base import S_READABLE
+    from shadow_tpu.descriptor.signalfd import SharedSignalPending, SignalFD
+
+    shared = SharedSignalPending()
+    mask = 1 << (15 - 1)          # SIGTERM
+    a = SignalFD(None, 3, mask, shared=shared)
+    b = SignalFD(None, 4, mask, shared=shared)
+    c = SignalFD(None, 5, 1 << (10 - 1), shared=shared)   # SIGUSR1 only
+
+    assert shared.deliver(15) == 2          # both matching fds woke
+    assert a.has_status(S_READABLE) and b.has_status(S_READABLE)
+    assert not c.has_status(S_READABLE)
+
+    rec = a.read_siginfo()                  # first read wins
+    assert rec is not None and rec[0] == 15
+    assert not a.has_status(S_READABLE)
+    assert not b.has_status(S_READABLE), \
+        "shared pending instance must vanish from the sibling on read"
+    assert b.read_siginfo() is None
+
+    # coalescing still holds through the shared store: two raises of a
+    # standard signal collapse to one pending instance
+    shared.deliver(15)
+    shared.deliver(15)
+    assert a.read_siginfo() is not None
+    assert b.read_siginfo() is None
+
+    # an unmatched signal reports 0 matching fds (handler fallback)
+    assert shared.deliver(2) == 0
+
+    # a signalfd opened while a matching signal is already pending is
+    # readable from the start (signalfd(2) reports the pending set), and a
+    # coalesced re-raise still wakes fds opened after the original raise
+    shared.deliver(15)
+    d = SignalFD(None, 6, mask, shared=shared)
+    assert d.has_status(S_READABLE)
+    e_mask_fd = SignalFD(None, 7, mask, shared=shared)
+    assert e_mask_fd.has_status(S_READABLE)
+    assert d.read_siginfo() is not None
+    assert not e_mask_fd.has_status(S_READABLE)
+
+
+def test_signalfd_process_route_via_api():
+    """deliver_signal through the process API returns the matching-fd count
+    and routes through the shared store (regression for the first-match
+    behavior)."""
+    from shadow_tpu.core.logger import SimLogger, set_logger
+    set_logger(SimLogger(level="warning"))
+    xml = ('<shadow stoptime="5"><plugin id="echo" path="python:echo" />'
+           '<host id="h"><process plugin="echo" starttime="1" '
+           'arguments="udp server 9000" /></host></shadow>')
+    cfg = configuration.parse_xml(xml)
+    ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                              stop_time_sec=5, log_level="warning"), cfg)
+    ctrl.setup()
+    host = ctrl.engine.host_by_name("h")
+    proc = host.processes[0]
+    api = proc.api
+    mask = 1 << (15 - 1)
+    fd1 = api.signalfd_create(mask)
+    fd2 = api.signalfd_create(mask)
+    assert fd1 != fd2
+    assert api.deliver_signal(15) == 2
+    # both descriptors readable; one read consumes the shared instance
+    d1, d2 = proc._signal_fds
+    from shadow_tpu.descriptor.base import S_READABLE
+    assert d1.has_status(S_READABLE) and d2.has_status(S_READABLE)
+    assert d2.read_siginfo() is not None
+    assert d1.read_siginfo() is None
+    assert not d1.has_status(S_READABLE)

@@ -719,7 +719,7 @@ def native_cpp_bin(tmp_path_factory):
     out = tmp_path_factory.mktemp("nativecpp") / "cppapp"
     subprocess.run(["g++", "-O1", "-std=c++17", "-o", str(out),
                     os.path.join(REPO, "tests", "native_src",
-                                 "testapp_cpp.cc")],
+                                 "testapp_cpp.cc"), "-lpthread"],
                    check=True, capture_output=True)
     return str(out)
 

@@ -137,7 +137,8 @@ def test_half_close_native_plane(tmp_path):
                    capture_output=True)
     binary = str(tmp_path / "testapp")
     subprocess.run(["gcc", "-O1", "-o", binary,
-                    os.path.join(REPO, "tests", "native_src", "testapp.c")],
+                    os.path.join(REPO, "tests", "native_src", "testapp.c"),
+                    "-lpthread"],
                    check=True, capture_output=True)
     xml = textwrap.dedent(f"""\
         <shadow stoptime="120">
