@@ -296,6 +296,12 @@ def _run_sim(xml, policy: str, workers: int, stop: int, **opt_kw) -> dict:
         "wall_sec": round(wall, 2),
         "host_exec_sec": round(eng.host_exec_ns / 1e9, 2),
         "flush_sec": round(eng.flush_ns / 1e9, 2),
+        # supervision columns (ISSUE 2): recoveries must be 0 in a healthy
+        # bench run, and the watchdog bookkeeping (guard-thread spawn per
+        # dispatch collect; the waits themselves are the dispatch's own
+        # cost) must stay pinned at ~0
+        "recoveries": eng.supervision.recoveries,
+        "watchdog_overhead_sec": round(eng.supervision.overhead_ns / 1e9, 4),
     }
     if eng.native_plane is not None:
         _sched, execd, _drops, _last = eng.native_plane.counters()
@@ -651,6 +657,16 @@ def main() -> None:
         "star100_device_traffic_fraction":
             sims.get("star100_device_plane",
                      {}).get("device_traffic_fraction"),
+        # supervision steady-state cost: recoveries summed over every run
+        # this round; watchdog_overhead_sec from tor200_device_plane (the
+        # always-measured config whose dispatch guard threads every
+        # collect — tor10k only runs when the reference topology exists).
+        # Both must be ~0 in a healthy round.
+        "recoveries": sum(
+            r.get("recoveries", 0) for r in sims.values()
+            if isinstance(r, dict)),
+        "watchdog_overhead_sec":
+            sims.get("tor200_device_plane", {}).get("watchdog_overhead_sec"),
         "gates_enforced": True,
     }
     blob = json.dumps(summary)

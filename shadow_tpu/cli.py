@@ -9,6 +9,7 @@ Usage:
 
 from __future__ import annotations
 
+import os
 import sys
 import textwrap
 from typing import List, Optional
@@ -46,6 +47,20 @@ BUILTIN_TEST_CONFIG = textwrap.dedent("""\
 def main(argv: Optional[List[str]] = None) -> int:
     opts = parse_args(argv)
     set_logger(SimLogger(level=opts.log_level))
+    # fail fast on supervision/recovery flags that could only error after
+    # minutes of setup: a bad --resume target or malformed --fault-inject
+    if opts.resume_path and not (os.path.isfile(opts.resume_path)
+                                 or os.path.isdir(opts.resume_path)):
+        print(f"error: --resume target not found: {opts.resume_path}",
+              file=sys.stderr)
+        return 2
+    if opts.fault_inject:
+        from .core.supervision import parse_fault_inject
+        try:
+            parse_fault_inject(opts.fault_inject)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     if opts.test_mode:
         cfg = configuration.parse_xml(BUILTIN_TEST_CONFIG)
     elif opts.config_path:

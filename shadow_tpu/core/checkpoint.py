@@ -124,11 +124,20 @@ def state_digest(engine) -> str:
 
 def save_state(state: Dict, path: str, options_info: Dict) -> str:
     """Stamp ``state`` with its digest + run options and pickle it to disk
-    (shared by the engine-side writer and the procs parent)."""
+    (shared by the engine-side writer and the procs parent).
+
+    The write is atomic (tmp + rename): a run SIGKILLed mid-write can never
+    leave a truncated file under the snapshot name, so every file a resume
+    scan sees is either complete or absent — the property crash recovery
+    leans on."""
     state["digest"] = digest_of_state(state)
     state["options"] = options_info
-    with open(path, "wb") as f:
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         pickle.dump(state, f, protocol=4)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
     return state["digest"]
 
 
@@ -141,9 +150,88 @@ def save_snapshot(engine, path: str) -> str:
     })
 
 
-def load_snapshot(path: str) -> Dict:
+def load_snapshot(path: str, verify: bool = False) -> Dict:
+    """Load a snapshot; ``verify=True`` additionally recomputes the digest
+    over the carried state and raises ``ValueError`` on mismatch — the
+    defense against a corrupt/tampered file silently seeding a resume."""
     with open(path, "rb") as f:
-        return pickle.load(f)
+        snap = pickle.load(f)
+    if verify:
+        core = {k: v for k, v in snap.items()
+                if k not in ("digest", "options")}
+        if digest_of_state(core) != snap.get("digest"):
+            raise ValueError(f"snapshot {path!r} is corrupt: stored digest "
+                             "does not match its state")
+    return snap
+
+
+def find_last_good_snapshot(path: str):
+    """Resolve ``--resume PATH``: a snapshot file loads (digest-verified);
+    a directory yields the newest snapshot that verifies, skipping corrupt
+    ones with a logged warning (a crash can outrun fsync on a shared fs;
+    'resume from the last GOOD snapshot' is the contract).  Returns
+    ``(snapshot, resolved_path)``."""
+    from .logger import get_logger
+    if os.path.isdir(path):
+        # every candidate is loaded + digest-verified (no early exit on
+        # name order: the interval- and round-triggered naming schemes
+        # interleave, so only the carried sim_time orders them — and a
+        # resume happens once per crash, so the full scan is cheap where
+        # it matters)
+        candidates = [p for p in os.listdir(path) if p.endswith(".ckpt")]
+        best = None
+        for name in candidates:
+            full = os.path.join(path, name)
+            try:
+                snap = load_snapshot(full, verify=True)
+            except (ValueError, OSError, pickle.UnpicklingError, EOFError) as e:
+                get_logger().warning(
+                    "checkpoint", f"skipping bad snapshot {full}: {e}")
+                continue
+            if best is None or snap["sim_time_ns"] > best[0]["sim_time_ns"]:
+                best = (snap, full)
+        if best is None:
+            raise FileNotFoundError(
+                f"--resume {path!r}: no loadable snapshot found")
+        return best
+    return load_snapshot(path, verify=True), path
+
+
+def verify_resume_boundary(snap: Dict, window_start_ns: int, digest: str,
+                           domain: str) -> None:
+    """The --resume gate, shared by the serial engine and the sharded
+    parent: the replay must land on the EXACT round boundary the snapshot
+    was written at, in the EXACT state.  A time overshoot or digest
+    mismatch means the config/seed diverged from the snapshotted run —
+    continuing would silently simulate something else, so abort loudly."""
+    if window_start_ns != snap["sim_time_ns"]:
+        raise RuntimeError(
+            f"--resume verification failed: the replay reached round "
+            f"boundary t={window_start_ns / 1e9:.6f}s but the snapshot was "
+            f"written at t={snap['sim_time_ns'] / 1e9:.6f}s — the "
+            "config/seed does not match the snapshotted run")
+    if digest != snap["digest"]:
+        raise RuntimeError(
+            f"--resume verification failed at "
+            f"t={window_start_ns / 1e9:.3f}s: replayed state digest "
+            f"{digest[:16]}… != snapshot digest {snap['digest'][:16]}… — "
+            "the config/seed does not match the snapshotted run")
+    from .logger import get_logger
+    get_logger().message(
+        domain,
+        f"resume verified at t={window_start_ns / 1e9:.3f}s (digest "
+        f"{digest[:16]}…): continuing past the snapshot boundary")
+
+
+def warn_resume_unreached(snap: Dict, domain: str) -> None:
+    """Logged at end of run when the snapshot boundary was never reached
+    (snapshot time past the run's last round)."""
+    from .logger import get_logger
+    get_logger().warning(
+        domain,
+        "--resume snapshot boundary was never reached (snapshot "
+        f"t={snap['sim_time_ns'] / 1e9:.3f}s is past this run's last "
+        "round) — resume NOT verified")
 
 
 def resume_digest(snapshot: Dict, engine) -> bool:
@@ -153,32 +241,63 @@ def resume_digest(snapshot: Dict, engine) -> bool:
 
 
 class CheckpointWriter:
-    """Engine-side round-boundary hook: writes a snapshot every
-    ``interval_sec`` of virtual time into ``out_dir``."""
+    """Round-boundary snapshot cadence: every ``interval_sec`` of virtual
+    time and/or every ``every_rounds`` engine rounds (either may be 0 =
+    off).  Engine-agnostic on purpose — the serial engine and the sharded
+    parent (parallel/procs.py) share one instance shape, so their write
+    boundaries (and therefore snapshot digests) line up exactly.
 
-    def __init__(self, interval_sec: int, out_dir: str):
+    ``rounds_done`` everywhere below is the number of COMPLETED rounds at
+    the round-boundary hook, i.e. the engine's counter before it increments
+    for the current round — the same value the state digest carries."""
+
+    def __init__(self, interval_sec: int, out_dir: str,
+                 every_rounds: int = 0):
         self.interval_ns = interval_sec * stime.SIM_TIME_SEC
+        self.every_rounds = int(every_rounds)
         self.out_dir = out_dir
-        self.next_at = self.interval_ns
+        self.next_at = self.interval_ns if interval_sec > 0 else None
+        self.next_round = self.every_rounds if self.every_rounds > 0 else None
         self.written = []
 
-    def due(self, engine) -> bool:
-        """True iff maybe_write would snapshot this round — checked by the
-        engine BEFORE forcing an early flush consume, so a run with
-        --checkpoint-interval keeps the async launch/consume overlap on all
-        the rounds that don't actually write."""
-        return engine.scheduler.window_start >= self.next_at
+    def due(self, window_start_ns: int, rounds_done: int) -> bool:
+        """True iff this round boundary writes — checked by the engine
+        BEFORE forcing an early flush consume, so a checkpointing run keeps
+        the async launch/consume overlap on all the rounds that don't
+        actually write."""
+        if self.next_at is not None and window_start_ns >= self.next_at:
+            return True
+        return (self.next_round is not None
+                and rounds_done + 1 >= self.next_round)
+
+    def path_for(self, window_start_ns: int, rounds_done: int) -> str:
+        """Zero-padded so lexicographic and chronological order agree.
+        Round-triggered writes are stamped with the round number (several
+        rounds can share one sim-second); interval writes keep the
+        sim-second name."""
+        if self.next_round is not None and rounds_done + 1 >= self.next_round:
+            return os.path.join(self.out_dir,
+                                f"checkpoint_r{rounds_done + 1:08d}.ckpt")
+        sim_sec = window_start_ns // stime.SIM_TIME_SEC
+        return os.path.join(self.out_dir, f"checkpoint_{sim_sec:08d}.ckpt")
+
+    def mark_written(self, window_start_ns: int, rounds_done: int,
+                     path: str) -> None:
+        self.written.append(path)
+        while self.next_at is not None and self.next_at <= window_start_ns:
+            self.next_at += self.interval_ns
+        while self.next_round is not None \
+                and self.next_round <= rounds_done + 1:
+            self.next_round += self.every_rounds
 
     def maybe_write(self, engine) -> Optional[str]:
+        """Engine-side convenience: write if due, return the path."""
         now = engine.scheduler.window_start
-        if now < self.next_at:
+        rounds = engine.rounds_executed
+        if not self.due(now, rounds):
             return None
         os.makedirs(self.out_dir, exist_ok=True)
-        sim_sec = now // stime.SIM_TIME_SEC
-        # zero-padded so lexicographic and chronological order agree
-        path = os.path.join(self.out_dir, f"checkpoint_{sim_sec:08d}.ckpt")
+        path = self.path_for(now, rounds)
         save_snapshot(engine, path)
-        self.written.append(path)
-        while self.next_at <= now:
-            self.next_at += self.interval_ns
+        self.mark_written(now, rounds, path)
         return path

@@ -95,11 +95,34 @@ class Engine:
         # C data plane (parallel/native_plane.py); set by attach() when the
         # run is eligible — protocol/interface/hop events then execute in C
         self.native_plane = None
+        # supervision ledger: watchdog fires, degradations, resume state
+        # (core/supervision.py) — every fault seam reports here
+        from .supervision import SupervisionStats
+        self.supervision = SupervisionStats()
         self._checkpointer = None
-        if getattr(options, "checkpoint_interval_sec", 0) > 0:
+        if getattr(options, "checkpoint_interval_sec", 0) > 0 \
+                or getattr(options, "checkpoint_every_rounds", 0) > 0:
             from .checkpoint import CheckpointWriter
             self._checkpointer = CheckpointWriter(
-                options.checkpoint_interval_sec, options.checkpoint_dir)
+                options.checkpoint_interval_sec, options.checkpoint_dir,
+                getattr(options, "checkpoint_every_rounds", 0))
+        # --resume: deterministic replay to the snapshot's virtual time,
+        # digest-verified there (_verify_resume), then the run continues —
+        # recovery leans on the determinism kernel, so restart-after-crash
+        # is exact rather than approximate
+        self._resume_snapshot = None
+        resume = getattr(options, "resume_path", None)
+        if resume:
+            from .checkpoint import find_last_good_snapshot
+            snap, resolved = find_last_good_snapshot(resume)
+            self._resume_snapshot = snap
+            self.supervision.resume_path = resolved
+            get_logger().message(
+                "engine",
+                f"resuming from {resolved} "
+                f"(t={snap['sim_time_ns'] / 1e9:.3f}s, "
+                f"rounds={snap['rounds']}): replaying to the snapshot "
+                "boundary, digest-verified there")
 
     # -- registry ----------------------------------------------------------
     def add_host(self, host, requested_ip: Optional[int] = None) -> None:
@@ -199,6 +222,9 @@ class Engine:
     # -- boot events -------------------------------------------------------
     def schedule_boot(self) -> None:
         """Host boots + process starts at t=0 (host_boot :372-390)."""
+        # commit the host->worker assignment (seeded Fisher-Yates shuffle,
+        # reference scheduler.c:437-472) now that every host is registered
+        self.scheduler.finalize_hosts()
         boot_worker = Worker(0, self)
         set_current_worker(boot_worker)
         try:
@@ -283,6 +309,12 @@ class Engine:
                     f"{_walltime.monotonic() - self.sim_start_wall:.3f}s wall "
                     f"(host_exec {self.host_exec_ns / 1e9:.3f}s, "
                     f"flush {self.flush_ns / 1e9:.3f}s)")
+        if self._resume_snapshot is not None:
+            from .checkpoint import warn_resume_unreached
+            warn_resume_unreached(self._resume_snapshot, "engine")
+        if self.supervision.recoveries:
+            log.message("engine",
+                        f"supervision: {self.supervision.summary()}")
         if leaks:
             log.message("engine", self.counters.report())
         log.flush()
@@ -299,7 +331,13 @@ class Engine:
         flush = getattr(self.scheduler.policy, "flush_round", None)
         if flush is not None:
             flush(self)
-        if self._checkpointer is not None and self._checkpointer.due(self):
+        ws = self.scheduler.window_start
+        if self._resume_snapshot is not None \
+                and ws >= self._resume_snapshot["sim_time_ns"]:
+            self._consume_flush()
+            self._verify_resume(ws)
+        if self._checkpointer is not None \
+                and self._checkpointer.due(ws, self.rounds_executed):
             # snapshots must include every in-flight delivery: consume first
             # (only on rounds that actually write — an unconditional consume
             # here would forfeit the async launch/consume overlap for the
@@ -308,6 +346,15 @@ class Engine:
             path = self._checkpointer.maybe_write(self)
             if path:
                 get_logger().message("engine", f"checkpoint written: {path}")
+
+    def _verify_resume(self, window_start: int) -> None:
+        from .checkpoint import (collect_state, digest_of_state,
+                                 verify_resume_boundary)
+        snap, self._resume_snapshot = self._resume_snapshot, None
+        verify_resume_boundary(snap, window_start,
+                               digest_of_state(collect_state(self)),
+                               "engine")
+        self.supervision.resume_verified = True
 
     def _consume_flush(self) -> None:
         """Materialize + push any async flush results (no-op otherwise)."""

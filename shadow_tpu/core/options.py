@@ -84,7 +84,23 @@ class Options:
                                          # to the pipelined default)
     # Checkpointing (new capability; absent in the reference — SURVEY.md §5)
     checkpoint_interval_sec: int = 0     # --checkpoint-interval (0 = off)
+    checkpoint_every_rounds: int = 0     # --checkpoint-every N rounds (0 = off)
     checkpoint_dir: str = "shadow-checkpoints"  # --checkpoint-dir
+    resume_path: Optional[str] = None    # --resume: snapshot file or dir;
+                                         # replay-verify to the last good
+                                         # snapshot's digest, then continue
+    # Supervision / fault recovery (core/supervision.py)
+    plugin_watchdog_sec: float = 0.0     # wall-clock silence budget per
+                                         # native plugin; 0 = module default
+                                         # (SHADOW_TPU_PLUGIN_STALL_TIMEOUT,
+                                         # 300 s)
+    device_watchdog_sec: float = 300.0   # timeout on collecting an in-flight
+                                         # device dispatch (0 = unbounded)
+    shard_watchdog_sec: float = 0.0      # parent aborts if a LIVE shard is
+                                         # silent this long (0 = only dead-
+                                         # shard detection, always on)
+    fault_inject: str = ""               # deterministic fault harness
+                                         # (supervision.parse_fault_inject)
     # Misc
     config_path: Optional[str] = None
     test_mode: bool = False              # --test builtin example
@@ -118,8 +134,38 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--checkpoint-interval", type=int, default=0,
                    dest="checkpoint_interval_sec",
                    help="write a state snapshot every N virtual seconds")
+    p.add_argument("--checkpoint-every", type=int, default=0,
+                   dest="checkpoint_every_rounds",
+                   help="write a state snapshot every N engine rounds "
+                        "(composes with --checkpoint-interval; 0 = off)")
     p.add_argument("--checkpoint-dir", default="shadow-checkpoints",
                    dest="checkpoint_dir")
+    p.add_argument("--resume", default=None, dest="resume_path",
+                   help="resume from a snapshot file (or the newest good "
+                        "snapshot in a checkpoint dir): deterministic "
+                        "replay to the snapshot's virtual time, digest-"
+                        "verified there, then the run continues")
+    p.add_argument("--plugin-watchdog-sec", type=float, default=0.0,
+                   dest="plugin_watchdog_sec",
+                   help="kill a native plugin silent on its RPC socketpair "
+                        "for this many wall seconds; its simulated process "
+                        "is marked exited and the run continues (0 = the "
+                        "SHADOW_TPU_PLUGIN_STALL_TIMEOUT default, 300 s)")
+    p.add_argument("--device-watchdog-sec", type=float, default=300.0,
+                   dest="device_watchdog_sec",
+                   help="abandon an in-flight device-plane dispatch that "
+                        "has not completed after this many wall seconds; "
+                        "the window replays on the numpy twin and the "
+                        "backend is demoted (0 = wait forever)")
+    p.add_argument("--shard-watchdog-sec", type=float, default=0.0,
+                   dest="shard_watchdog_sec",
+                   help="abort with a diagnostic if a live shard is silent "
+                        "this long at a round barrier (0 = no wall limit; "
+                        "dead-shard detection is always on)")
+    p.add_argument("--fault-inject", default="", dest="fault_inject",
+                   help="deterministic fault-injection harness (tests): "
+                        "device-dispatch:N | device-dispatch-hang:N | "
+                        "plugin-stall:NAME:NREQ | shard-exit:SID:ROUND")
     p.add_argument("--interface-batch", type=int, default=1, dest="interface_batch_ms")
     p.add_argument("--router-queue", choices=ROUTER_QUEUE_KINDS, default="codel",
                    dest="router_queue")

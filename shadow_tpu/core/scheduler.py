@@ -617,6 +617,8 @@ class Scheduler:
         self.window_end = 1
         self._next_host_worker = 0
         self._host_count = 0
+        self._pending_hosts: List = []
+        self._hosts_finalized = False
         self._running = True
         self._threads: List[threading.Thread] = []
         self._workers: List = []
@@ -625,12 +627,39 @@ class Scheduler:
 
     # -- host assignment (scheduler.c:437-531 random shuffle) --------------
     def add_host(self, host) -> None:
-        # deterministic round-robin assignment; the reference shuffles with
-        # the scheduler seed — round-robin is equally balanced and stable
+        """Hosts registered before finalize_hosts() are collected and dealt
+        to workers in seeded-shuffle order at boot; a host added after boot
+        (none today) falls back to plain round-robin."""
+        if self._hosts_finalized:
+            self._assign(host)
+            return
+        self._pending_hosts.append(host)
+
+    def _assign(self, host) -> None:
         wid = self._next_host_worker
         self._next_host_worker = (self._next_host_worker + 1) % self.n_threads
         self.policy.add_host(host, wid)
         self._host_count += 1
+
+    def finalize_hosts(self) -> None:
+        """Commit the host->worker assignment: a Fisher-Yates shuffle keyed
+        off the simulation seed (the reference shuffles its host list with
+        the scheduler RNG before dealing round-robin, scheduler.c:437-472),
+        so no adversarial config ordering can pile heavy hosts onto one
+        worker.  Deterministic: same seed, same assignment — and the final
+        state digest is assignment-independent anyway (the cross-policy
+        parity gates pin that), so the shuffle affects load balance only."""
+        if self._hosts_finalized:
+            return
+        self._hosts_finalized = True
+        hosts, self._pending_hosts = self._pending_hosts, []
+        from .rng import RandomSource, derive
+        rng = RandomSource(derive(self.seed_key, "host-shuffle"))
+        for i in range(len(hosts) - 1, 0, -1):
+            j = rng.next_int(i + 1)
+            hosts[i], hosts[j] = hosts[j], hosts[i]
+        for host in hosts:
+            self._assign(host)
 
     # -- push/pop (worker-facing) -----------------------------------------
     def push(self, event: Event, worker) -> None:

@@ -74,6 +74,23 @@ from ..core.logger import get_logger
 TICK_NS = 1_000_000          # 1 ms, = the interface refill interval
 
 
+class _PoisonedFlush:
+    """Fault-harness stand-in for an in-flight flush handle: materializing
+    it raises (``device-dispatch:N``) or stalls (``device-dispatch-hang:N``,
+    bounded so the abandoned guard thread cannot linger forever) — the
+    deterministic stand-ins for a dispatch that failed or wedged."""
+
+    def __init__(self, handle, hang: bool = False):
+        self._handle = handle
+        self._hang = hang
+
+    def __array__(self, dtype=None, copy=None):
+        if self._hang:
+            import time as _t
+            _t.sleep(30.0)
+        raise RuntimeError("fault injection: poisoned device dispatch")
+
+
 class _FlowSpec:
     """One device-mode client = TWO independent cell chains, e.g. a tor
     download (server -> exit -> middle -> guard -> client) and upload
@@ -292,6 +309,31 @@ class DeviceTrafficPlane:
         self._cells_delivered_seen = 0
         self._idle_ticks_banked = 0
         self.idle_rounds_skipped = 0
+        # Dispatch supervision (ISSUE 2): every dispatch window is logged as
+        # (base_ticks, inject pairs, n, idle) — a few ints per window — so
+        # that a FAILED in-flight dispatch (exception at materialization, or
+        # collect timeout via --device-watchdog-sec) can be recovered by
+        # replaying the whole window history on the bit-identical numpy
+        # twin.  Full-history replay rather than one-window replay because
+        # the carried device state is donated on accelerators: after the
+        # failed dispatch there is no pre-state buffer left to restart from.
+        # On recovery the backend is PERMANENTLY demoted to the numpy twin
+        # (graceful degradation: digest parity preserved, device speed
+        # forfeited), counted in engine.supervision.
+        self._dispatch_log: List[tuple] = []
+        self._watchdog_sec = float(
+            getattr(engine.options, "device_watchdog_sec", 0) or 0)
+        self.demoted = False
+        self.recoveries = 0
+        from ..core.supervision import parse_fault_inject
+        fault = parse_fault_inject(
+            getattr(engine.options, "fault_inject", "") or "")
+        self._fault_dispatch = 0
+        self._fault_hang = False
+        if fault and fault["kind"] in ("device-dispatch",
+                                       "device-dispatch-hang"):
+            self._fault_dispatch = fault["dispatch"]
+            self._fault_hang = fault["kind"] == "device-dispatch-hang"
 
     # -- static layout ----------------------------------------------------
     def _build_layout(self, engine) -> None:
@@ -656,6 +698,7 @@ class DeviceTrafficPlane:
             # rounds before paying a dispatch; next_time() keeps the engine
             # window loop coming back even when the Python plane idles
             return
+        inject_pairs = list(self._inject_buf)
         if self._inject_buf:
             f = self.n_flows
             inject = np.zeros(f, dtype=np.int64)
@@ -683,6 +726,13 @@ class DeviceTrafficPlane:
         # else desynchronizes the arrival ring's absolute slots: cells would
         # be skipped or re-read — caught by an adversarial review repro and
         # now pinned by test_varying_dispatch_sizes_preserve_arrivals.)
+        if self.mode == "device":
+            # the log exists solely to recover a FAILED device dispatch;
+            # the numpy twin executes synchronously and cannot leave a
+            # failed in-flight slot, so logging there (or after demotion)
+            # would only accumulate memory it can never use
+            self._dispatch_log.append((int(self._ticks_synced),
+                                       inject_pairs, int(n), int(idle)))
         state = (np.int64(self._ticks_synced), *self._state[1:])
         if self._shard is not None:
             lay = self._shard
@@ -720,6 +770,14 @@ class DeviceTrafficPlane:
                 # must match the pipelined run bit for bit
                 import jax
                 jax.block_until_ready(self._flush_handle)
+        if self._fault_dispatch and self.dispatches == self._fault_dispatch \
+                and self.mode == "device":
+            # fault harness: this dispatch's collect raises (or hangs) —
+            # consume() must recover via the numpy-twin replay (device-only:
+            # the twin has no asynchronous slot to poison)
+            self._flush_handle = _PoisonedFlush(self._flush_handle,
+                                                hang=self._fault_hang)
+            self._fault_dispatch = 0
         self._launch_wall = _wt.perf_counter_ns()
         self.host_ns += self._launch_wall - t0
 
@@ -735,14 +793,17 @@ class DeviceTrafficPlane:
         import time as _wt
         t0 = _wt.perf_counter_ns()
         self.pipeline_overlap_ns += t0 - self._launch_wall
+        # the slot is released up front so state stays consistent whether
+        # the collect succeeds, raises, or is recovered
+        handle, self._flush_handle = self._flush_handle, None
+        self._inflight = False
         try:
             # blocks iff still computing; a failure inside the in-flight
-            # dispatch RAISES here (nothing downstream catches it) — the
-            # slot is released either way so state stays consistent
-            flush = np.asarray(self._flush_handle)
-        finally:
-            self._flush_handle = None
-            self._inflight = False
+            # dispatch RAISES here (guarded by --device-watchdog-sec), and
+            # the dispatch guard recovers it on the numpy twin
+            flush = self._collect_flush(engine, handle)
+        except Exception as e:  # noqa: BLE001 - any dispatch failure
+            flush = self._recover_dispatch(engine, e)
         t1 = _wt.perf_counter_ns()
         self.device_ns += t1 - t0
         if self.mode == "device":
@@ -786,6 +847,99 @@ class DeviceTrafficPlane:
                 self._schedule_wake(engine, circ, wake)
         self.host_ns += _wt.perf_counter_ns() - t1
 
+    def _collect_flush(self, engine, handle) -> np.ndarray:
+        """Materialize the in-flight dispatch's flush buffer, bounded by
+        ``--device-watchdog-sec`` in device mode: the blocking read runs on
+        a helper thread so a dispatch that never completes (wedged runtime,
+        dead device tunnel) raises TimeoutError here instead of freezing
+        the round loop forever.  Only the guard's bookkeeping (thread spawn
+        + join return) is charged to supervision overhead — the wait for
+        the result is the dispatch's own cost, watchdog or not."""
+        if self.mode != "device" or self._watchdog_sec <= 0:
+            return np.asarray(handle)
+        import threading
+        import time as _wt
+        t_g = _wt.perf_counter_ns()
+        box: Dict[str, object] = {}
+
+        def _work() -> None:
+            try:
+                box["out"] = np.asarray(handle)
+            except BaseException as e:  # noqa: BLE001 - forwarded below
+                box["err"] = e
+
+        th = threading.Thread(target=_work, daemon=True,
+                              name="device-dispatch-collect")
+        th.start()
+        engine.supervision.overhead_ns += _wt.perf_counter_ns() - t_g
+        th.join(self._watchdog_sec)
+        if th.is_alive():
+            # the helper thread is abandoned with the handle (it cannot be
+            # interrupted mid-XLA-call); the numpy replay takes over
+            raise TimeoutError(
+                f"device dispatch did not complete within "
+                f"{self._watchdog_sec:.0f}s (--device-watchdog-sec)")
+        t_g = _wt.perf_counter_ns()
+        err = box.get("err")
+        if err is not None:
+            raise err
+        out = box["out"]
+        engine.supervision.overhead_ns += _wt.perf_counter_ns() - t_g
+        return out
+
+    def _recover_dispatch(self, engine, exc: BaseException) -> np.ndarray:
+        """Graceful device-plane degradation: the in-flight dispatch failed
+        (exception or watchdog timeout), so rebuild the plane's state by
+        replaying the FULL logged window history on the bit-identical numpy
+        twin — the carried device state is donated on accelerators, so
+        there is no pre-state buffer to restart from — and PERMANENTLY
+        demote the backend to the twin.  Digest parity is preserved (the
+        twin is the parity oracle the tests pin); device speed is
+        forfeited.  Returns the failed window's flush buffer, which the
+        caller consumes exactly as if the device had produced it."""
+        get_logger().warning(
+            "device-plane",
+            f"in-flight dispatch failed ({exc!r}); replaying "
+            f"{len(self._dispatch_log)} windows on the numpy twin and "
+            "permanently demoting the backend to numpy")
+        self.mode = "numpy"
+        self.demoted = True
+        self.recoveries += 1
+        engine.supervision.count_dispatch_recovery(
+            f"device dispatch recovered on the numpy twin ({exc!r}); "
+            "backend demoted for the rest of the run")
+        self._mesh = None
+        self._shard = None
+        self._sharded_step = None
+        self._flush_step = None
+        self._flow_args_cached = None
+        self._zero_inject_cached = None
+        from ..ops.torcells_device import (RING_DTYPE,
+                                           torcells_step_window_numpy_flush)
+        f, h = self.n_flows, self.n_nodes
+        state = (np.int64(0), np.zeros(f, dtype=np.int64),
+                 np.zeros((self.ring_len, f), dtype=RING_DTYPE),
+                 self.capacity_step.copy(),
+                 np.zeros(f, dtype=np.int64), np.zeros(f, dtype=np.int64),
+                 np.full(f, -1, dtype=np.int64), np.zeros(h, dtype=np.int64))
+        args = self._flow_args()        # plain numpy now that mode flipped
+        flush = None
+        for base, pairs, n, idle in self._dispatch_log:
+            inject = np.zeros(f, dtype=np.int64)
+            inject_target = np.zeros(f, dtype=np.int64)
+            for circ, cells in pairs:
+                inject[self.first_flow[circ]] += cells
+                inject_target[self.last_flow[circ]] += cells
+            out = torcells_step_window_numpy_flush(
+                np.int64(base), *state[1:], inject, inject_target,
+                np.int64(n), np.int64(idle), *args, self.ring_len)
+            state = out[:8]
+            flush = out[9]
+        self._state = state
+        assert flush is not None, "recovery with an empty dispatch log"
+        self._dispatch_log.clear()      # demoted: the log has no future use
+        return flush
+
     def _schedule_wake(self, engine, circuit: int, when: int) -> None:
         if when >= engine.end_time:
             return
@@ -824,6 +978,11 @@ class DeviceTrafficPlane:
             "dispatches": self.dispatches,
             "idle_rounds_skipped": self.idle_rounds_skipped,
             "mode": self.mode,
+            # dispatch-guard outcomes: >0 recoveries means a dispatch
+            # failed, the window history replayed on the numpy twin, and
+            # the backend was demoted for the rest of the run
+            "recoveries": self.recoveries,
+            "demoted": self.demoted,
             # the plane's own wall split (VERDICT r4 weak #2: this was
             # tracked but never exported, hiding ~half the flagship wall):
             # host_sec = advance() dispatch prep + wake bookkeeping;

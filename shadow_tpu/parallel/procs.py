@@ -85,6 +85,17 @@ def _shard_body(conn, options, config) -> None:
     engine = ctrl.engine
     log = get_logger()
 
+    # fault harness (shard-exit:SID:ROUND): this shard hard-exits at the
+    # start of round ROUND — os._exit skips the ("error", ...) report, so
+    # the parent sees exactly what a SIGKILL/OOM kill looks like and must
+    # recover via dead-shard detection, never a hang
+    from ..core.supervision import parse_fault_inject
+    fault = parse_fault_inject(getattr(options, "fault_inject", "") or "")
+    fault_exit_round = 0
+    if fault and fault["kind"] == "shard-exit" \
+            and fault["shard"] == engine.shard_id:
+        fault_exit_round = fault["round"]
+
     engine.sim_start_wall = _walltime.monotonic()
     engine.schedule_boot()
     worker = Worker(0, engine)
@@ -115,6 +126,9 @@ def _shard_body(conn, options, config) -> None:
                                      if engine.owns_host(h)}))
                 continue
             ws, we = msg[1], msg[2]
+            if fault_exit_round and \
+                    engine.rounds_executed + 1 >= fault_exit_round:
+                os._exit(3)
             scheduler.window_start = ws
             scheduler.window_end = we
             worker.round_end = we
@@ -192,6 +206,45 @@ def _shard_body(conn, options, config) -> None:
 # parent (coordinator) side
 # ---------------------------------------------------------------------------
 
+class ShardDeadError(RuntimeError):
+    """A shard process died (or went watchdog-silent) mid-protocol — the
+    distinguished failure the supervision ledger counts, as opposed to a
+    shard that REPORTED an error before exiting."""
+
+
+def _recv_supervised(conn, proc, sid: int, watchdog_sec: float):
+    """Shard supervision: a ``recv`` that polls in short slices and checks
+    the shard process between them.  A shard that died without reporting
+    (SIGKILL, OOM, os._exit) surfaces as a diagnostic RuntimeError within
+    ~a poll slice instead of parking the parent in ``Connection.recv``
+    forever; ``watchdog_sec > 0`` additionally bounds how long a LIVE but
+    silent shard may stall a round barrier."""
+    waited = 0.0
+    while True:
+        if conn.poll(0.5):
+            try:
+                msg = conn.recv()
+            except EOFError:
+                raise ShardDeadError(
+                    f"shard {sid} closed its pipe mid-message "
+                    f"(exit code {proc.exitcode}) — aborting cleanly")
+            if msg[0] == "error":
+                raise RuntimeError(f"shard failed:\n{msg[1]}")
+            return msg
+        if not proc.is_alive():
+            if conn.poll(0):
+                continue        # final message raced the death check
+            raise ShardDeadError(
+                f"shard {sid} died (exit code {proc.exitcode}) without "
+                "reporting an error — aborting cleanly (dead-shard "
+                "detection)")
+        waited += 0.5
+        if watchdog_sec > 0 and waited >= watchdog_sec:
+            raise ShardDeadError(
+                f"shard {sid} alive but silent for {waited:.0f}s "
+                "(--shard-watchdog-sec) — aborting with diagnostics")
+
+
 class ProcsController:
     """Coordinator for ``--processes N``: spawns the shard engines, drives
     the window/exchange protocol, assembles checkpoints and the final state
@@ -210,6 +263,9 @@ class ProcsController:
         self.final_state: Optional[Dict] = None
         self.digest: Optional[str] = None
         self.checkpoints: List[str] = []
+        self.resume_verified = False
+        from ..core.supervision import SupervisionStats
+        self.supervision = SupervisionStats()
 
     def _child_options(self, shard_id: int):
         import dataclasses
@@ -222,8 +278,12 @@ class ProcsController:
         # heaps that _shard_body's lone Worker(0) never pops
         opt.workers = 0
         # checkpoints are assembled by the parent from shard host-states;
-        # per-shard snapshot files would be partial and misleading
+        # per-shard snapshot files would be partial and misleading — and
+        # the parent likewise owns resume verification over the ASSEMBLED
+        # state, so shards never verify partial digests
         opt.checkpoint_interval_sec = 0
+        opt.checkpoint_every_rounds = 0
+        opt.resume_path = None
         # the parent seeds the data directory from the template ONCE before
         # spawning (N children racing shutil.copytree would collide)
         opt.data_template = None
@@ -253,11 +313,19 @@ class ProcsController:
             conns.append(parent_conn)
             procs.append(p)
 
+        sid_of = {id(c): i for i, c in enumerate(conns)}
+        shard_wd = float(getattr(self.options, "shard_watchdog_sec", 0) or 0)
+
         def recv(c):
-            msg = c.recv()
-            if msg[0] == "error":
-                raise RuntimeError(f"shard failed:\n{msg[1]}")
-            return msg
+            sid = sid_of[id(c)]
+            try:
+                return _recv_supervised(c, procs[sid], sid, shard_wd)
+            except ShardDeadError:
+                # the ledger records the detection (it aborts the run, but
+                # distinguishes 'we caught a dead shard cleanly' from 'a
+                # shard reported its own error')
+                self.supervision.shard_deaths_detected += 1
+                raise
 
         try:
             readies = [recv(c) for c in conns]
@@ -272,9 +340,26 @@ class ProcsController:
                 f"{n} processes, lookahead={lookahead / 1e6:.3f} ms, "
                 f"end={end_time / 1e9:.1f} s")
 
-            ckpt_interval = self.options.checkpoint_interval_sec \
-                * stime.SIM_TIME_SEC
-            ckpt_next = ckpt_interval if ckpt_interval > 0 else None
+            writer = None
+            if self.options.checkpoint_interval_sec > 0 \
+                    or getattr(self.options,
+                               "checkpoint_every_rounds", 0) > 0:
+                from ..core.checkpoint import CheckpointWriter
+                writer = CheckpointWriter(
+                    self.options.checkpoint_interval_sec,
+                    self.options.checkpoint_dir,
+                    getattr(self.options, "checkpoint_every_rounds", 0))
+            resume_snap = None
+            if getattr(self.options, "resume_path", None):
+                from ..core.checkpoint import find_last_good_snapshot
+                resume_snap, resolved = find_last_good_snapshot(
+                    self.options.resume_path)
+                log.message(
+                    "procs",
+                    f"resuming from {resolved} "
+                    f"(t={resume_snap['sim_time_ns'] / 1e9:.3f}s): "
+                    "replaying to the snapshot boundary, digest-verified "
+                    "there")
             last_ws = 0
             while True:
                 nxt = min(m[1] for m in mins)
@@ -291,16 +376,24 @@ class ProcsController:
                     c.send(("in", inbox))
                 mins = [recv(c) for c in conns]
                 last_ws = ws
+                if resume_snap is not None \
+                        and ws >= resume_snap["sim_time_ns"]:
+                    self._verify_resume(conns, recv, ws, resume_snap,
+                                        sum(m[2] for m in mins))
+                    resume_snap = None
                 # parent-assembled checkpoint at the same boundaries the
-                # serial CheckpointWriter uses (window_start >= next_at,
-                # BEFORE the round counter increments)
-                if ckpt_next is not None and ws >= ckpt_next:
+                # serial CheckpointWriter uses (shared due()/path_for
+                # cadence, BEFORE the round counter increments — so
+                # snapshot names and digests line up with a serial run)
+                if writer is not None \
+                        and writer.due(ws, self.rounds_executed):
                     self._write_checkpoint(conns, recv, ws,
-                                           sum(m[2] for m in mins))
-                    while ckpt_next <= ws:
-                        ckpt_next += ckpt_interval
+                                           sum(m[2] for m in mins), writer)
                 self.rounds_executed += 1
 
+            if resume_snap is not None:
+                from ..core.checkpoint import warn_resume_unreached
+                warn_resume_unreached(resume_snap, "procs")
             for c in conns:
                 c.send(("stop",))
             finals = [recv(c)[1] for c in conns]
@@ -343,18 +436,36 @@ class ProcsController:
         log.flush()
         return 1 if plugin_errors else 0
 
-    def _write_checkpoint(self, conns, recv, ws: int, pending: int) -> None:
-        from ..core.checkpoint import assemble_state, save_state
+    def _collect_assembled(self, conns, recv, ws: int, pending: int) -> Dict:
+        """Gather every shard's host states and assemble the canonical
+        digestible state (shared by checkpoint writes and resume verify)."""
+        from ..core.checkpoint import assemble_state
         for c in conns:
             c.send(("collect",))
         host_states: Dict = {}
         for c in conns:
             host_states.update(recv(c)[1])
-        state = assemble_state(ws, self.rounds_executed, host_states, pending)
+        return assemble_state(ws, self.rounds_executed, host_states, pending)
+
+    def _verify_resume(self, conns, recv, ws: int, snap: Dict,
+                       pending: int) -> None:
+        """--resume under --processes: the shared boundary gate computed
+        over the parent-assembled state."""
+        from ..core.checkpoint import digest_of_state, verify_resume_boundary
+        verify_resume_boundary(
+            snap, ws,
+            digest_of_state(self._collect_assembled(conns, recv, ws,
+                                                    pending)),
+            "procs")
+        self.resume_verified = True
+        self.supervision.resume_verified = True
+
+    def _write_checkpoint(self, conns, recv, ws: int, pending: int,
+                          writer) -> None:
+        from ..core.checkpoint import save_state
+        state = self._collect_assembled(conns, recv, ws, pending)
         os.makedirs(self.options.checkpoint_dir, exist_ok=True)
-        sim_sec = ws // stime.SIM_TIME_SEC
-        path = os.path.join(self.options.checkpoint_dir,
-                            f"checkpoint_{sim_sec:08d}.ckpt")
+        path = writer.path_for(ws, self.rounds_executed)
         save_state(state, path, {
             "seed": self.options.seed,
             "scheduler_policy": self.options.scheduler_policy,
@@ -364,6 +475,7 @@ class ProcsController:
             "stop_time_sec": self.options.stop_time_sec,
             "processes": self.n_shards,
         })
+        writer.mark_written(ws, self.rounds_executed, path)
         self.checkpoints.append(path)
         get_logger().message("procs", f"checkpoint written: {path}")
 

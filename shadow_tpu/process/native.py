@@ -106,16 +106,67 @@ _PRELOAD_LIB = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__fi
 STALL_TIMEOUT_SEC = float(os.environ.get("SHADOW_TPU_PLUGIN_STALL_TIMEOUT",
                                          "300"))
 
+
+def _watchdog_sec(api) -> float:
+    """The plugin RPC watchdog budget: ``--plugin-watchdog-sec`` when set,
+    else the module/env default.  One resolution point so the serve loop,
+    the handshake wait, and the pooled loop all honor the same knob."""
+    opts = getattr(getattr(api.host, "engine", None), "options", None)
+    v = float(getattr(opts, "plugin_watchdog_sec", 0) or 0)
+    return v if v > 0 else STALL_TIMEOUT_SEC
+
+
+def _supervise_kill(api, reason: str) -> None:
+    """Mark the simulated process as supervisor-killed: its app generator
+    exits with code 124 (the timeout convention), process._finish routes
+    the exit to the supervision ledger instead of plugin_errors, and the
+    host + round loop continue."""
+    get_logger().warning("native", f"{api.process.name}: {reason}")
+    api.process.supervised_kill = reason
+
+
+def _fault_stall_after(api) -> int:
+    """Fault harness: ``plugin-stall:NAME:NREQ`` -> NREQ for this process
+    (SIGSTOP its child after serving that many requests), else 0."""
+    opts = getattr(getattr(api.host, "engine", None), "options", None)
+    spec = getattr(opts, "fault_inject", "") or ""
+    if spec.startswith("plugin-stall:"):
+        from ..core.supervision import parse_fault_inject
+        f = parse_fault_inject(spec)
+        if f["name"] in api.process.name:
+            return f["nreq"]
+    return 0
+
 _live_children: List[subprocess.Popen] = []
 
 
-def _kill_stragglers() -> None:
-    for p in _live_children:
-        if p.poll() is None:
+def _kill_stragglers(grace_sec: float = 2.0) -> None:
+    """Tear down surviving plugin/pool children: terminate -> grace ->
+    kill, then ``wait`` (waitpid) each one so no zombies outlive a run —
+    a bare SIGKILL without reaping used to leave defunct entries behind
+    for the life of the test process."""
+    import time as _t
+    live = [p for p in _live_children if p.poll() is None]
+    for p in live:
+        try:
+            p.terminate()
+        except OSError:
+            pass
+    deadline = _t.monotonic() + grace_sec
+    for p in live:
+        try:
+            p.wait(timeout=max(0.0, deadline - _t.monotonic()))
+        except subprocess.TimeoutExpired:
             try:
                 p.kill()
             except OSError:
                 pass
+            try:
+                p.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:  # pragma: no cover - D state
+                pass
+        except OSError:  # pragma: no cover - already reaped elsewhere
+            pass
 
 
 atexit.register(_kill_stragglers)
@@ -668,32 +719,17 @@ class NativeKernel:
 
 
 def _read_exact_raising(conn: real_socket.socket, n: int) -> Optional[bytes]:
-    """Like _read_exact but lets socket timeouts propagate (TimeoutError),
-    so a bounded read can distinguish 'child stalled' from 'child exited'."""
+    """Blocking read of exactly n bytes; None on EOF; socket timeouts
+    propagate (TimeoutError) so every bounded read distinguishes 'child
+    stalled' (a watchdog fire) from 'child exited' (a normal teardown).
+
+    This *real* blocking read is the determinism seam: while we're here, the
+    plugin is executing (instantaneous in virtual time); it will either send
+    another request, stall, or exit."""
     chunks = []
     got = 0
     while got < n:
         chunk = conn.recv(n - got)
-        if not chunk:
-            return None
-        chunks.append(chunk)
-        got += len(chunk)
-    return b"".join(chunks)
-
-
-def _read_exact(conn: real_socket.socket, n: int) -> Optional[bytes]:
-    """Blocking read of exactly n bytes; None on EOF.
-
-    This *real* blocking read is the determinism seam: while we're here, the
-    plugin is executing (instantaneous in virtual time); it will either send
-    another request or exit."""
-    chunks = []
-    got = 0
-    while got < n:
-        try:
-            chunk = conn.recv(n - got)
-        except OSError:
-            return None
         if not chunk:
             return None
         chunks.append(chunk)
@@ -761,6 +797,9 @@ def run_native_plugin(api, args: List[str], binary: str,
     _live_children.append(proc)
     child_side.close()
     kernel = NativeKernel(api, sim_side)
+    wd = _watchdog_sec(api)
+    stall_after = _fault_stall_after(api)
+    served = 0
     try:
         # the shim's constructor sends a GETTIME before the plugin's main()
         # runs, so the first request arrives within exec latency.  A binary
@@ -769,15 +808,19 @@ def run_native_plugin(api, args: List[str], binary: str,
         # bound that wait and fail loudly instead.
         # Wall-clock pressure must not change simulation outcomes, so a
         # slow-but-alive child gets generous retries; only a child that is
-        # alive yet silent for the full budget (the shim speaks before
-        # main() runs, so silence means it isn't interposed) is killed.
+        # alive yet silent for the full watchdog budget (the shim speaks
+        # before main() runs, so silence means it isn't interposed) is
+        # killed.
         import select as _select
         spoke = False
-        for _ in range(18):  # 18 x 10s = 3 min budget
-            readable, _, _ = _select.select([sim_side], [], [], 10.0)
+        waited = 0.0
+        slice_sec = min(10.0, wd)
+        while waited < wd:
+            readable, _, _ = _select.select([sim_side], [], [], slice_sec)
             if readable or proc.poll() is not None:
                 spoke = True
                 break
+            waited += slice_sec
         if not spoke:
             log.warning("native",
                         f"{name}: {binary} never spoke the interposition "
@@ -787,7 +830,7 @@ def run_native_plugin(api, args: List[str], binary: str,
         # select only guarantees one readable byte: bound the header read
         # too, so a child that writes a partial/garbage header then hangs
         # fails loudly instead of freezing the simulator
-        sim_side.settimeout(30.0)
+        sim_side.settimeout(min(30.0, wd))
         try:
             hdr = _read_exact_raising(sim_side, REQ_HDR.size)
         except TimeoutError:
@@ -796,19 +839,21 @@ def run_native_plugin(api, args: List[str], binary: str,
                         "stalled; killing it")
             raise OSError("plugin handshake timeout")
         # stall watchdog for the whole run: a TIMEOUT (as opposed to EOF)
-        # means the plugin went silent without exiting — declare it dead
-        # loudly; the finally block kills it
-        sim_side.settimeout(STALL_TIMEOUT_SEC)
+        # means the plugin went silent without exiting — a supervised kill:
+        # the simulated process is marked exited with the reason, the host
+        # and round loop continue (the finally block kills + reaps the OS
+        # process)
+        sim_side.settimeout(wd)
         first = True
         while True:
             if not first:
                 try:
                     hdr = _read_exact_raising(sim_side, REQ_HDR.size)
                 except TimeoutError:
-                    log.warning("native",
-                                f"{name}: no syscall for "
-                                f"{STALL_TIMEOUT_SEC:.0f}s wall (busy spin "
-                                "without syscalls?); killing the plugin")
+                    _supervise_kill(
+                        api, f"no syscall for {wd:.0f}s wall (SIGSTOP'd? "
+                        "busy spin without syscalls?); watchdog killing "
+                        "the plugin")
                     hdr = None
             first = False
             if hdr is None:
@@ -817,7 +862,19 @@ def run_native_plugin(api, args: List[str], binary: str,
             plen = length - REQ_HDR.size
             payload = b""
             if plen > 0:
-                payload = _read_exact(sim_side, plen)
+                # the payload read must distinguish timeout from EOF too: a
+                # plugin frozen MID-REQUEST (header delivered, payload
+                # stalled — exactly where a SIGSTOP can land) is a watchdog
+                # kill, not a silent exit
+                try:
+                    payload = _read_exact_raising(sim_side, plen)
+                except TimeoutError:
+                    _supervise_kill(
+                        api, f"request truncated mid-payload for "
+                        f"{wd:.0f}s wall; watchdog killing the plugin")
+                    payload = None
+                except OSError:
+                    payload = None      # reset mid-payload = plugin exit
                 if payload is None:
                     break
             ret, resp_payload = yield from kernel.dispatch(op, a, b, c, d,
@@ -826,8 +883,26 @@ def run_native_plugin(api, args: List[str], binary: str,
                                  int(ret), api.now_ns()) + resp_payload
             try:
                 sim_side.sendall(resp)
+            except TimeoutError:
+                # response stuck for the full watchdog budget: the plugin
+                # stopped draining its socket mid-syscall — same supervised
+                # teardown as request-side silence
+                _supervise_kill(
+                    api, f"response undeliverable for {wd:.0f}s wall; "
+                    "watchdog killing the plugin")
+                break
             except OSError:
                 break
+            served += 1
+            if stall_after and served == stall_after:
+                # fault harness (plugin-stall:NAME:NREQ): freeze the child
+                # mid-syscall-stream, deterministically — the next request
+                # read must trip the watchdog, never hang the simulator
+                import signal as _signal
+                log.warning("native",
+                            f"{name}: fault injection — SIGSTOP after "
+                            f"request #{served}")
+                os.kill(proc.pid, _signal.SIGSTOP)
     finally:
         sim_side.close()
         if proc.poll() is None:
@@ -844,6 +919,9 @@ def run_native_plugin(api, args: List[str], binary: str,
                                  "returncode": proc.returncode}
         if captured:
             log.debug("native", f"{name} output: {captured[:2000]!r}")
+    if getattr(api.process, "supervised_kill", None):
+        return 124          # timeout convention; routed to the supervision
+                            # ledger by process._finish, not plugin_errors
     rc = kernel.exit_code if kernel.exit_code is not None else proc.returncode
     return rc if rc is not None else 0
 
@@ -953,16 +1031,16 @@ def run_pooled_plugin(api, args: List[str], so_path: str):
         log.warning("native", f"{name}: pool add_instance failed: {e}")
         return 127
     kernel = NativeKernel(api, sim_side)
-    sim_side.settimeout(STALL_TIMEOUT_SEC)
+    wd = _watchdog_sec(api)
+    sim_side.settimeout(wd)
     try:
         while True:
             try:
                 hdr = _read_exact_raising(sim_side, REQ_HDR.size)
             except TimeoutError:
-                log.warning("native",
-                            f"{name}: no syscall for "
-                            f"{STALL_TIMEOUT_SEC:.0f}s wall; retiring the "
-                            "pooled instance")
+                _supervise_kill(
+                    api, f"no syscall for {wd:.0f}s wall; watchdog "
+                    "retiring the pooled instance")
                 hdr = None
             if hdr is None:
                 break
@@ -970,7 +1048,16 @@ def run_pooled_plugin(api, args: List[str], so_path: str):
             plen = length - REQ_HDR.size
             payload = b""
             if plen > 0:
-                payload = _read_exact(sim_side, plen)
+                try:
+                    payload = _read_exact_raising(sim_side, plen)
+                except TimeoutError:
+                    _supervise_kill(
+                        api, f"request truncated mid-payload for "
+                        f"{wd:.0f}s wall; watchdog retiring the pooled "
+                        "instance")
+                    payload = None
+                except OSError:
+                    payload = None      # reset mid-payload = instance exit
                 if payload is None:
                     break
             ret, resp_payload = yield from kernel.dispatch(op, a, b, c, d,
@@ -979,10 +1066,20 @@ def run_pooled_plugin(api, args: List[str], so_path: str):
                                  int(ret), api.now_ns()) + resp_payload
             try:
                 sim_side.sendall(resp)
+            except TimeoutError:
+                # same supervised teardown as the standalone loop: an
+                # instance that stops draining its socket mid-response is
+                # a watchdog fire, not a clean exit
+                _supervise_kill(
+                    api, f"response undeliverable for {wd:.0f}s wall; "
+                    "watchdog retiring the pooled instance")
+                break
             except OSError:
                 break
     finally:
         sim_side.close()
+    if getattr(api.process, "supervised_kill", None):
+        return 124
     return kernel.exit_code if kernel.exit_code is not None else 0
 
 

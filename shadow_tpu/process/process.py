@@ -106,6 +106,10 @@ class Process:
         self.running = False
         self.exited = False
         self.exit_code: Optional[int] = None
+        # set by a watchdog (process/native.py _supervise_kill) when this
+        # process was torn down BY DESIGN: the nonzero exit then counts as
+        # a supervision recovery, not a plugin error
+        self.supervised_kill: Optional[str] = None
         self.return_values: Dict[int, Any] = {}
         self.app_state: Any = None  # apps may park observable state here (tests)
         self._continue_scheduled = False
@@ -156,7 +160,11 @@ class Process:
         get_logger().info("process",
                           f"process {self.name} (pid {self.pid}) exited with {exit_code}")
         if exit_code != 0 and self.host.engine is not None:
-            self.host.engine.increment_plugin_error()
+            if self.supervised_kill:
+                self.host.engine.supervision.count_plugin_kill(
+                    self.name, self.supervised_kill)
+            else:
+                self.host.engine.increment_plugin_error()
 
     # -- green threads -----------------------------------------------------
     def spawn_thread(self, gen) -> GreenThread:

@@ -122,6 +122,38 @@ def test_save_and_resume_digest_roundtrip(tmp_path):
     assert not resume_digest(snap, ctrl2.engine)
 
 
+def test_checkpoint_every_rounds_writes_verified(tmp_path):
+    """--checkpoint-every N: round-cadence snapshots, round-stamped names,
+    atomic + digest-verified on load (the crash-recovery substrate)."""
+    ckdir = str(tmp_path / "ck")
+    ctrl = run(checkpoint_every_rounds=25, checkpoint_dir=ckdir)
+    written = sorted(glob.glob(ckdir + "/checkpoint_r*.ckpt"))
+    assert len(written) >= 2
+    for path in written:
+        snap = load_snapshot(path, verify=True)   # raises if corrupt
+        assert snap["options"]["seed"] == 5
+    # rounds strictly increase with the file names
+    rounds = [load_snapshot(p)["rounds"] for p in written]
+    assert rounds == sorted(rounds)
+    assert not glob.glob(ckdir + "/*.tmp"), "atomic write left a tmp file"
+    del ctrl
+
+
+def test_corrupt_snapshot_detected(tmp_path):
+    """A truncated snapshot file fails verified load instead of seeding a
+    resume with garbage."""
+    import pytest
+
+    ckdir = str(tmp_path / "ck")
+    run(checkpoint_every_rounds=25, checkpoint_dir=ckdir)
+    path = sorted(glob.glob(ckdir + "/checkpoint_r*.ckpt"))[0]
+    import os
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) // 2)
+    with pytest.raises(Exception):
+        load_snapshot(path, verify=True)
+
+
 def test_checkpoint_parity_across_policies(tmp_path):
     """Mid-run round-boundary snapshots are policy-independent: the first
     checkpoint written under global, steal x4, and tpu scheduling carries

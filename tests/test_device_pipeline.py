@@ -80,9 +80,13 @@ class _PoisonFlush:
         raise RuntimeError("boom-in-flight")
 
 
-def test_inflight_exception_surfaces_at_collect(monkeypatch):
-    """A failure inside the launched dispatch must raise at consume()
-    (where the flush buffer materializes) — never be swallowed."""
+def test_inflight_exception_recovered_at_collect(monkeypatch):
+    """A failure inside the launched dispatch surfaces at consume() (where
+    the flush buffer materializes) and is RECOVERED by the dispatch guard
+    (ISSUE 2): the window history replays on the numpy twin, the backend is
+    permanently demoted, and the recovery is counted — never swallowed,
+    never fatal.  End-to-end digest parity of this path is pinned by
+    tests/test_supervision.py."""
     xml = workloads.tor_network(8, n_clients=2, n_servers=1, stoptime=10,
                                 stream_spec="512:5120", device_data=True)
     cfg = configuration.parse_xml(xml)
@@ -109,9 +113,17 @@ def test_inflight_exception_surfaces_at_collect(monkeypatch):
     eng.scheduler.window_end = 10 ** 9
     plane.advance(eng)
     assert plane._inflight
-    with pytest.raises(RuntimeError, match="boom-in-flight"):
-        plane.consume(eng)
+    plane.consume(eng)
     assert not plane._inflight
+    assert plane.demoted and plane.mode == "numpy"
+    assert plane.recoveries == 1
+    assert eng.supervision.dispatch_recoveries == 1
+    # demotion is permanent: the next windows run on the twin, no new slot
+    # poisoning possible (the monkeypatched device path is never hit again)
+    eng.scheduler.window_end = 2 * 10 ** 9
+    plane.advance(eng)
+    plane.consume(eng)
+    assert plane.recoveries == 1
 
 
 def test_signalfd_shared_pending_fanout():

@@ -23,17 +23,8 @@ from shadow_tpu.core.options import Options
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.fixture(scope="session")
-def native_bin(tmp_path_factory):
-    """Build the shim and the dual-execution test binary."""
-    subprocess.run(["make", "-C", os.path.join(REPO, "native")], check=True,
-                   capture_output=True)
-    out = tmp_path_factory.mktemp("nativebin") / "testapp"
-    subprocess.run(["gcc", "-O1", "-o", str(out),
-                    os.path.join(REPO, "tests", "native_src", "testapp.c"),
-                    "-lpthread"],
-                   check=True, capture_output=True)
-    return str(out)
+# the native_bin fixture (shim + testapp build) lives in conftest.py now,
+# shared with the supervision fault-injection suite
 
 
 def run_sim(xml, stop=120, policy="global", workers=0, data_directory=None):

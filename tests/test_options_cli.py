@@ -36,6 +36,12 @@ def test_every_flag_parses_and_lands():
         "--tpu-max-inflight", "4096",
         "--tpu-devices", "8",
         "--tpu-shard-matrix",
+        "--checkpoint-every", "50",
+        "--resume", "/tmp/ck",
+        "--plugin-watchdog-sec", "7.5",
+        "--device-watchdog-sec", "12",
+        "--shard-watchdog-sec", "90",
+        "--fault-inject", "device-dispatch:2",
     ])
     assert opts.config_path == "cfg.xml"
     assert opts.workers == 4
@@ -65,6 +71,24 @@ def test_every_flag_parses_and_lands():
     assert opts.tpu_max_inflight == 4096
     assert opts.tpu_devices == 8
     assert opts.tpu_shard_matrix is True
+    assert opts.checkpoint_every_rounds == 50
+    assert opts.resume_path == "/tmp/ck"
+    assert opts.plugin_watchdog_sec == 7.5
+    assert opts.device_watchdog_sec == 12.0
+    assert opts.shard_watchdog_sec == 90.0
+    assert opts.fault_inject == "device-dispatch:2"
+
+
+def test_supervision_defaults():
+    """Supervision is on by default with conservative budgets: the device
+    dispatch guard at 300 s, plugin watchdog deferring to the module/env
+    default, shard liveness always checked (wall watchdog off)."""
+    opts = parse_args([])
+    assert opts.device_watchdog_sec == 300.0
+    assert opts.plugin_watchdog_sec == 0.0
+    assert opts.shard_watchdog_sec == 0.0
+    assert opts.checkpoint_every_rounds == 0
+    assert opts.resume_path is None and opts.fault_inject == ""
 
 
 def test_invalid_choices_rejected():

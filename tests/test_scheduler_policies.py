@@ -89,3 +89,42 @@ def test_steal_soak_large_phold():
     serial = run("global", 0)
     assert run("steal", 8) == serial
     assert run("threadXhost", 8) == serial
+
+
+def test_host_worker_shuffle_deterministic_and_balanced():
+    """Satellite (ISSUE 2): host->worker assignment is a Fisher-Yates
+    shuffle keyed off the sim seed (reference scheduler.c:437-472), dealt
+    round-robin in shuffled order — deterministic per seed, balanced to
+    within one host, different across seeds, and NOT the identity
+    round-robin (so adversarial config ordering can't pile heavy hosts
+    onto one worker)."""
+    from collections import Counter
+
+    from shadow_tpu.core.scheduler import Scheduler
+
+    class _H:
+        def __init__(self, hid):
+            self.id = hid
+
+    def assignment(seed, n=64, workers=4):
+        s = Scheduler(None, "host", workers, seed)
+        for i in range(1, n + 1):
+            s.add_host(_H(i))
+        s.finalize_hosts()
+        return dict(s.policy._host_worker)
+
+    a = assignment(111)
+    assert a == assignment(111), "same seed must give the same assignment"
+    assert a != assignment(222), "different seeds should shuffle differently"
+    counts = Counter(a.values())
+    assert len(counts) == 4
+    assert max(counts.values()) - min(counts.values()) <= 1
+    round_robin = {hid: (hid - 1) % 4 for hid in a}
+    assert a != round_robin, "shuffle degenerated to identity round-robin"
+    # late registration (after boot) still lands somewhere valid
+    s = Scheduler(None, "host", 4, 111)
+    for i in range(1, 9):
+        s.add_host(_H(i))
+    s.finalize_hosts()
+    s.add_host(_H(99))
+    assert s.policy._host_worker[99] in (0, 1, 2, 3)
