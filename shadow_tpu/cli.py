@@ -61,6 +61,29 @@ def main(argv: Optional[List[str]] = None) -> int:
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
+    # observability outputs are written at END of run: an unwritable
+    # --trace/--metrics destination must fail now, not after the whole
+    # simulation has been paid for.  Probe-open in append mode (no
+    # truncation of an existing file) — catches a missing or read-only
+    # directory, a path that IS a directory, and permission walls alike.
+    for flag, path in (("--trace", opts.trace_path),
+                       ("--metrics", opts.metrics_path)):
+        if path:
+            existed = os.path.exists(path)
+            try:
+                with open(path, "a"):
+                    pass
+            except OSError as e:
+                print(f"error: {flag} {path!r} is not writable: {e}",
+                      file=sys.stderr)
+                return 2
+            if not existed:
+                # the probe must not leave a zero-byte artifact behind if
+                # a LATER validation step rejects the invocation
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
     if opts.test_mode:
         cfg = configuration.parse_xml(BUILTIN_TEST_CONFIG)
     elif opts.config_path:

@@ -44,3 +44,14 @@ class ObjectCounter:
             flag = "" if n == f else "  <-- LEAK"
             lines.append(f"  {k:<16} {n:>10} / {f:>10}{flag}")
         return "\n".join(lines)
+
+    def summary(self) -> Dict[str, object]:
+        """The shutdown report in metrics-summary form (obs/metrics.py):
+        the leak map plus per-type [new, free] pairs — the SAME numbers
+        report() formats for the log, so the two surfaces cannot drift."""
+        return {
+            "object_leaks": dict(self.leaks()),
+            "object_counts": {k: [self._new[k], self._free[k]]
+                              for k in sorted(set(self._new)
+                                              | set(self._free))},
+        }

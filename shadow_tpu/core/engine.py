@@ -37,6 +37,12 @@ class Engine:
     def __init__(self, options, topology, seed_key: Optional[int] = None):
         self.options = options
         self.topology = topology
+        # observability plane (shadow_tpu/obs/): installed module-global
+        # like the logger, FIRST, so everything built below (scheduler,
+        # native plane, device plane, plugins) binds the run's tracer
+        from ..obs import configure_observability
+        self.tracer, self.metrics, self._metrics_writer = \
+            configure_observability(options)
         self.root_key = seed_key if seed_key is not None else derive(options.seed, "root")
         self.dns = DNS()
         self.random = RandomSource(derive(self.root_key, "engine"))
@@ -123,6 +129,21 @@ class Engine:
                 f"(t={snap['sim_time_ns'] / 1e9:.3f}s, "
                 f"rounds={snap['rounds']}): replaying to the snapshot "
                 "boundary, digest-verified there")
+        # metrics sources: the engine's phase split, the policy/kernel and
+        # plane introspection, and the supervision ledger all scrape from
+        # ONE registry — bench.py reads flush_sec / device_wait_sec /
+        # pipeline_overlap_sec here instead of re-deriving them with
+        # ad-hoc timers per run
+        self.metrics.source("engine", self._scrape_metrics)
+        self.metrics.source(
+            "supervision",
+            lambda: {f"supervision.{k}": v
+                     for k, v in self.supervision.summary().items()})
+        self.metrics.gauge(
+            "engine.wall_uptime_sec",
+            lambda: round(_walltime.monotonic() - self.sim_start_wall, 3))
+        self._checkpoint_counter = self.metrics.counter(
+            "engine.checkpoints_written")
 
     # -- registry ----------------------------------------------------------
     def add_host(self, host, requested_ip: Optional[int] = None) -> None:
@@ -219,6 +240,92 @@ class Engine:
             return m
         return DEFAULT_LOOKAHEAD_NS
 
+    # -- observability -----------------------------------------------------
+    def _scrape_metrics(self) -> Dict:
+        """The 'engine' metrics source: phase wall split + policy/kernel +
+        plane + native-plane introspection, one flat namespace."""
+        out = {
+            "engine.rounds": self.rounds_executed,
+            "engine.events": self.events_executed,
+            "engine.host_exec_sec": round(self.host_exec_ns / 1e9, 4),
+            "engine.flush_sec": round(self.flush_ns / 1e9, 4),
+        }
+        pol = self.scheduler.policy
+        if hasattr(pol, "device_ns"):       # tpu policy phase timers
+            out["policy.device_wait_sec"] = round(pol.device_ns / 1e9, 4)
+            out["policy.flush_host_sec"] = round(pol.host_flush_ns / 1e9, 4)
+        kern = getattr(pol, "_kernel", None)
+        if kern is not None:
+            out["policy.device_calls"] = kern.device_calls
+            out["policy.host_calls"] = kern.host_calls
+        if self.device_plane is not None:
+            out.update({f"plane.{k}": v
+                        for k, v in self.device_plane.stats().items()})
+        if self.native_plane is not None:
+            sched, execd, drops, _last = self.native_plane.counters()
+            out["native.events_scheduled"] = sched
+            out["native.events_executed"] = execd
+            out["native.drops"] = drops
+        return out
+
+    def _obs_round_end(self) -> None:
+        """Round-cadence observability hook (both run loops): scrape the
+        registry to the JSONL stream when due.  One None-check per round
+        when metrics are off."""
+        if self._metrics_writer is not None:
+            self._metrics_writer.maybe_write(self.metrics,
+                                             self.rounds_executed,
+                                             self.scheduler.window_start)
+
+    def _obs_emergency(self) -> None:
+        """Crash-path observability: export whatever the flight recorder
+        holds and close the metrics stream with a summary.  Every step is
+        best-effort — this runs while an exception is propagating and must
+        never mask it."""
+        try:
+            if self.tracer.enabled and self.shard_count == 1:
+                path = self.tracer.export()
+                if path:
+                    get_logger().warning(
+                        "engine",
+                        f"flight recorder exported after abnormal "
+                        f"termination: {path}")
+            if self._metrics_writer is not None:
+                self._metrics_writer.write_summary(
+                    self.metrics, self.rounds_executed,
+                    self.scheduler.window_start)
+            get_logger().flush()
+        except Exception:
+            pass
+
+    def _obs_finish(self) -> None:
+        """End-of-run observability: final metrics summary (carrying the
+        ObjectCounter leak report + supervision ledger + plane stats) and
+        the trace export.  Shard engines skip the export — their rings are
+        drained over the procs protocol and merged by the parent."""
+        if self._metrics_writer is not None:
+            # final tracker sweep: one closing heartbeat per host so the
+            # summary's tracker.* aggregates (and the last legacy log
+            # sample tools parse) reflect END-of-run totals, not the last
+            # sim-gated heartbeat's
+            for hid in sorted(self.hosts):
+                host = self.hosts[hid]
+                if self.owns_host(host):
+                    host.tracker.heartbeat(self.scheduler.window_start)
+            for key, val in self.counters.summary().items():
+                self.metrics.set_summary_info(key, val)
+            self._metrics_writer.write_summary(self.metrics,
+                                               self.rounds_executed,
+                                               self.scheduler.window_start)
+            get_logger().message(
+                "engine",
+                f"metrics written: {self._metrics_writer.path} "
+                f"({self._metrics_writer.records_written} records)")
+        if self.tracer.enabled and self.shard_count == 1:
+            path = self.tracer.export()
+            if path:
+                get_logger().message("engine", f"trace written: {path}")
+
     # -- boot events -------------------------------------------------------
     def schedule_boot(self) -> None:
         """Host boots + process starts at t=0 (host_boot :372-390)."""
@@ -277,6 +384,13 @@ class Engine:
                 self._run_serial(lookahead)
             else:
                 self._run_threaded(lookahead)
+        except BaseException:
+            # abnormal termination: best-effort flight-recorder export +
+            # metrics summary BEFORE the exception propagates — the
+            # post-mortem timeline is exactly what the flight recorder
+            # exists to preserve (the success path exports in _obs_finish)
+            self._obs_emergency()
+            raise
         finally:
             if gc_was_enabled:
                 gc.enable()
@@ -317,6 +431,7 @@ class Engine:
                         f"supervision: {self.supervision.summary()}")
         if leaks:
             log.message("engine", self.counters.report())
+        self._obs_finish()
         log.flush()
         return 1 if self.plugin_errors else 0
 
@@ -343,8 +458,10 @@ class Engine:
             # here would forfeit the async launch/consume overlap for the
             # whole run)
             self._consume_flush()
-            path = self._checkpointer.maybe_write(self)
+            with self.tracer.span("checkpoint.write", "engine", sim_ns=ws):
+                path = self._checkpointer.maybe_write(self)
             if path:
+                self._checkpoint_counter.inc()
                 get_logger().message("engine", f"checkpoint written: {path}")
 
     def _verify_resume(self, window_start: int) -> None:
@@ -394,60 +511,96 @@ class Engine:
 
     def _heartbeat(self) -> None:
         """Periodic (wall-clock-gated) engine heartbeat with the per-round
-        host-vs-device split the perf hunt steers by."""
+        host-vs-device split the perf hunt steers by.  The values are
+        computed ONCE into a dict that feeds both the legacy log line
+        (tools/plot_log.py keeps scraping it) and the metrics registry —
+        the promotion ISSUE 3 asks for, with both consumers guaranteed to
+        read the same numbers."""
         now_wall = _walltime.monotonic()
         if now_wall - self._last_heartbeat_wall < self.heartbeat_wall_interval:
             return
         self._last_heartbeat_wall = now_wall
         policy = self.scheduler.policy
+        # resource usage line, reference slave.c:390-411 heartbeat getrusage
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        vals = {
+            "rounds": self.rounds_executed,
+            "simtime_s": round(self.scheduler.window_start / 1e9, 3),
+            "wall_s": round(now_wall - self.sim_start_wall, 1),
+            "host_exec_ms": round(self.host_exec_ns / 1e6, 1),
+            "flush_ms": round(self.flush_ns / 1e6, 1),
+            "cpu_user_s": round(ru.ru_utime, 1),
+            "cpu_sys_s": round(ru.ru_stime, 1),
+            "maxrss_mb": round(ru.ru_maxrss / 1024),
+        }
         extra = ""
         if self.native_plane is not None:
             _sched, execd, drops, _last = self.native_plane.counters()
+            vals["native_events"] = execd
+            vals["native_drops"] = drops
             extra = f" native_events={execd} native_drops={drops}"
         kern = getattr(policy, "_kernel", None)
         if kern is not None:
-            extra = (f" device_ms={policy.device_ns / 1e6:.1f}"
-                     f" flush_host_ms={policy.host_flush_ns / 1e6:.1f}"
+            vals["device_ms"] = round(policy.device_ns / 1e6, 1)
+            vals["flush_host_ms"] = round(policy.host_flush_ns / 1e6, 1)
+            vals["last_batch"] = policy.last_batch
+            vals["device_calls"] = kern.device_calls
+            vals["recompiles"] = len(kern.buckets_seen)
+            extra = (f" device_ms={vals['device_ms']:.1f}"
+                     f" flush_host_ms={vals['flush_host_ms']:.1f}"
                      f" last_batch={policy.last_batch}"
                      f" device_calls={kern.device_calls}"
                      f" recompiles={len(kern.buckets_seen)}")
-        # resource usage line, reference slave.c:390-411 heartbeat getrusage
-        ru = resource.getrusage(resource.RUSAGE_SELF)
+        self.metrics.record_engine_heartbeat(vals)
+        self.tracer.instant("engine.heartbeat", "engine",
+                            sim_ns=self.scheduler.window_start)
         get_logger().message(
             "engine",
-            f"[engine-heartbeat] rounds={self.rounds_executed}"
-            f" simtime={self.scheduler.window_start / 1e9:.3f}s"
-            f" wall={now_wall - self.sim_start_wall:.1f}s"
-            f" host_exec_ms={self.host_exec_ns / 1e6:.1f}"
-            f" flush_ms={self.flush_ns / 1e6:.1f}"
-            f" cpu_user_s={ru.ru_utime:.1f} cpu_sys_s={ru.ru_stime:.1f}"
-            f" maxrss_mb={ru.ru_maxrss / 1024:.0f}{extra}",
+            f"[engine-heartbeat] rounds={vals['rounds']}"
+            f" simtime={vals['simtime_s']:.3f}s"
+            f" wall={vals['wall_s']:.1f}s"
+            f" host_exec_ms={vals['host_exec_ms']:.1f}"
+            f" flush_ms={vals['flush_ms']:.1f}"
+            f" cpu_user_s={vals['cpu_user_s']:.1f}"
+            f" cpu_sys_s={vals['cpu_sys_s']:.1f}"
+            f" maxrss_mb={vals['maxrss_mb']}{extra}",
             sim_time=self.scheduler.window_start)
 
     def _run_serial(self, lookahead: int) -> None:
         worker = Worker(0, self)
         set_current_worker(worker)
         perf = _walltime.perf_counter_ns
+        tracer = self.tracer
+        log = get_logger()
         try:
             while True:
                 tc = perf()
-                self._consume_flush()
+                with tracer.span("collect", "engine",
+                                 sim_ns=self.scheduler.window_start):
+                    self._consume_flush()
                 self.flush_ns += perf() - tc
                 if not self._advance_window(lookahead):
                     break
+                ws = self.scheduler.window_start
                 tl = perf()
-                self._launch_plane()
+                with tracer.span("dispatch.launch", "engine", sim_ns=ws):
+                    self._launch_plane()
                 self.flush_ns += perf() - tl
                 worker.round_end = self.scheduler.window_end
                 t0 = perf()
-                worker.run_round()
+                with tracer.span("round", "engine", sim_ns=ws,
+                                 args={"round": self.rounds_executed}):
+                    worker.run_round()
                 t1 = perf()
-                self._flush_round()
+                with tracer.span("flush", "engine", sim_ns=ws):
+                    self._flush_round()
                 self.flush_ns += perf() - t1
                 self.host_exec_ns += t1 - t0
                 self.rounds_executed += 1
                 self._heartbeat()
-                get_logger().flush()
+                self._obs_round_end()
+                with tracer.span("log.flush", "engine", sim_ns=ws):
+                    log.flush()
             self.events_executed = worker.counters._free.get("event", 0)
             if self.native_plane is not None:
                 # fold the C plane's event lifecycle into the engine's
@@ -493,30 +646,42 @@ class Engine:
         for t in threads:
             t.start()
         perf = _walltime.perf_counter_ns
+        tracer = self.tracer
+        log = get_logger()
         try:
             while True:
                 tc = perf()
-                self._consume_flush()
+                with tracer.span("collect", "engine",
+                                 sim_ns=self.scheduler.window_start):
+                    self._consume_flush()
                 self.flush_ns += perf() - tc
                 if not self._advance_window(lookahead):
                     break
+                ws = self.scheduler.window_start
                 tl = perf()
-                self._launch_plane()
+                with tracer.span("dispatch.launch", "engine", sim_ns=ws):
+                    self._launch_plane()
                 self.flush_ns += perf() - tl
                 t0 = perf()
-                start_latch.count_down_await()
-                start_latch.reset()
-                done_latch.count_down_await()
-                done_latch.reset()
+                with tracer.span("round", "engine", sim_ns=ws,
+                                 args={"round": self.rounds_executed,
+                                       "workers": n}):
+                    start_latch.count_down_await()
+                    start_latch.reset()
+                    done_latch.count_down_await()
+                    done_latch.reset()
                 t1 = perf()
                 if errors:
                     raise errors[0]
-                self._flush_round()
+                with tracer.span("flush", "engine", sim_ns=ws):
+                    self._flush_round()
                 self.flush_ns += perf() - t1
                 self.host_exec_ns += t1 - t0
                 self.rounds_executed += 1
                 self._heartbeat()
-                get_logger().flush()
+                self._obs_round_end()
+                with tracer.span("log.flush", "engine", sim_ns=ws):
+                    log.flush()
         finally:
             stop_flag["stop"] = True
             start_latch.count_down_await()

@@ -101,6 +101,17 @@ class Options:
                                          # shard detection, always on)
     fault_inject: str = ""               # deterministic fault harness
                                          # (supervision.parse_fault_inject)
+    # Observability (shadow_tpu/obs/): flight-recorder tracing + metrics
+    trace_path: Optional[str] = None     # --trace: Chrome trace-event JSON
+                                         # (Perfetto-loadable) written at
+                                         # end of run; enables the
+                                         # flight-recorder span ring
+    trace_ring: int = 0                  # --trace-ring: events kept per
+                                         # track (0 = obs.trace.DEFAULT_RING)
+    metrics_path: Optional[str] = None   # --metrics: JSONL scrape stream +
+                                         # final summary record
+    metrics_every_rounds: int = 0        # --metrics-every N rounds cadence
+                                         # (0 = MetricsWriter.DEFAULT_EVERY)
     # Misc
     config_path: Optional[str] = None
     test_mode: bool = False              # --test builtin example
@@ -223,6 +234,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "accumulate mid-round, overlapping device compute "
                         "with the rest of the round (0 = launch at the "
                         "barrier only)")
+    p.add_argument("--trace", default=None, dest="trace_path",
+                   help="record sim+wall-time spans into the flight "
+                        "recorder and write Chrome trace-event JSON here "
+                        "at end of run (load in Perfetto / "
+                        "chrome://tracing)")
+    p.add_argument("--trace-ring", type=int, default=0, dest="trace_ring",
+                   help="flight-recorder depth: events kept per track "
+                        "(bounded ring; 0 = default 65536)")
+    p.add_argument("--metrics", default=None, dest="metrics_path",
+                   help="scrape the metrics registry to this JSONL file on "
+                        "a round cadence, plus a final summary record")
+    p.add_argument("--metrics-every", type=int, default=0,
+                   dest="metrics_every_rounds",
+                   help="rounds between metrics scrapes (0 = default 50)")
     p.add_argument("--test", action="store_true", dest="test_mode",
                    help="run the built-in example simulation")
     return p

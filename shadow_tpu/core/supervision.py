@@ -48,16 +48,26 @@ class SupervisionStats:
         return (self.plugin_watchdog_kills + self.dispatch_recoveries
                 + self.shard_deaths_detected)
 
+    @staticmethod
+    def _dump_flight_recorder(reason: str) -> None:
+        """Every recovery arrives with its timeline attached: the flight
+        recorder's recent spans are logged alongside the watchdog report
+        (ISSUE 3).  A no-op note when the run wasn't traced."""
+        from ..obs.trace import get_tracer
+        get_tracer().dump_recent("supervision", reason)
+
     def count_plugin_kill(self, name: str, reason: str) -> None:
         self.plugin_watchdog_kills += 1
         get_logger().warning(
             "supervision",
             f"plugin {name} killed by watchdog ({reason}); its simulated "
             "process is marked exited — the host and round loop continue")
+        self._dump_flight_recorder(f"plugin watchdog: {name}")
 
     def count_dispatch_recovery(self, reason: str) -> None:
         self.dispatch_recoveries += 1
         get_logger().warning("supervision", reason)
+        self._dump_flight_recorder("device dispatch recovery")
 
     def summary(self) -> Dict:
         return {

@@ -89,21 +89,43 @@ class Tracker:
         self.socket_stats[handle] = {"rx_buffer": rx_buf, "rx_length": rx_len,
                                      "tx_buffer": tx_buf, "tx_length": tx_len}
 
+    def heartbeat_values(self) -> Dict:
+        """The heartbeat payload, computed once: the legacy log line is
+        formatted from THIS dict and the metrics registry records the same
+        dict, so the two surfaces can never disagree (ISSUE 3 promotion —
+        tools/plot_log.py keeps scraping the line against the same
+        values)."""
+        r_in, r_out = self.in_remote, self.out_remote
+        return {"rx": r_in.bytes_total, "tx": r_out.bytes_total,
+                "rx_pkts": r_in.packets_total,
+                "tx_pkts": r_out.packets_total,
+                "retrans": r_out.packets_retrans, "drops": self.drops,
+                "proc_ms": round(self.processing_ns / 1e6, 3)}
+
     def heartbeat(self, now: int) -> None:
         native = getattr(self, "_native", None)
         if native is not None:
             # native dataplane: the authoritative counters live in C
             plane, hid = native
             plane.sync_tracker(hid, self)
-        r_in, r_out = self.in_remote, self.out_remote
+        vals = self.heartbeat_values()
+        # the owning engine's registry when attached (robust against
+        # another engine re-installing the global between construction and
+        # shutdown, e.g. interleaved parity runs); the global otherwise
+        registry = getattr(getattr(self.host, "engine", None),
+                           "metrics", None)
+        if registry is None:
+            from ..obs.metrics import get_metrics
+            registry = get_metrics()
+        registry.record_host_heartbeat(self.host.name, vals)
         level = getattr(self.host.params, "heartbeat_log_level", None) \
             or "message"
         get_logger().log(
             level,
             "tracker",
             f"[shadow-heartbeat] [{self.host.name}] "
-            f"rx={r_in.bytes_total} tx={r_out.bytes_total} "
-            f"rx_pkts={r_in.packets_total} tx_pkts={r_out.packets_total} "
-            f"retrans={r_out.packets_retrans} drops={self.drops} "
-            f"proc_ms={self.processing_ns / 1e6:.3f}",
+            f"rx={vals['rx']} tx={vals['tx']} "
+            f"rx_pkts={vals['rx_pkts']} tx_pkts={vals['tx_pkts']} "
+            f"retrans={vals['retrans']} drops={vals['drops']} "
+            f"proc_ms={vals['proc_ms']:.3f}",
             sim_time=now)

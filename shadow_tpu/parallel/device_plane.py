@@ -321,6 +321,11 @@ class DeviceTrafficPlane:
         # (graceful degradation: digest parity preserved, device speed
         # forfeited), counted in engine.supervision.
         self._dispatch_log: List[tuple] = []
+        # observability hooks (shadow_tpu/obs/): dispatch/collect latency
+        # histograms, bytes per flush, pipeline-overlap efficiency — all
+        # no-ops (one attribute check) when tracing/metrics are off
+        from ..obs.profiler import DeviceProfiler
+        self._profiler = DeviceProfiler()
         self._watchdog_sec = float(
             getattr(engine.options, "device_watchdog_sec", 0) or 0)
         self.demoted = False
@@ -780,6 +785,9 @@ class DeviceTrafficPlane:
             self._fault_dispatch = 0
         self._launch_wall = _wt.perf_counter_ns()
         self.host_ns += self._launch_wall - t0
+        self._profiler.on_dispatch(t0, self._launch_wall, int(n),
+                                   len(inject_pairs), self.dispatches,
+                                   engine.scheduler.window_end)
 
     def consume(self, engine) -> None:
         """COLLECT: materialize the in-flight dispatch's packed flush
@@ -797,15 +805,24 @@ class DeviceTrafficPlane:
         # the collect succeeds, raises, or is recovered
         handle, self._flush_handle = self._flush_handle, None
         self._inflight = False
-        try:
-            # blocks iff still computing; a failure inside the in-flight
-            # dispatch RAISES here (guarded by --device-watchdog-sec), and
-            # the dispatch guard recovers it on the numpy twin
-            flush = self._collect_flush(engine, handle)
-        except Exception as e:  # noqa: BLE001 - any dispatch failure
-            flush = self._recover_dispatch(engine, e)
+        with self._profiler.tracer.span(
+                "device.collect", "device",
+                sim_ns=engine.scheduler.window_start,
+                args={"dispatch": self.dispatches}):
+            try:
+                # blocks iff still computing; a failure inside the
+                # in-flight dispatch RAISES here (guarded by
+                # --device-watchdog-sec), and the dispatch guard recovers
+                # it on the numpy twin
+                flush = self._collect_flush(engine, handle)
+            except Exception as e:  # noqa: BLE001 - any dispatch failure
+                flush = self._recover_dispatch(engine, e)
         t1 = _wt.perf_counter_ns()
         self.device_ns += t1 - t0
+        self._profiler.on_collect(self._launch_wall, t0, t1 - t0,
+                                  int(getattr(flush, "nbytes", 0)),
+                                  self.dispatches,
+                                  engine.scheduler.window_start)
         if self.mode == "device":
             self.device_calls += 1              # the flush read
         from ..ops.torcells_device import CELL_WIRE_BYTES, parse_flush
@@ -994,6 +1011,12 @@ class DeviceTrafficPlane:
             # the in-flight dispatch computed behind host round work
             "device_calls": self.device_calls,
             "pipeline_overlap_sec": round(self.pipeline_overlap_ns / 1e9, 3),
+            # fraction of device compute hidden behind host round work:
+            # overlap / (overlap + blocked collect); 1.0 = the collect
+            # never blocked (obs/profiler.py reads the same definition)
+            "overlap_efficiency": round(
+                self.pipeline_overlap_ns
+                / max(self.pipeline_overlap_ns + self.device_ns, 1), 4),
         }
 
 

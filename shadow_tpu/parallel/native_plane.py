@@ -275,6 +275,18 @@ class NativeGlobalPolicy(GlobalSinglePolicy):
         super().__init__()
         self._plane = plane
         self.serial = True
+        # native-plane call spans (ISSUE 3): bound ONCE at construction —
+        # the traced wrapper only exists when the run is traced, so the
+        # untraced hot path pays nothing (c.run is called per pop-loop
+        # leg, far too hot for a per-call enabled check)
+        from ..obs.trace import get_tracer
+        self._tracer = get_tracer()
+        self._run_c = self._run_c_traced if self._tracer.enabled \
+            else plane.c.run
+
+    def _run_c_traced(self, t, d, s, q) -> None:
+        with self._tracer.span("native.run", "native", sim_ns=int(t)):
+            self._plane.c.run(t, d, s, q)
 
     def push(self, event, worker_id: int, barrier: int) -> None:
         if event.dst_host is not event.src_host and event.time < barrier:
@@ -299,11 +311,11 @@ class NativeGlobalPolicy(GlobalSinglePolicy):
                 # window end); callbacks may add Python events and shrink
                 # the horizon, so re-evaluate afterwards
                 if py_ok:
-                    c.run(pk[0], pk[1], pk[2], pk[3])
+                    self._run_c(pk[0], pk[1], pk[2], pk[3])
                 else:
                     # int(): window_end inherits float-ness from fractional
                     # <shadow stoptime> configs
-                    c.run(int(window_end), _SENT_D, _SENT_D, _SENT_Q)
+                    self._run_c(int(window_end), _SENT_D, _SENT_D, _SENT_Q)
                 continue
             if not py_ok:
                 return None

@@ -100,6 +100,7 @@ def _shard_body(conn, options, config) -> None:
     engine.schedule_boot()
     worker = Worker(0, engine)
     set_current_worker(worker)
+    tracer = engine.tracer
 
     import gc
     gc_was_enabled = gc.isenabled()
@@ -134,10 +135,15 @@ def _shard_body(conn, options, config) -> None:
             worker.round_end = we
             if engine.native_plane is not None:
                 engine.native_plane.set_window(we)
-            worker.run_round()
-            engine._flush_round()
+            with tracer.span("round", "engine", sim_ns=ws,
+                             args={"round": engine.rounds_executed,
+                                   "shard": engine.shard_id}):
+                worker.run_round()
+            with tracer.span("flush", "engine", sim_ns=ws):
+                engine._flush_round()
             conn.send(("out", engine.drain_outboxes()))
-            inbox = conn.recv()[1]
+            with tracer.span("exchange", "engine", sim_ns=ws):
+                inbox = conn.recv()[1]
             for t, dst_id, src_id, seq, wire in inbox:
                 if engine.native_plane is not None:
                     # C-plane shard: the hop lands straight in the C event
@@ -190,6 +196,21 @@ def _shard_body(conn, options, config) -> None:
         if engine.owns_host(host):
             engine.counters.count_free("host")
     log.flush()
+    # observability merge (ISSUE 3): the shard's flight-recorder ring and
+    # metrics scrape ride the final message; the parent merges traces onto
+    # per-shard tracks (Chrome pid = shard id) and folds the scrapes into
+    # its summary.  Shard engines never export/write files themselves.
+    from ..obs.metrics import get_metrics
+    from ..obs.trace import get_tracer
+    if get_metrics().enabled:
+        # closing tracker sweep (same as Engine._obs_finish): the shard's
+        # scrape ships end-of-run tracker totals to the parent summary,
+        # and the heartbeat lines it logs need one more flush to reach
+        # the shard's log (the earlier flush predates the sweep)
+        for host in engine.hosts.values():
+            if engine.owns_host(host):
+                host.tracker.heartbeat(engine.scheduler.window_start)
+        log.flush()
     conn.send(("final", {
         "events": events,
         "rounds": engine.rounds_executed,
@@ -199,6 +220,11 @@ def _shard_body(conn, options, config) -> None:
         "counters_new": dict(engine.counters._new),
         "counters_free": dict(engine.counters._free),
         "wall": _walltime.monotonic() - engine.sim_start_wall,
+        "trace_events": get_tracer().drain(),
+        "trace_epoch": get_tracer().epoch,
+        "trace_dropped": get_tracer().dropped,
+        "metrics": get_metrics().scrape(),
+        "supervision": engine.supervision.summary(),
     }))
 
 
@@ -266,6 +292,13 @@ class ProcsController:
         self.resume_verified = False
         from ..core.supervision import SupervisionStats
         self.supervision = SupervisionStats()
+        # parent-side observability: the parent owns the merged trace file
+        # (per-shard tracks) and the metrics summary; its own track is
+        # labeled 'parent' on a pid past the shard range
+        from ..obs import configure_observability
+        self.tracer, self.metrics, self._metrics_writer = \
+            configure_observability(options, shard_id=self.n_shards,
+                                    label="parent")
 
     def _child_options(self, shard_id: int):
         import dataclasses
@@ -323,8 +356,11 @@ class ProcsController:
             except ShardDeadError:
                 # the ledger records the detection (it aborts the run, but
                 # distinguishes 'we caught a dead shard cleanly' from 'a
-                # shard reported its own error')
+                # shard reported its own error'); the abort carries the
+                # parent's recent timeline, like every other recovery seam
                 self.supervision.shard_deaths_detected += 1
+                self.supervision._dump_flight_recorder(
+                    f"shard {sid} death detected")
                 raise
 
         try:
@@ -360,21 +396,31 @@ class ProcsController:
                     f"(t={resume_snap['sim_time_ns'] / 1e9:.3f}s): "
                     "replaying to the snapshot boundary, digest-verified "
                     "there")
+            self.metrics.source(
+                "procs", lambda: {"procs.rounds": self.rounds_executed,
+                                  "procs.shards": n})
+            self.metrics.source(
+                "supervision",
+                lambda: {f"supervision.{k}": v
+                         for k, v in self.supervision.summary().items()})
             last_ws = 0
             while True:
                 nxt = min(m[1] for m in mins)
                 if nxt >= end_time or nxt >= stime.SIM_TIME_MAX:
                     break
                 ws, we = nxt, min(nxt + lookahead, end_time)
-                for c in conns:
-                    c.send(("run", ws, we))
-                outs = [recv(c)[1] for c in conns]
-                for sid, c in enumerate(conns):
-                    inbox = []
-                    for o in outs:
-                        inbox.extend(o[sid])
-                    c.send(("in", inbox))
-                mins = [recv(c) for c in conns]
+                with self.tracer.span("round", "procs", sim_ns=ws,
+                                      args={"round": self.rounds_executed}):
+                    for c in conns:
+                        c.send(("run", ws, we))
+                    outs = [recv(c)[1] for c in conns]
+                    with self.tracer.span("exchange", "procs", sim_ns=ws):
+                        for sid, c in enumerate(conns):
+                            inbox = []
+                            for o in outs:
+                                inbox.extend(o[sid])
+                            c.send(("in", inbox))
+                        mins = [recv(c) for c in conns]
                 last_ws = ws
                 if resume_snap is not None \
                         and ws >= resume_snap["sim_time_ns"]:
@@ -387,9 +433,15 @@ class ProcsController:
                 # snapshot names and digests line up with a serial run)
                 if writer is not None \
                         and writer.due(ws, self.rounds_executed):
-                    self._write_checkpoint(conns, recv, ws,
-                                           sum(m[2] for m in mins), writer)
+                    with self.tracer.span("checkpoint.write", "procs",
+                                          sim_ns=ws):
+                        self._write_checkpoint(conns, recv, ws,
+                                               sum(m[2] for m in mins),
+                                               writer)
                 self.rounds_executed += 1
+                if self._metrics_writer is not None:
+                    self._metrics_writer.maybe_write(
+                        self.metrics, self.rounds_executed, ws)
 
             if resume_snap is not None:
                 from ..core.checkpoint import warn_resume_unreached
@@ -397,6 +449,20 @@ class ProcsController:
             for c in conns:
                 c.send(("stop",))
             finals = [recv(c)[1] for c in conns]
+        except BaseException:
+            # abnormal termination (shard death, protocol error): export
+            # the parent's own flight-recorder events best-effort so the
+            # abort keeps its timeline; shard rings die with their
+            # processes — the log dump in the recv handler is their trace
+            try:
+                if self.tracer.enabled:
+                    self.tracer.export()
+                if self._metrics_writer is not None:
+                    self._metrics_writer.write_summary(
+                        self.metrics, self.rounds_executed, 0)
+            except Exception:
+                pass
+            raise
         finally:
             # closing the pipes first unblocks any shard still parked in
             # conn.recv() (EOFError -> exit), so a mid-run failure tears
@@ -433,8 +499,39 @@ class ProcsController:
             f"{_walltime.monotonic() - t_start:.3f}s wall")
         if totals.leaks():
             log.message("procs", totals.report())
+        self._obs_finish(finals, totals, last_ws)
         log.flush()
         return 1 if plugin_errors else 0
+
+    def _obs_finish(self, finals, totals, last_ws: int) -> None:
+        """Merge the shards' observability payloads: flight-recorder rings
+        land on per-shard tracks in ONE trace file; metrics scrapes and the
+        assembled leak report land in the parent's summary record."""
+        if self.tracer.enabled:
+            for f in finals:
+                self.tracer.ingest(f.get("trace_events") or [],
+                                   f.get("trace_epoch"))
+                # the merged file's drop count must cover the SHARDS' ring
+                # evictions, not just the parent's (no silent truncation)
+                self.tracer.dropped += int(f.get("trace_dropped") or 0)
+            path = self.tracer.export()
+            if path:
+                get_logger().message("procs", f"trace written: {path}")
+        if self._metrics_writer is not None:
+            for key, val in totals.summary().items():
+                self.metrics.set_summary_info(key, val)
+            self.metrics.set_summary_info(
+                "shards", [f.get("metrics", {}) for f in finals])
+            self.metrics.set_summary_info(
+                "shard_supervision", [f.get("supervision", {})
+                                      for f in finals])
+            self._metrics_writer.write_summary(self.metrics,
+                                               self.rounds_executed,
+                                               last_ws)
+            get_logger().message(
+                "procs",
+                f"metrics written: {self._metrics_writer.path} "
+                f"({self._metrics_writer.records_written} records)")
 
     def _collect_assembled(self, conns, recv, ws: int, pending: int) -> Dict:
         """Gather every shard's host states and assemble the canonical

@@ -34,6 +34,7 @@ from ..core import stime
 from ..core.logger import get_logger
 from ..descriptor.base import Descriptor, S_CLOSED, S_READABLE, S_WRITABLE
 from ..descriptor.epoll import Epoll, EPOLLERR, EPOLLHUP, EPOLLIN, EPOLLOUT
+from ..obs.trace import get_tracer
 from .process import _Block, _Sleep
 
 # -- protocol constants (mirror native/preload/protocol.h) -------------------
@@ -737,6 +738,21 @@ def _read_exact_raising(conn: real_socket.socket, n: int) -> Optional[bytes]:
     return b"".join(chunks)
 
 
+def _dispatch_traced(tracer, kernel, name: str, op: int, a, b, c, d,
+                     payload):
+    """One native-plugin RPC wrapped in a ``plugin.rpc`` span (ISSUE 3:
+    plugin execution is a named span).  Only called on TRACED runs — the
+    serve loops call ``kernel.dispatch`` directly otherwise, so the
+    disabled per-syscall path gains no extra generator frame.  The span's
+    wall duration covers any virtual-time blocking the syscall performed —
+    i.e. it is the request's *service* time, which is what the flight
+    recorder wants around a watchdog fire."""
+    with tracer.span("plugin.rpc", "plugin", sim_ns=kernel.api.now_ns(),
+                     args={"op": op, "proc": name}):
+        ret = yield from kernel.dispatch(op, a, b, c, d, payload)
+    return ret
+
+
 def run_native_plugin(api, args: List[str], binary: str,
                       extra_env: Optional[dict] = None):
     """App-main generator serving one native plugin process.
@@ -797,6 +813,7 @@ def run_native_plugin(api, args: List[str], binary: str,
     _live_children.append(proc)
     child_side.close()
     kernel = NativeKernel(api, sim_side)
+    tracer = get_tracer()
     wd = _watchdog_sec(api)
     stall_after = _fault_stall_after(api)
     served = 0
@@ -877,8 +894,12 @@ def run_native_plugin(api, args: List[str], binary: str,
                     payload = None      # reset mid-payload = plugin exit
                 if payload is None:
                     break
-            ret, resp_payload = yield from kernel.dispatch(op, a, b, c, d,
-                                                           payload)
+            if tracer.enabled:
+                ret, resp_payload = yield from _dispatch_traced(
+                    tracer, kernel, name, op, a, b, c, d, payload)
+            else:
+                ret, resp_payload = yield from kernel.dispatch(
+                    op, a, b, c, d, payload)
             resp = RESP_HDR.pack(RESP_HDR.size + len(resp_payload), 0,
                                  int(ret), api.now_ns()) + resp_payload
             try:
@@ -1031,6 +1052,7 @@ def run_pooled_plugin(api, args: List[str], so_path: str):
         log.warning("native", f"{name}: pool add_instance failed: {e}")
         return 127
     kernel = NativeKernel(api, sim_side)
+    tracer = get_tracer()
     wd = _watchdog_sec(api)
     sim_side.settimeout(wd)
     try:
@@ -1060,8 +1082,12 @@ def run_pooled_plugin(api, args: List[str], so_path: str):
                     payload = None      # reset mid-payload = instance exit
                 if payload is None:
                     break
-            ret, resp_payload = yield from kernel.dispatch(op, a, b, c, d,
-                                                           payload)
+            if tracer.enabled:
+                ret, resp_payload = yield from _dispatch_traced(
+                    tracer, kernel, name, op, a, b, c, d, payload)
+            else:
+                ret, resp_payload = yield from kernel.dispatch(
+                    op, a, b, c, d, payload)
             resp = RESP_HDR.pack(RESP_HDR.size + len(resp_payload), 0,
                                  int(ret), api.now_ns()) + resp_payload
             try:

@@ -29,6 +29,7 @@ from ..core.logger import get_logger
 from ..core.task import Task
 from ..descriptor.base import S_CLOSED, S_READABLE, S_WRITABLE
 from ..core.worker import current_worker
+from ..obs.trace import NULL_SPAN, get_tracer
 
 RUNNABLE = "runnable"
 BLOCKED = "blocked"
@@ -174,17 +175,24 @@ class Process:
 
     def continue_(self) -> None:
         """Resume all runnable green threads until everything blocks
-        (reference process_continue :1197-1275)."""
+        (reference process_continue :1197-1275).  One plugin-execution
+        span per resume when the run is traced (ISSUE 3: plugin execution
+        is a named span, like the reference's process_continue timings)."""
         self._continue_scheduled = False
         if self.exited:
             return
-        progressed = True
-        while progressed:
-            progressed = False
-            for t in list(self.threads):
-                if t.state == RUNNABLE:
-                    progressed = True
-                    self._run_thread(t)
+        tracer = get_tracer()
+        span = tracer.span("plugin.continue", "plugin", sim_ns=self.host.now,
+                           args={"proc": self.name}) \
+            if tracer.enabled else NULL_SPAN
+        with span:
+            progressed = True
+            while progressed:
+                progressed = False
+                for t in list(self.threads):
+                    if t.state == RUNNABLE:
+                        progressed = True
+                        self._run_thread(t)
         if all(t.state == DONE for t in self.threads) and not self.exited:
             main_done = self.threads[0].state == DONE if self.threads else True
             if main_done:

@@ -1,0 +1,151 @@
+"""Trace report: summarize a flight-recorder trace file so CI and humans
+read the same numbers.
+
+Input is the Chrome trace-event JSON ``--trace PATH`` writes (obs/trace.py);
+output is ONE JSON document on stdout:
+
+* ``top_spans_by_self_time`` — per span name: count, total, self (total
+  minus same-track children), mean — the profile's headline table;
+* ``per_round_phase`` — wall totals of the engine's round phases
+  (collect / dispatch.launch / round / flush / log.flush) plus per-round
+  means, i.e. the BENCH phase columns recomputed from the trace itself;
+* ``overlap_efficiency`` — device.inflight (device compute hidden behind
+  host work) vs device.collect (exposed wait), the pipeline's honesty
+  number;
+* ``tracks`` — per (shard, thread) event counts, so a sharded run's merge
+  is checkable at a glance (one entry per shard track).
+
+Usage: python -m shadow_tpu.tools.trace_report <trace.json> [--pretty]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from collections import defaultdict
+from typing import Dict, Iterable, List
+
+ROUND_PHASES = ("collect", "dispatch.launch", "round", "flush", "log.flush",
+                "checkpoint.write", "exchange")
+
+
+def load_events(path: str) -> List[dict]:
+    with open(path) as f:
+        blob = json.load(f)
+    if isinstance(blob, dict):
+        events = blob.get("traceEvents", [])
+    else:                      # bare-array form is legal Chrome JSON too
+        events = blob
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: traceEvents is not a list")
+    return [e for e in events if e.get("ph") != "M"]
+
+
+def self_times(events: Iterable[dict]) -> Dict[str, Dict[str, float]]:
+    """Aggregate complete ('X') spans by name with self-time: duration
+    minus the duration of spans nested inside them on the same track
+    (computed with a containment stack per track, the standard flame-graph
+    fold).  A span that merely OVERLAPS its predecessor — starts inside it
+    but ends after, like the async ``device.inflight`` window stretching
+    from one round's launch into the next round's collect — is not a
+    child: it neither discounts the enclosing span's self-time nor becomes
+    a parent for later spans."""
+    by_track: Dict[tuple, List[dict]] = defaultdict(list)
+    for e in events:
+        if e.get("ph") == "X":
+            by_track[(e.get("pid", 0), e.get("tid", ""))].append(e)
+    agg: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"count": 0, "total_us": 0.0, "self_us": 0.0})
+    for track_events in by_track.values():
+        track_events.sort(key=lambda e: (e["ts"], -e.get("dur", 0.0)))
+        stack: List[tuple] = []     # (end_ts, name) of open CONTAINED spans
+        for e in track_events:
+            ts, dur = e["ts"], e.get("dur", 0.0)
+            end = ts + dur
+            while stack and ts >= stack[-1][0]:
+                stack.pop()
+            contained = not stack or end <= stack[-1][0] + 1e-6
+            if stack and contained:  # true child: charge parent self-time
+                agg[stack[-1][1]]["self_us"] -= dur
+            a = agg[e["name"]]
+            a["count"] += 1
+            a["total_us"] += dur
+            a["self_us"] += dur
+            if contained:
+                stack.append((end, e["name"]))
+    return dict(agg)
+
+
+def summarize(events: List[dict]) -> Dict:
+    events = [e for e in events if e.get("ph") != "M"]
+    spans = self_times(events)
+    top = sorted(
+        ({"name": name, "count": int(v["count"]),
+          "total_ms": round(v["total_us"] / 1e3, 3),
+          "self_ms": round(max(v["self_us"], 0.0) / 1e3, 3),
+          "mean_us": round(v["total_us"] / max(v["count"], 1), 1)}
+         for name, v in spans.items()),
+        key=lambda r: -r["self_ms"])
+    rounds = spans.get("round", {}).get("count", 0)
+    phases: Dict[str, Dict[str, float]] = {}
+    for name in ROUND_PHASES:
+        v = spans.get(name)
+        if not v:
+            continue
+        phases[name] = {"total_ms": round(v["total_us"] / 1e3, 3),
+                        "mean_us": round(v["total_us"] / max(v["count"], 1),
+                                         1)}
+    inflight = spans.get("device.inflight", {}).get("total_us", 0.0)
+    blocked = spans.get("device.collect", {}).get("total_us", 0.0)
+    tracks: Dict[str, int] = defaultdict(int)
+    sim_min = sim_max = None
+    for e in events:
+        tracks[f"{e.get('pid', 0)}:{e.get('tid', '')}"] += 1
+        sim = e.get("args", {}).get("sim_ns")
+        if isinstance(sim, (int, float)) and sim >= 0:
+            sim_min = sim if sim_min is None else min(sim_min, sim)
+            sim_max = sim if sim_max is None else max(sim_max, sim)
+    return {
+        "events": len(events),
+        "rounds": int(rounds),
+        "tracks": dict(sorted(tracks.items())),
+        "shards": sorted({e.get("pid", 0) for e in events}),
+        "sim_span_s": (round((sim_max - sim_min) / 1e9, 3)
+                       if sim_min is not None else None),
+        "top_spans_by_self_time": top[:15],
+        "per_round_phase": phases,
+        "device": {
+            "inflight_ms": round(inflight / 1e3, 3),
+            "collect_blocked_ms": round(blocked / 1e3, 3),
+            "overlap_efficiency": round(inflight / (inflight + blocked), 4)
+            if (inflight + blocked) else None,
+        },
+    }
+
+
+def main(argv: List[str]) -> int:
+    if not argv:
+        print("usage: python -m shadow_tpu.tools.trace_report "
+              "<trace.json> [--pretty]", file=sys.stderr)
+        return 2
+    pretty = "--pretty" in argv
+    paths = [a for a in argv if not a.startswith("--")]
+    if not paths:
+        print("usage: python -m shadow_tpu.tools.trace_report "
+              "<trace.json> [--pretty]", file=sys.stderr)
+        return 2
+    path = paths[0]
+    try:
+        events = load_events(path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: cannot read trace {path!r}: {e}", file=sys.stderr)
+        return 1
+    report = summarize(events)
+    json.dump(report, sys.stdout, indent=2 if pretty else None,
+              sort_keys=True)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

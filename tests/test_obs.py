@@ -1,0 +1,326 @@
+"""Flight-recorder observability gates (ISSUE 3).
+
+1. Schema: a traced device-plane run emits valid Chrome trace-event JSON —
+   required keys, complete-span durations, monotonic per-track timestamps —
+   with spans for round/dispatch/collect/plugin (+ checkpoint when
+   checkpointing), and trace_report.py summarizes it.
+2. Determinism: two identically-seeded runs produce identical sim-time
+   event streams (wall-time fields excluded) — the trace-stream mirror of
+   the log-diff determinism gate.
+3. Parity: digests are identical with observability on and off.
+4. Metrics: the JSONL stream + summary absorb the ObjectCounter (a
+   deliberate leak is reported), SupervisionStats, tracker heartbeats, and
+   the phase timings bench.py reads; the legacy heartbeat log lines keep
+   working against the same values (plot_log regexes).
+5. Fault recovery dumps the flight recorder's recent spans.
+6. A sharded run's merged trace contains tracks from every shard.
+7. The disabled path costs ~0 (obs_overhead microbench sanity).
+"""
+
+import io
+import json
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.checkpoint import state_digest
+from shadow_tpu.core.controller import Controller, run_simulation
+from shadow_tpu.core.logger import SimLogger, set_logger
+from shadow_tpu.core.options import Options
+from shadow_tpu.obs.metrics import read_metrics_file
+from shadow_tpu.tools import trace_report, workloads
+
+
+def _run_device(tmp_path, tag, stop=60, seed=3, trace=True, metrics=True,
+                **opt_kw):
+    """Small tor device-plane workload (the test_device_pipeline shape)
+    with observability on; returns (ctrl, log_text, trace_path,
+    metrics_path)."""
+    sink = io.StringIO()
+    set_logger(SimLogger(stream=sink, level="message"))
+    try:
+        xml = workloads.tor_network(8, n_clients=5, n_servers=2,
+                                    stoptime=stop,
+                                    stream_spec="512:20200",
+                                    device_data=True)
+        cfg = configuration.parse_xml(xml)
+        cfg.stop_time_sec = stop
+        tp = str(tmp_path / f"trace_{tag}.json") if trace else None
+        mp_ = str(tmp_path / f"metrics_{tag}.jsonl") if metrics else None
+        opts = Options(scheduler_policy="global", workers=0, seed=seed,
+                       stop_time_sec=stop, log_level="message",
+                       heartbeat_interval_sec=10,
+                       trace_path=tp, metrics_path=mp_,
+                       metrics_every_rounds=20, **opt_kw)
+        ctrl = Controller(opts, cfg)
+        assert ctrl.run() == 0
+    finally:
+        set_logger(SimLogger())
+    return ctrl, sink.getvalue(), tp, mp_
+
+
+def _load_trace(path):
+    with open(path) as f:
+        blob = json.load(f)
+    assert isinstance(blob, dict) and isinstance(blob["traceEvents"], list)
+    return blob["traceEvents"]
+
+
+def _sim_stream(events):
+    """The deterministic projection of a trace: per-track ordered
+    (name, cat, ph, sim_ns) tuples — every wall field excluded, and the
+    wall-clock-GATED engine heartbeat dropped exactly like strip_log drops
+    its log line (its presence depends on wall time, not sim state)."""
+    out = []
+    for e in events:
+        if e.get("ph") == "M" or e["name"] == "engine.heartbeat":
+            continue
+        out.append((e["pid"], e["tid"], e["name"], e["cat"], e["ph"],
+                    e.get("args", {}).get("sim_ns")))
+    return out
+
+
+def test_trace_schema_and_report(tmp_path):
+    ctrl, _log, tp, _mp = _run_device(tmp_path, "schema",
+                                      checkpoint_every_rounds=50,
+                                      checkpoint_dir=str(tmp_path / "ckpt"))
+    events = _load_trace(tp)
+    names = set()
+    last_ts = {}
+    for e in events:
+        assert set(e) >= {"name", "ph", "pid", "tid"}, e
+        if e["ph"] == "M":
+            continue
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        names.add(e["name"])
+        assert "sim_ns" in e["args"]
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+        # exported timestamps are monotonic per (pid, tid) track
+        key = (e["pid"], e["tid"])
+        assert e["ts"] >= last_ts.get(key, -1.0), f"ts regressed on {key}"
+        last_ts[key] = e["ts"]
+    # the acceptance span set: round / device dispatch+collect / plugin /
+    # checkpoint all present in one traced run
+    for required in ("round", "device.dispatch", "device.collect",
+                     "device.inflight", "plugin.continue", "collect",
+                     "checkpoint.write"):
+        assert required in names, f"missing span {required} in {names}"
+    report = trace_report.summarize(events)
+    assert report["rounds"] > 0
+    assert report["events"] == sum(1 for e in events if e["ph"] != "M")
+    assert report["per_round_phase"]["round"]["total_ms"] > 0
+    assert report["device"]["overlap_efficiency"] is not None
+    top = {r["name"] for r in report["top_spans_by_self_time"]}
+    assert "round" in top
+
+
+def test_trace_simtime_stream_deterministic(tmp_path):
+    _c1, _l1, tp1, _m1 = _run_device(tmp_path, "det1")
+    _c2, _l2, tp2, _m2 = _run_device(tmp_path, "det2")
+    s1 = _sim_stream(_load_trace(tp1))
+    s2 = _sim_stream(_load_trace(tp2))
+    assert s1 == s2, "sim-time trace streams differ between seeded runs"
+    # the gate compared something substantial: real engine + device +
+    # plugin spans, at more than one virtual time
+    names = {t[2] for t in s1}
+    assert {"round", "device.dispatch", "plugin.continue"} <= names
+    assert len({t[5] for t in s1}) > 2
+
+
+def test_digest_parity_with_obs_enabled(tmp_path):
+    on, _log, _tp, _mp = _run_device(tmp_path, "obs_on")
+    off, _log2, _tp2, _mp2 = _run_device(tmp_path, "obs_off",
+                                         trace=False, metrics=False)
+    assert state_digest(on.engine) == state_digest(off.engine), \
+        "observability changed simulation state"
+
+
+def test_metrics_stream_and_summary(tmp_path):
+    ctrl, log_text, _tp, mp_ = _run_device(tmp_path, "metrics")
+    recs = read_metrics_file(mp_)
+    assert len(recs) >= 2
+    cadence = [r for r in recs if not r.get("summary")]
+    summary = recs[-1]
+    assert summary["summary"] is True
+    for r in cadence:
+        assert r["round"] % 20 == 0
+        assert r["sim_time_ns"] >= 0
+    m = summary["metrics"]
+    # engine phase timings (what bench.py reads), plane stats, supervision
+    assert m["engine.rounds"] == ctrl.engine.rounds_executed
+    assert m["engine.flush_sec"] >= 0
+    assert m["plane.dispatches"] == ctrl.engine.device_plane.dispatches
+    assert m["supervision.recoveries"] == 0
+    assert 0.0 <= m["plane.overlap_efficiency"] <= 1.0
+    # device profiler histograms carry every dispatch
+    assert m["device.dispatch_launch_us"]["count"] \
+        == ctrl.engine.device_plane.dispatches
+    assert m["device.flush_bytes"]["count"] >= 1
+    assert m["device.flush_bytes"]["min"] > 0
+    # tracker heartbeats were promoted: aggregate totals present and equal
+    # to the sum over host trackers
+    assert m["tracker.hosts_reporting"] >= 1
+    want_rx = sum(h.tracker.in_remote.bytes_total
+                  for h in ctrl.engine.hosts.values())
+    assert m["tracker.rx"] == want_rx
+    # object accounting landed in the summary (no leaks in a clean run)
+    assert summary["object_leaks"] == {}
+    assert summary["object_counts"]["host"][0] > 0
+    # the legacy log lines kept working against the same values
+    from shadow_tpu.tools.parse_log import parse_log
+    parsed = parse_log(log_text.splitlines())
+    assert parsed["total_rx_bytes"] == want_rx
+
+
+def test_deliberate_leak_reported_in_summary(tmp_path):
+    sink = io.StringIO()
+    set_logger(SimLogger(stream=sink, level="message"))
+    try:
+        xml = workloads.star_bulk(3, stoptime=10, bulk_bytes=4096)
+        cfg = configuration.parse_xml(xml)
+        cfg.stop_time_sec = 10
+        mp_ = str(tmp_path / "leak_metrics.jsonl")
+        ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                                  stop_time_sec=10, log_level="message",
+                                  metrics_path=mp_), cfg)
+        ctrl.setup()
+        # the deliberate leak: an object counted new and never freed
+        ctrl.engine.counters.count_new("leaky_widget", 3)
+        from shadow_tpu.parallel.device_plane import build_plane_from_engine
+        ctrl.engine.device_plane = build_plane_from_engine(ctrl.engine)
+        assert ctrl.engine.run() == 0
+    finally:
+        set_logger(SimLogger())
+    summary = read_metrics_file(mp_)[-1]
+    assert summary["object_leaks"]["leaky_widget"] == 3
+    assert summary["object_counts"]["leaky_widget"] == [3, 0]
+    # the legacy shutdown report still prints too
+    assert "leaky_widget" in sink.getvalue()
+
+
+def test_fault_recovery_dumps_flight_recorder(tmp_path):
+    ctrl, log_text, _tp, _mp = _run_device(
+        tmp_path, "fault", fault_inject="device-dispatch:2",
+        device_plane="device")
+    plane = ctrl.engine.device_plane
+    assert plane.recoveries == 1 and plane.demoted
+    assert "flight recorder: last" in log_text
+    assert "[flight-recorder]" in log_text
+    # the dumped timeline names real spans
+    assert any(s in log_text for s in ("device.dispatch", "round"))
+
+
+def test_fault_recovery_without_trace_notes_disabled(tmp_path):
+    ctrl, log_text, _tp, _mp = _run_device(
+        tmp_path, "fault_untraced", trace=False, metrics=False,
+        fault_inject="device-dispatch:2")
+    assert ctrl.engine.device_plane.recoveries == 1
+    assert "flight recorder: no spans buffered" in log_text
+
+
+def test_sharded_trace_merges_all_shards(tmp_path):
+    xml = workloads.star_bulk(6, stoptime=15, bulk_bytes=16384)
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = 15
+    tp = str(tmp_path / "sharded_trace.json")
+    mp_ = str(tmp_path / "sharded_metrics.jsonl")
+    set_logger(SimLogger(stream=io.StringIO(), level="warning"))
+    try:
+        rc = run_simulation(
+            Options(scheduler_policy="global", workers=0, processes=2,
+                    stop_time_sec=15, log_level="warning",
+                    trace_path=tp, metrics_path=mp_), cfg)
+    finally:
+        set_logger(SimLogger())
+    assert rc == 0
+    events = _load_trace(tp)
+    report = trace_report.summarize(
+        [e for e in events if e.get("ph") != "M"])
+    # tracks from every shard (pids 0, 1) plus the parent (pid 2)
+    assert set(report["shards"]) == {0, 1, 2}
+    shard_names = {e["name"] for e in events if e.get("pid") in (0, 1)}
+    assert "round" in shard_names        # shard engines recorded spans
+    parent_names = {e["name"] for e in events if e.get("pid") == 2}
+    assert "exchange" in parent_names    # the parent's own protocol spans
+    # parent summary folded the shard scrapes in
+    summary = read_metrics_file(mp_)[-1]
+    assert summary["summary"] is True
+    assert len(summary["shards"]) == 2
+    assert all("engine.rounds" in s for s in summary["shards"])
+
+
+def test_abort_still_exports_trace(tmp_path):
+    """Abnormal termination keeps its post-mortem: a dead-shard abort
+    still exports the parent's flight recorder and closes the metrics
+    stream with a summary (the emergency path, not _obs_finish)."""
+    import pytest
+
+    from shadow_tpu.parallel.procs import ProcsController
+    xml = workloads.star_bulk(6, stoptime=30, bulk_bytes=16384)
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = 30
+    tp = str(tmp_path / "abort_trace.json")
+    mp_ = str(tmp_path / "abort_metrics.jsonl")
+    set_logger(SimLogger(stream=io.StringIO(), level="warning"))
+    try:
+        ctrl = ProcsController(
+            Options(scheduler_policy="global", workers=0, seed=7,
+                    stop_time_sec=30, processes=2, log_level="warning",
+                    fault_inject="shard-exit:1:3",
+                    trace_path=tp, metrics_path=mp_), cfg)
+        with pytest.raises(RuntimeError):
+            ctrl.run()
+    finally:
+        set_logger(SimLogger())
+    events = _load_trace(tp)        # the file exists and is valid JSON
+    assert any(e["name"] == "round" for e in events)   # parent spans made it
+    assert read_metrics_file(mp_)[-1]["summary"] is True
+
+
+def test_native_plugin_rpc_spans(tmp_path, native_bin):
+    """A traced run with a REAL native binary records plugin.rpc spans
+    (the native half of plugin-execution coverage; the Python half is
+    plugin.continue, covered above)."""
+    import textwrap
+    xml = textwrap.dedent(f"""\
+        <shadow stoptime="30">
+          <plugin id="app" path="{native_bin}" />
+          <host id="node">
+            <process plugin="app" starttime="1" arguments="vtime" />
+          </host>
+        </shadow>
+    """)
+    sink = io.StringIO()
+    set_logger(SimLogger(stream=sink, level="warning"))
+    try:
+        cfg = configuration.parse_xml(xml)
+        cfg.stop_time_sec = 30
+        tp = str(tmp_path / "native_trace.json")
+        ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                                  stop_time_sec=30, log_level="warning",
+                                  data_directory=str(tmp_path / "data"),
+                                  trace_path=tp), cfg)
+        assert ctrl.run() == 0
+    finally:
+        set_logger(SimLogger())
+    rpc = [e for e in _load_trace(tp) if e["name"] == "plugin.rpc"]
+    assert rpc, "no plugin.rpc spans recorded for a native plugin run"
+    assert all(e["args"]["proc"] == "node.app" for e in rpc)
+    assert {e["args"]["op"] for e in rpc} != set()
+
+
+def test_disabled_overhead_is_small():
+    from shadow_tpu.obs import disabled_overhead_sec
+    # 6 hooks/round x 10k rounds of disabled spans must be far under a
+    # second even on a loaded box (measured ~5-10 ms)
+    assert disabled_overhead_sec(60_000) < 1.0
+
+
+def test_options_cli_roundtrip():
+    from shadow_tpu.core.options import parse_args
+    opts = parse_args(["--trace", "/tmp/t.json", "--trace-ring", "1024",
+                       "--metrics", "/tmp/m.jsonl", "--metrics-every", "7",
+                       "cfg.xml"])
+    assert opts.trace_path == "/tmp/t.json"
+    assert opts.trace_ring == 1024
+    assert opts.metrics_path == "/tmp/m.jsonl"
+    assert opts.metrics_every_rounds == 7
