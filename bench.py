@@ -587,6 +587,15 @@ def main() -> None:
     # run, reported alongside as tor200_tpu for continuity)
     tor200 = sims["tor200_serial"]["sim_sec_per_wall_sec"]
     c_rate = chot.get("c_hotloop_events_per_sec")
+    # static-analysis health (ISSUE 4): the same simlint pass the tier-1
+    # gate enforces, timed — findings must stay 0 and the pass must stay
+    # cheap enough to run on every PR
+    from shadow_tpu.analysis.simlint import lint_paths, load_config
+    _repo = os.path.dirname(os.path.abspath(__file__))
+    _lint_t0 = time.perf_counter()
+    _lint = lint_paths([os.path.join(_repo, "shadow_tpu")],
+                       load_config(os.path.join(_repo, "pyproject.toml")))
+    simlint_sec = round(time.perf_counter() - _lint_t0, 3)
     out = {
         "metric": "tor200_sim_sec_per_wall_sec",
         "value": tor200,
@@ -611,6 +620,9 @@ def main() -> None:
             "also failed (see c_hotloop keys)"),
         "cpu_cores": multiprocessing.cpu_count(),
         "device": jax.devices()[0].platform,
+        "simlint_findings": len(_lint.unsuppressed),
+        "simlint_suppressed": len(_lint.suppressed),
+        "simlint_sec": simlint_sec,
         "kernel_transfer_inclusive_mpkts": round(dev_rate / 1e6, 3),
         "kernel_device_compute_mpkts": round(dev_compute / 1e6, 2),
         "own_scalar_python_mpkts": round(cpu_rate / 1e6, 4),
@@ -688,6 +700,9 @@ def main() -> None:
         # workload — must be ~0 (ISSUE 3)
         "obs_overhead_sec":
             sims.get("tor200_serial", {}).get("obs_overhead_sec"),
+        # static-analysis gate (ISSUE 4): must be 0 findings, a few sec
+        "simlint_findings": out["simlint_findings"],
+        "simlint_sec": simlint_sec,
         "gates_enforced": True,
     }
     blob = json.dumps(summary)

@@ -1,0 +1,18 @@
+"""Static-analysis layer: lint-time proofs of the simulator's invariants.
+
+The whole value of this engine is *deterministic* parallel discrete-event
+simulation — every random stream derives from one master seed
+(core/rng.py, mirroring the reference's utility/random.c + master.c:417),
+simulation time is an integer nanosecond clock (core/stime.py), and the
+digest-parity tests pin bit-identical state across every execution seam.
+Those contracts are enforced dynamically by tests, but a test only checks
+where it happens to look; one ``time.monotonic()`` on a sim path or one
+read of a donated JAX buffer silently breaks reproducibility.
+
+``simlint`` (python -m shadow_tpu.analysis.simlint) proves the invariants
+statically, codebase-wide, on every PR — see simlint.py for the engine and
+rules.py for the rule catalog (SIM001-SIM006).  Import
+``shadow_tpu.analysis.simlint`` directly for the API (lint_paths,
+lint_source); the package module stays import-free so ``python -m``
+execution of the submodule is clean.
+"""

@@ -212,10 +212,11 @@ def _peer_loop(api, st, fd):
                 yield from _announce_tx(api, st, tx_id, exclude=fd)
     # a dead peer's undelivered getdata/gettx must not black-hole those
     # items: clear them so another peer's inv re-triggers the request
-    for block_id in inflight:
+    # (sorted: set iteration order is hash-seed-dependent — SIM003)
+    for block_id in sorted(inflight):
         if block_id not in st.blocks:
             st.requested.discard(block_id)
-    for tx_id in tx_inflight:
+    for tx_id in sorted(tx_inflight):
         if tx_id not in st.mempool:
             st.tx_requested.discard(tx_id)
     if fd in st.peers:

@@ -31,7 +31,9 @@ class ObjectCounter:
 
     def leaks(self) -> Dict[str, int]:
         out = {}
-        for k in set(self._new) | set(self._free):
+        # sorted: the leak dict reaches the metrics summary JSON and the
+        # shutdown report — byte-stable output across runs (SIM003)
+        for k in sorted(set(self._new) | set(self._free)):
             d = self._new[k] - self._free[k]
             if d != 0:
                 out[k] = d

@@ -404,7 +404,9 @@ class Engine:
                 self.native_plane.sync_tracker(host.id, host.tracker)
         # teardown: hosts (and their descriptors) are reclaimed here
         for host in self.hosts.values():
-            for iface in set(host.interfaces.values()):
+            # dict.fromkeys: dedupe multi-IP interfaces in insertion order
+            # (set iteration order varies run-to-run — SIM003)
+            for iface in dict.fromkeys(host.interfaces.values()):
                 if iface.pcap is not None:
                     iface.pcap.close()
             self.counters.count_free("host")

@@ -10,7 +10,7 @@ threshold < 0 (the common configuration).
 
 from __future__ import annotations
 
-import time as _wall
+import time as _walltime
 
 
 class CPU:
@@ -30,11 +30,11 @@ class CPU:
 
     def start_measurement(self) -> None:
         if self.enabled:
-            self._measure_start = _wall.perf_counter_ns()
+            self._measure_start = _walltime.perf_counter_ns()
 
     def stop_measurement(self) -> None:
         if self.enabled and self._measure_start is not None:
-            elapsed = _wall.perf_counter_ns() - self._measure_start
+            elapsed = _walltime.perf_counter_ns() - self._measure_start
             self._measure_start = None
             self.add_delay(elapsed)
 

@@ -155,17 +155,20 @@ class MetricsRegistry:
     def scrape(self) -> Dict:
         """One flat {metric: value} snapshot (histograms expand to nested
         dicts).  Works whether or not the registry is enabled."""
+        # sorted everywhere: instrument registration order differs between
+        # engine configurations, and the scrape reaches user-visible JSONL
+        # — explicit ordering keeps reports byte-stable across runs
         out: Dict = {}
-        for name, c in self._counters.items():
+        for name, c in sorted(self._counters.items()):
             out[name] = c.value
-        for name, g in self._gauges.items():
+        for name, g in sorted(self._gauges.items()):
             try:
                 out[name] = g.read()
             except Exception as e:  # a broken gauge fn must not kill a run
                 out[name] = f"gauge_error: {e!r}"
-        for name, h in self._histograms.items():
+        for name, h in sorted(self._histograms.items()):
             out[name] = h.snapshot()
-        for sname, fn in self._sources.items():
+        for sname, fn in sorted(self._sources.items()):
             try:
                 vals = fn() or {}
             except Exception as e:  # a broken source must not kill the run

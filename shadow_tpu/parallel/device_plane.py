@@ -86,8 +86,9 @@ class _PoisonedFlush:
 
     def __array__(self, dtype=None, copy=None):
         if self._hang:
-            import time as _t
-            _t.sleep(30.0)
+            import time as _wt
+            # simlint: disable=SIM005 -- fault harness: a deliberate stall
+            _wt.sleep(30.0)
         raise RuntimeError("fault injection: poisoned device dispatch")
 
 

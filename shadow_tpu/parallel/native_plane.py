@@ -54,21 +54,32 @@ def _load_module():
     native/dataplane.cc is newer than the extension, ``make`` runs (a no-op
     when the artifact is actually current) so a source edit can never be
     masked by an old binary.  If the rebuild fails while a stale .so
-    exists, loading it would silently execute outdated code — refuse."""
+    exists, loading it would silently execute outdated code — refuse.
+
+    ``SHADOW_SANITIZE=address,undefined`` (any -fsanitize= spec) switches
+    to a sanitizer-instrumented twin, ``_shadow_dataplane_san.so``, built
+    via ``make SANITIZE=...`` with ``-fno-omit-frame-pointer`` — a
+    separate artifact so the hardened test run (tests/test_native_sanitize
+    .py) never clobbers the production extension.  Loading an ASan build
+    into a stock interpreter additionally needs the runtime preloaded
+    (LD_PRELOAD=libasan.so); the sanitize test arranges that."""
     global _MOD, _MOD_TRIED
     if _MOD_TRIED:
         return _MOD
     _MOD_TRIED = True
+    san = os.environ.get("SHADOW_SANITIZE", "").strip()
+    artifact = "_shadow_dataplane_san.so" if san else "_shadow_dataplane.so"
+    make_args = [f"SANITIZE={san}"] if san else []
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    path = os.path.join(here, "native", "_shadow_dataplane.so")
+    path = os.path.join(here, "native", artifact)
     src = os.path.join(here, "..", "native", "dataplane.cc")
     stale = (os.path.exists(path) and os.path.exists(src)
              and os.path.getmtime(src) > os.path.getmtime(path))
     if not os.path.exists(path) or stale:
         try:
-            subprocess.run(["make", "-s", os.path.join("..", "shadow_tpu",
-                                                       "native",
-                                                       "_shadow_dataplane.so")],
+            subprocess.run(["make", "-s"] + make_args +
+                           [os.path.join("..", "shadow_tpu", "native",
+                                         artifact)],
                            cwd=os.path.join(here, "..", "native"),
                            check=True, timeout=120)
         except Exception:
@@ -93,9 +104,9 @@ def _load_module():
         # existing file is only replaced if the build succeeds, so a box
         # without a toolchain keeps its checkout intact.
         try:
-            subprocess.run(["make", "-s", "-B",
-                            os.path.join("..", "shadow_tpu", "native",
-                                         "_shadow_dataplane.so")],
+            subprocess.run(["make", "-s", "-B"] + make_args +
+                           [os.path.join("..", "shadow_tpu", "native",
+                                         artifact)],
                            cwd=os.path.join(here, "..", "native"),
                            check=True, timeout=120)
         except Exception:

@@ -190,7 +190,8 @@ def _shard_body(conn, options, config) -> None:
     host_states = {hid: _host_state(h) for hid, h in hosts_by_id.items()
                    if engine.owns_host(h)}
     for host in engine.hosts.values():
-        for iface in set(host.interfaces.values()):
+        # dict.fromkeys: deterministic dedupe (set order varies — SIM003)
+        for iface in dict.fromkeys(host.interfaces.values()):
             if iface.pcap is not None:
                 iface.pcap.close()
         if engine.owns_host(host):

@@ -146,17 +146,17 @@ def _kill_stragglers(grace_sec: float = 2.0) -> None:
     kill, then ``wait`` (waitpid) each one so no zombies outlive a run —
     a bare SIGKILL without reaping used to leave defunct entries behind
     for the life of the test process."""
-    import time as _t
+    import time as _wt
     live = [p for p in _live_children if p.poll() is None]
     for p in live:
         try:
             p.terminate()
         except OSError:
             pass
-    deadline = _t.monotonic() + grace_sec
+    deadline = _wt.monotonic() + grace_sec
     for p in live:
         try:
-            p.wait(timeout=max(0.0, deadline - _t.monotonic()))
+            p.wait(timeout=max(0.0, deadline - _wt.monotonic()))
         except subprocess.TimeoutExpired:
             try:
                 p.kill()

@@ -408,7 +408,10 @@ class SyscallAPI:
         # like the reference's bind-to-any association — so both the
         # ephemeral-port scan and the in-use check must cover every
         # interface it will claim
-        targets = list(set(self.host.interfaces.values())) if wildcard else [iface]
+        # dict.fromkeys: dedupe in insertion order so the ephemeral-port
+        # scan and association order are run-to-run stable (SIM003)
+        targets = list(dict.fromkeys(self.host.interfaces.values())) \
+            if wildcard else [iface]
         port = addr[1]
         if port == 0:
             port = self.host.allocate_ephemeral_port(sock.kind, ip,

@@ -159,7 +159,7 @@ class Topology:
         self._edge_ns: Dict[Tuple[int, int], int] = {
             k: int(math.ceil(v[0] * stime.SIM_TIME_MS)) for k, v in best.items()}
         if best:
-            keys = list(best.keys())
+            keys = list(best)       # the dict itself: insertion-ordered
             rr = [k[0] for k in keys]
             cc = [k[1] for k in keys]
             ww = [self._edge_ns[k] + 1 for k in keys]

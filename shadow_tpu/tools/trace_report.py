@@ -79,13 +79,15 @@ def self_times(events: Iterable[dict]) -> Dict[str, Dict[str, float]]:
 def summarize(events: List[dict]) -> Dict:
     events = [e for e in events if e.get("ph") != "M"]
     spans = self_times(events)
+    # name tiebreak + pre-sorted input: the headline table stays
+    # byte-stable across runs even when two spans measure equal self-time
     top = sorted(
         ({"name": name, "count": int(v["count"]),
           "total_ms": round(v["total_us"] / 1e3, 3),
           "self_ms": round(max(v["self_us"], 0.0) / 1e3, 3),
           "mean_us": round(v["total_us"] / max(v["count"], 1), 1)}
-         for name, v in spans.items()),
-        key=lambda r: -r["self_ms"])
+         for name, v in sorted(spans.items())),
+        key=lambda r: (-r["self_ms"], r["name"]))
     rounds = spans.get("round", {}).get("count", 0)
     phases: Dict[str, Dict[str, float]] = {}
     for name in ROUND_PHASES:

@@ -1,0 +1,123 @@
+"""Sanitizer-hardened native plane (ISSUE 4 satellite, slow tier).
+
+Builds the C data plane as ``_shadow_dataplane_san.so`` with
+``-fsanitize=address,undefined -fno-omit-frame-pointer`` (native/Makefile
+``SANITIZE=``), then replays the ENTIRE native dataplane digest-parity
+suite (tests/test_native_dataplane.py) in a subprocess running under the
+instrumented extension — ``SHADOW_SANITIZE`` makes
+``native_plane._load_module`` pick the hardened twin, and ``LD_PRELOAD``
+supplies the ASan runtime a stock interpreter lacks.  Any sanitizer
+report (heap overflow, use-after-free, UB) fails the test; a toolchain
+without sanitizer runtimes skips LOUDLY rather than passing vacuously.
+
+Slow-marked: the instrumented suite costs minutes, so it rides the slow
+tier, not the tier-1 gate.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "native")
+SAN_SPEC = "address,undefined"
+SAN_SO = os.path.join(REPO, "shadow_tpu", "native",
+                      "_shadow_dataplane_san.so")
+
+
+def _sanitizer_toolchain_or_skip(tmp_path) -> str:
+    """Verify g++ can produce AND link sanitized objects here; return the
+    libasan runtime path for LD_PRELOAD.  Skips (loudly, with the reason)
+    when any piece is missing."""
+    gxx = os.environ.get("CXX") or "g++"
+    if shutil.which(gxx) is None:
+        pytest.skip(f"no C++ compiler ({gxx}) — cannot build the "
+                    "sanitized native plane")
+    smoke = tmp_path / "smoke.cc"
+    smoke.write_text("extern \"C\" int shd_smoke(int x) { return x + 1; }\n")
+    try:
+        probe = subprocess.run(
+            [gxx, f"-fsanitize={SAN_SPEC}", "-fno-omit-frame-pointer",
+             "-shared", "-fPIC", "-o", str(tmp_path / "smoke.so"),
+             str(smoke)],
+            capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        pytest.skip(f"sanitizer smoke compile failed to run: {e!r}")
+    if probe.returncode != 0:
+        pytest.skip("toolchain lacks sanitizer runtimes "
+                    f"(-fsanitize={SAN_SPEC} failed):\n{probe.stderr}")
+    libasan = subprocess.run(
+        [gxx, "-print-file-name=libasan.so"],
+        capture_output=True, text=True, timeout=60).stdout.strip()
+    if not os.path.isabs(libasan) or not os.path.exists(libasan):
+        pytest.skip("libasan runtime not found "
+                    f"(g++ -print-file-name gave {libasan!r})")
+    return libasan
+
+
+def _san_env(libasan: str) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "SHADOW_SANITIZE": SAN_SPEC,
+        "LD_PRELOAD": libasan,
+        # detect_leaks=0: CPython intentionally leaks interned/static
+        # allocations at exit — LSan would drown real reports.
+        # abort_on_error=1 turns any ASan report into a nonzero exit the
+        # assertion below catches even if the report text is garbled.
+        "ASAN_OPTIONS": "detect_leaks=0:abort_on_error=1",
+        # UBSan prints-and-continues by default; halt so a report fails.
+        "UBSAN_OPTIONS": "halt_on_error=1:print_stacktrace=1",
+        "JAX_PLATFORMS": "cpu",
+    })
+    return env
+
+
+def test_native_dataplane_suite_under_sanitizers(tmp_path):
+    libasan = _sanitizer_toolchain_or_skip(tmp_path)
+    # build the instrumented twin (separate artifact: never clobbers the
+    # production _shadow_dataplane.so)
+    build = subprocess.run(
+        ["make", f"SANITIZE={SAN_SPEC}",
+         os.path.join("..", "shadow_tpu", "native",
+                      "_shadow_dataplane_san.so")],
+        cwd=NATIVE_DIR, capture_output=True, text=True, timeout=300)
+    if build.returncode != 0:
+        pytest.skip("sanitized dataplane build failed (toolchain lacks "
+                    f"sanitizer support?):\n{build.stderr[-2000:]}")
+    assert os.path.exists(SAN_SO), "make succeeded but produced no .so"
+    env = _san_env(libasan)
+    # the hardened twin must actually LOAD — otherwise the suite below
+    # would skip its native cases and this test would pass vacuously
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "from shadow_tpu.parallel import native_plane as n; import sys; "
+         "sys.exit(0 if n.native_available() else 3)"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    if probe.returncode == 3:
+        pytest.skip("sanitized extension built but did not load "
+                    "(sanitizer runtime mismatch?) — stderr:\n"
+                    f"{probe.stderr[-2000:]}")
+    assert probe.returncode == 0, (
+        f"probe interpreter died under sanitizers (rc={probe.returncode}):"
+        f"\n{probe.stderr[-3000:]}")
+    # the full digest-parity suite, now instrumented end to end
+    run = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         os.path.join("tests", "test_native_dataplane.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=1800)
+    text = run.stdout + run.stderr
+    for marker in ("ERROR: AddressSanitizer", "ERROR: LeakSanitizer",
+                   "runtime error:", "AddressSanitizer:DEADLYSIGNAL"):
+        assert marker not in text, (
+            f"sanitizer report under the native dataplane suite "
+            f"({marker}):\n{text[-4000:]}")
+    assert run.returncode == 0, (
+        f"sanitized dataplane suite failed (rc={run.returncode}):\n"
+        f"{text[-4000:]}")

@@ -1,0 +1,590 @@
+"""simlint (shadow_tpu/analysis/): the determinism & device-safety
+static-analysis pass, ISSUE 4's tentpole.
+
+One positive + one negative fixture per rule (SIM001-SIM006), the
+suppression-pragma and allowlist semantics, the JSON output schema, the
+CLI round trip — and the GATE: simlint over all of shadow_tpu/ must
+report ZERO unsuppressed findings, so every wall-clock read, RNG draw,
+unordered iteration, donated-buffer reuse, blocking call and jit side
+effect in this codebase is either fixed or justified in-code forever.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from shadow_tpu.analysis.simlint import (Config, Finding, lint_paths,
+                                         lint_source, load_config)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(src: str, relpath: str = "shadow_tpu/fake/mod.py",
+          config: Config = None):
+    return lint_source(textwrap.dedent(src), relpath, config)
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings if not f.suppressed})
+
+
+# ---------------------------------------------------------------------------
+# SIM001 — wall clock
+
+
+def test_sim001_fires_on_wall_clock():
+    out = _lint("""
+        import time
+        def f():
+            return time.monotonic()
+    """)
+    assert _rules_of(out) == ["SIM001"]
+    assert "time.monotonic" in out[0].message
+
+
+def test_sim001_sees_through_renamed_import():
+    out = _lint("""
+        import time as _clock
+        def f():
+            return _clock.perf_counter()
+    """)
+    assert _rules_of(out) == ["SIM001"]
+
+
+def test_sim001_fires_on_from_import_and_datetime():
+    out = _lint("""
+        from time import monotonic
+        import datetime
+        def f():
+            return monotonic(), datetime.datetime.now()
+    """)
+    assert [f.rule for f in out] == ["SIM001", "SIM001"]
+
+
+def test_sim001_allows_walltime_alias_convention():
+    out = _lint("""
+        import time as _walltime
+        def heartbeat():
+            return _walltime.monotonic()
+        def span():
+            import time as _wt
+            return _wt.perf_counter_ns()
+    """)
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SIM002 — nondeterministic randomness
+
+
+def test_sim002_fires_on_global_rng_urandom_uuid():
+    out = _lint("""
+        import random
+        import os
+        import uuid
+        import numpy as np
+        def f():
+            a = random.randint(0, 7)
+            b = np.random.rand(3)
+            c = os.urandom(8)
+            d = uuid.uuid4()
+            return a, b, c, d
+    """)
+    assert [f.rule for f in out] == ["SIM002"] * 4
+
+
+def test_sim002_allows_seeded_generators_and_host_streams():
+    out = _lint("""
+        import numpy as np
+        def f(host, seed):
+            rng = np.random.default_rng(seed)
+            draw = host.random.next_u64()
+            return rng, draw
+    """)
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SIM003 — unordered iteration
+
+
+def test_sim003_fires_on_set_iteration_and_keys():
+    out = _lint("""
+        def f(items, d):
+            pending = set(items)
+            for x in pending:
+                use(x)
+            for k in d.keys():
+                use(k)
+            return [y for y in set(d) | pending]
+    """)
+    assert [f.rule for f in out] == ["SIM003"] * 3
+
+
+def test_sim003_quiet_on_sorted_and_dict_iteration():
+    out = _lint("""
+        def f(items, d):
+            for x in sorted(set(items)):
+                use(x)
+            for k in d:
+                use(k)
+            for v in dict.fromkeys(items):
+                use(v)
+    """)
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SIM004 — donated-buffer reuse
+
+
+def test_sim004_fires_on_read_after_donation():
+    out = _lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, x):
+            return state + x
+
+        def drive(state):
+            out = step(state, 1)
+            return out + state.sum()
+    """)
+    assert _rules_of(out) == ["SIM004"]
+    assert "donated" in out[0].message
+
+
+def test_sim004_starred_state_and_rebind_semantics():
+    out = _lint("""
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def step(a, b):
+            return a, b
+
+        def bad(state):
+            r = step(*state)
+            return state
+        def good(state):
+            state = step(*state)
+            return state
+    """)
+    flagged = [f for f in out if f.rule == "SIM004"]
+    assert len(flagged) == 1 and flagged[0].line == 11
+
+
+def test_sim004_loop_back_edge():
+    # the dispatch-loop idiom: `out = step(s)` re-reads donated `s` on
+    # every iteration after the first; `s = step(s)` rebinds and is safe
+    out = _lint("""
+        import jax
+        from functools import partial
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(s):
+            return s
+        def bad(s, n):
+            for _ in range(n):
+                out = step(s)
+            return out
+        def good(s, n):
+            for _ in range(n):
+                s = step(s)
+            return s
+    """)
+    flagged = [(f.line, f.rule) for f in out]
+    assert flagged == [(9, "SIM004")]
+
+
+def test_sim004_quiet_without_donation():
+    out = _lint("""
+        import jax
+
+        @jax.jit
+        def step(state, x):
+            return state + x
+
+        def drive(state):
+            out = step(state, 1)
+            return out + state.sum()
+    """)
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SIM005 — blocking wall-time operations
+
+
+def test_sim005_fires_on_sleep_and_unbounded_subprocess():
+    out = _lint("""
+        import time as _wt
+        import subprocess
+        def f(cmd):
+            _wt.sleep(1.0)
+            subprocess.run(cmd, check=True)
+    """)
+    assert [f.rule for f in out] == ["SIM005", "SIM005"]
+
+
+def test_sim005_quiet_when_bounded():
+    out = _lint("""
+        import subprocess
+        def f(cmd):
+            subprocess.run(cmd, check=True, timeout=30)
+    """)
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SIM006 — jit side effects
+
+
+def test_sim006_fires_on_print_and_closure_mutation():
+    out = _lint("""
+        import jax
+        trace_log = []
+
+        @jax.jit
+        def f(x):
+            print(x)
+            trace_log.append(x)
+            return x
+    """)
+    assert [f.rule for f in out] == ["SIM006", "SIM006"]
+
+
+def test_sim006_sees_partial_jit_wrapping_idiom():
+    # the ops/ idiom: impl defined bare, wrapped by partial(jax.jit, ...)()
+    out = _lint("""
+        import jax
+        from functools import partial
+        seen = []
+
+        def _impl(x):
+            seen.append(x)
+            return x
+
+        step = partial(jax.jit, static_argnames=("n",))(_impl)
+    """)
+    assert _rules_of(out) == ["SIM006"]
+
+
+def test_sim006_quiet_on_pure_kernel_and_unjitted_effects():
+    out = _lint("""
+        import jax
+        import jax.numpy as jnp
+        log = []
+
+        @jax.jit
+        def f(x, hist):
+            hist = hist.at[0].set(x)
+            acc = []
+            acc.append(x)
+            return jnp.sum(hist), acc
+
+        def host_side(x):
+            log.append(x)
+            return x
+    """)
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# suppression pragmas
+
+
+def test_suppression_requires_reason_and_records_it():
+    src = """
+        import time
+        def f():
+            return time.monotonic()  # simlint: disable=SIM001 -- CLI stopwatch, digest never sees it
+    """
+    out = _lint(src)
+    assert _rules_of(out) == []
+    supp = [f for f in out if f.suppressed]
+    assert len(supp) == 1 and supp[0].rule == "SIM001"
+    assert "stopwatch" in supp[0].reason
+
+
+def test_suppression_standalone_line_covers_next_line():
+    out = _lint("""
+        import time
+        def f():
+            # simlint: disable=SIM001 -- boot banner timestamp only
+            return time.monotonic()
+    """)
+    assert _rules_of(out) == []
+
+
+def test_reasonless_pragma_is_its_own_finding():
+    out = _lint("""
+        import time
+        def f():
+            return time.monotonic()  # simlint: disable=SIM001
+    """)
+    # the SIM001 stays live AND the bad pragma is flagged
+    assert _rules_of(out) == ["SIM000", "SIM001"]
+
+
+def test_pragma_text_inside_strings_is_inert():
+    # pragma syntax quoted in a docstring or string literal (docs, rule
+    # messages) must be neither a live suppression nor a SIM000
+    out = _lint('''
+        import time
+        MSG = "call()  # simlint: disable=SIM005"
+        def f():
+            """Example: x()  # simlint: disable=SIM001"""
+            return time.monotonic()
+    ''')
+    assert _rules_of(out) == ["SIM001"]
+    assert not [f for f in out if f.suppressed]
+
+
+def test_sim004_module_level_and_nested_scopes():
+    # module-level driver code is checked; a donation of an INNER
+    # function's variable must not kill the outer scope's same-named one
+    out = _lint("""
+        import jax
+        from functools import partial
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(s):
+            return s
+        out = step(state0)
+        top = state0.sum()
+        def outer(s):
+            def inner(s):
+                r = step(s)
+                return r + s
+            return s
+    """)
+    flagged = [(f.line, f.rule) for f in out]
+    # exactly two: the module-level read and the inner function's read —
+    # outer's `return s` is a different scope's `s`, not a finding
+    assert flagged == [(8, "SIM004"), (12, "SIM004")]
+
+
+def test_unreadable_file_is_a_finding_not_a_crash(tmp_path):
+    bad = tmp_path / "latin.py"
+    bad.write_bytes("x = '\xe9'\n".encode("latin-1"))
+    result = lint_paths([str(tmp_path)], Config(root=str(tmp_path)))
+    assert [f.rule for f in result.findings] == ["SIM000"]
+    assert "unreadable" in result.findings[0].message
+
+
+def test_unknown_rule_in_pragma_is_flagged():
+    out = _lint("""
+        x = 1  # simlint: disable=SIM999 -- no such rule
+    """)
+    assert _rules_of(out) == ["SIM000"]
+
+
+def test_pragma_only_suppresses_named_rule():
+    out = _lint("""
+        import time
+        import random
+        def f():
+            a = time.monotonic()  # simlint: disable=SIM001 -- telemetry
+            b = random.random()  # simlint: disable=SIM001 -- wrong rule id
+            return a, b
+    """)
+    # the SIM002 stays live; the wrong-rule pragma is flagged as stale
+    assert _rules_of(out) == ["SIM000", "SIM002"]
+    stale = [f for f in out if f.rule == "SIM000"]
+    assert "matched no finding" in stale[0].message
+
+
+def test_pragma_covers_wrapped_multiline_statement():
+    out = _lint("""
+        import subprocess
+        def f(cmd):
+            subprocess.run(
+                cmd)  # simlint: disable=SIM005 -- bounded by caller's alarm
+    """)
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM005"]
+
+
+def test_stale_pragma_is_flagged():
+    out = _lint("""
+        x = 1  # simlint: disable=SIM001 -- nothing here anymore
+    """)
+    assert _rules_of(out) == ["SIM000"]
+    assert "matched no finding" in out[0].message
+
+
+# every rule fires bare AND can be justified by a reasoned pragma on the
+# finding line — the pair the ISSUE requires per rule
+_RULE_SNIPPETS = {
+    "SIM001": """
+        import time
+        def f():
+            return time.monotonic(){PRAGMA}
+    """,
+    "SIM002": """
+        import os
+        def f():
+            return os.urandom(8){PRAGMA}
+    """,
+    "SIM003": """
+        def f(items):
+            for x in set(items):{PRAGMA}
+                use(x)
+    """,
+    "SIM004": """
+        import jax
+        from functools import partial
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(s):
+            return s
+        def drive(s):
+            out = step(s)
+            return out + s{PRAGMA}
+    """,
+    "SIM005": """
+        import subprocess
+        def f(cmd):
+            subprocess.run(cmd){PRAGMA}
+    """,
+    "SIM006": """
+        import jax
+        @jax.jit
+        def f(x):
+            print(x){PRAGMA}
+            return x
+    """,
+}
+
+
+@pytest.mark.parametrize("rule", sorted(_RULE_SNIPPETS))
+def test_every_rule_fires_and_is_suppressible(rule):
+    bare = _RULE_SNIPPETS[rule].replace("{PRAGMA}", "")
+    out = _lint(bare)
+    assert _rules_of(out) == [rule], f"{rule} did not fire bare"
+    justified = _RULE_SNIPPETS[rule].replace(
+        "{PRAGMA}", f"  # simlint: disable={rule} -- fixture justification")
+    out = _lint(justified)
+    assert _rules_of(out) == [], f"{rule} pragma did not suppress"
+    supp = [f for f in out if f.suppressed]
+    assert [f.rule for f in supp] == [rule]
+    assert supp[0].reason == "fixture justification"
+
+
+# ---------------------------------------------------------------------------
+# allowlist + config parsing
+
+
+def test_allowlist_exempts_matching_modules_per_rule():
+    cfg = Config(allow={"SIM001": ["shadow_tpu/obs/*"]})
+    src = """
+        import time
+        def f():
+            return time.monotonic()
+    """
+    assert _lint(src, "shadow_tpu/obs/trace.py", cfg) == []
+    assert _rules_of(_lint(src, "shadow_tpu/core/engine.py", cfg)) \
+        == ["SIM001"]
+    # the allowlist is per-rule: SIM002 still fires in an allowed module
+    out = _lint("import os\nx = os.urandom(4)\n",
+                "shadow_tpu/obs/trace.py", cfg)
+    assert _rules_of(out) == ["SIM002"]
+
+
+def test_load_config_reads_repo_pyproject():
+    cfg = load_config(os.path.join(REPO, "pyproject.toml"))
+    assert "shadow_tpu/obs/*" in cfg.allow.get("SIM001", [])
+    assert cfg.is_allowed("SIM001", "shadow_tpu/obs/metrics.py")
+    assert not cfg.is_allowed("SIM001", "shadow_tpu/core/engine.py")
+
+
+def test_unparsable_file_reports_sim000():
+    out = _lint("def f(:\n")
+    assert [f.rule for f in out] == ["SIM000"]
+    assert "parse" in out[0].message
+
+
+# ---------------------------------------------------------------------------
+# JSON schema + CLI round trip
+
+
+def test_json_schema_and_cli_roundtrip(tmp_path):
+    mod = tmp_path / "snippet.py"
+    mod.write_text(textwrap.dedent("""
+        import time
+        import random
+        def f():
+            ok = time.monotonic()  # simlint: disable=SIM001 -- bench timer
+            return ok, random.random()
+    """))
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simlint",
+         str(mod), "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert run.returncode == 1, run.stderr
+    doc = json.loads(run.stdout)
+    assert doc["version"] == 1 and doc["tool"] == "simlint"
+    assert doc["files"] == 1
+    assert doc["summary"]["findings"] == 1
+    assert doc["summary"]["suppressed"] == 1
+    assert doc["summary"]["by_rule"] == {"SIM002": 1}
+    (f,) = doc["findings"]
+    assert set(f) == {"rule", "severity", "path", "line", "col", "message"}
+    assert f["rule"] == "SIM002" and f["severity"] == "error"
+    (s,) = doc["suppressed"]
+    assert s["suppressed"] is True and s["reason"] == "bench timer"
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    ok = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simlint", str(clean)],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert ok.returncode == 0
+    missing = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simlint",
+         str(tmp_path / "nope.py")],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert missing.returncode == 2
+
+
+# ---------------------------------------------------------------------------
+# THE GATE: zero unsuppressed findings over the whole package
+
+
+def test_gate_zero_findings_over_shadow_tpu():
+    """Every invariant violation in shadow_tpu/ is fixed or justified.
+
+    This is the tier-1 gate that makes simlint self-enforcing: a future
+    PR introducing time.time() on a sim path, an unseeded RNG draw, a
+    hash-ordered iteration, a donated-buffer reuse or a jit side effect
+    fails HERE with the exact file:line, and the only ways out are to
+    fix it or to justify it with a reasoned pragma in the diff itself."""
+    result = lint_paths([os.path.join(REPO, "shadow_tpu")],
+                        load_config(os.path.join(REPO, "pyproject.toml")))
+    assert result.files > 50, "package discovery looks broken"
+    pretty = "\n".join(f.render() for f in result.unsuppressed)
+    assert not result.unsuppressed, (
+        f"simlint found unsuppressed violations:\n{pretty}\n"
+        "fix them, or justify with "
+        "`# simlint: disable=<RULE> -- <why>`")
+    # every suppression in the tree carries its reason (SIM000 would have
+    # fired above otherwise); sanity-check they are present and reasoned
+    for f in result.suppressed:
+        assert f.reason, f"reasonless suppression survived: {f.render()}"
+
+
+def test_gate_cli_matches_api(tmp_path):
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simlint",
+         "shadow_tpu", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr
+    doc = json.loads(run.stdout)
+    assert doc["findings"] == []
