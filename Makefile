@@ -1,0 +1,30 @@
+# Developer/CI entry points for shadow-tpu.  Native artifacts have their
+# own Makefile (native/); this one wires the static-analysis lanes.
+
+PY ?= python
+# `make lint-diff BASE=origin/main` lints only files changed since BASE
+# (simlint) / reports only changed-file findings (simrace — its rules
+# are cross-module, so the ANALYSIS stays package-wide either way).
+BASE ?= HEAD
+
+.PHONY: lint lint-diff test native sanitize sanitize-thread
+
+lint:
+	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu
+	$(PY) -m shadow_tpu.analysis.simrace shadow_tpu
+
+lint-diff:
+	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu --diff $(BASE)
+	$(PY) -m shadow_tpu.analysis.simrace shadow_tpu --diff $(BASE)
+
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+native:
+	$(MAKE) -C native
+
+sanitize:
+	$(MAKE) -C native sanitize
+
+sanitize-thread:
+	$(MAKE) -C native sanitize-thread

@@ -587,15 +587,19 @@ def main() -> None:
     # run, reported alongside as tor200_tpu for continuity)
     tor200 = sims["tor200_serial"]["sim_sec_per_wall_sec"]
     c_rate = chot.get("c_hotloop_events_per_sec")
-    # static-analysis health (ISSUE 4): the same simlint pass the tier-1
-    # gate enforces, timed — findings must stay 0 and the pass must stay
-    # cheap enough to run on every PR
+    # static-analysis health (ISSUE 4 + 5): the same simlint/simrace
+    # passes the tier-1 gates enforce, timed — findings must stay 0 and
+    # both passes must stay cheap enough to run on every PR
     from shadow_tpu.analysis.simlint import lint_paths, load_config
+    from shadow_tpu.analysis.simrace import race_paths
     _repo = os.path.dirname(os.path.abspath(__file__))
+    _cfg = load_config(os.path.join(_repo, "pyproject.toml"))
     _lint_t0 = time.perf_counter()
-    _lint = lint_paths([os.path.join(_repo, "shadow_tpu")],
-                       load_config(os.path.join(_repo, "pyproject.toml")))
+    _lint = lint_paths([os.path.join(_repo, "shadow_tpu")], _cfg)
     simlint_sec = round(time.perf_counter() - _lint_t0, 3)
+    _race_t0 = time.perf_counter()
+    _race = race_paths([os.path.join(_repo, "shadow_tpu")], _cfg)
+    simrace_sec = round(time.perf_counter() - _race_t0, 3)
     out = {
         "metric": "tor200_sim_sec_per_wall_sec",
         "value": tor200,
@@ -623,6 +627,9 @@ def main() -> None:
         "simlint_findings": len(_lint.unsuppressed),
         "simlint_suppressed": len(_lint.suppressed),
         "simlint_sec": simlint_sec,
+        "simrace_findings": len(_race.unsuppressed),
+        "simrace_suppressed": len(_race.suppressed),
+        "simrace_sec": simrace_sec,
         "kernel_transfer_inclusive_mpkts": round(dev_rate / 1e6, 3),
         "kernel_device_compute_mpkts": round(dev_compute / 1e6, 2),
         "own_scalar_python_mpkts": round(cpu_rate / 1e6, 4),
@@ -700,9 +707,11 @@ def main() -> None:
         # workload — must be ~0 (ISSUE 3)
         "obs_overhead_sec":
             sims.get("tor200_serial", {}).get("obs_overhead_sec"),
-        # static-analysis gate (ISSUE 4): must be 0 findings, a few sec
+        # static-analysis gates (ISSUE 4 + 5): must be 0 findings each
         "simlint_findings": out["simlint_findings"],
         "simlint_sec": simlint_sec,
+        "simrace_findings": out["simrace_findings"],
+        "simrace_sec": simrace_sec,
         "gates_enforced": True,
     }
     blob = json.dumps(summary)

@@ -11,8 +11,18 @@ read of a donated JAX buffer silently breaks reproducibility.
 
 ``simlint`` (python -m shadow_tpu.analysis.simlint) proves the invariants
 statically, codebase-wide, on every PR — see simlint.py for the engine and
-rules.py for the rule catalog (SIM001-SIM006).  Import
-``shadow_tpu.analysis.simlint`` directly for the API (lint_paths,
-lint_source); the package module stays import-free so ``python -m``
-execution of the submodule is clean.
+rules.py for the rule catalog (SIM001-SIM006).
+
+``simrace`` (python -m shadow_tpu.analysis.simrace) reuses the same
+engine, severity model, pragma and allowlist machinery for the
+CONCURRENCY contracts, analyzing the package as a whole: lock identities
+and lock-order edges, thread-shared state, blocking calls under locks
+(race_rules.py, SIM101-SIM103) and the parent<->shard tag protocol
+model-checked as a pair of communicating state machines (protocol.py,
+SIM110).
+
+Import ``shadow_tpu.analysis.simlint`` / ``.simrace`` directly for the
+APIs (lint_paths, lint_source, race_paths, race_sources); the package
+module stays import-free so ``python -m`` execution of the submodules is
+clean.
 """

@@ -64,10 +64,11 @@ import io
 import json
 import os
 import re
+import subprocess
 import sys
 import tokenize
 from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 SCHEMA_VERSION = 1
 
@@ -226,9 +227,16 @@ _KNOWN_RULES_CACHE: Optional[set] = None
 
 
 def known_rule_ids() -> set:
+    """Every rule id any tool in this package owns: simlint's SIM00x
+    catalog plus simrace's SIM1xx concurrency catalog.  Pragmas may name
+    any of them; each TOOL only judges staleness for the rules it RUNS
+    (a ``disable=SIM103`` pragma is invisible to simlint, not stale)."""
     global _KNOWN_RULES_CACHE
     if _KNOWN_RULES_CACHE is None:
-        _KNOWN_RULES_CACHE = {r.id for r in default_rules()} | {"SIM000"}
+        ids = {r.id for r in default_rules()} | {"SIM000"}
+        from . import race_rules
+        ids |= {r.id for r in race_rules.CATALOG}
+        _KNOWN_RULES_CACHE = ids
     return _KNOWN_RULES_CACHE
 
 
@@ -401,24 +409,18 @@ def default_rules() -> List[Rule]:
     return list(rules.CATALOG)
 
 
-def lint_source(source: str, relpath: str = "<snippet>",
-                config: Optional[Config] = None,
-                rules: Optional[List[Rule]] = None) -> List[Finding]:
-    """Lint one module's source text (the test-fixture entry point)."""
-    config = config or Config()
-    rules = rules if rules is not None else default_rules()
-    try:
-        ctx = ModuleContext(relpath, source)
-    except SyntaxError as e:
-        return [Finding("SIM000", "error", relpath, e.lineno or 1,
-                        (e.offset or 1) - 1,
-                        f"file does not parse: {e.msg}")]
-    findings: List[Finding] = []
-    for rule in rules:
-        if config.is_allowed(rule.id, relpath):
-            continue
-        findings.extend(rule.run(ctx))
-    pragmas, bad = collect_pragmas(relpath, source, ctx.lines)
+def apply_pragmas(ctx: ModuleContext, findings: List[Finding],
+                  active_ids: Set[str]) -> List[Finding]:
+    """Match suppression pragmas against ``findings`` and return the
+    combined (suppressed + SIM000) list for one module.
+
+    ``active_ids`` scopes ownership: only pragmas naming a rule in it can
+    suppress here, and only THOSE pragmas can be stale — a pragma for a
+    rule another tool runs (simrace's SIM1xx from simlint's point of view,
+    and vice versa) is simply not this tool's business.  Malformed pragmas
+    (reasonless, unknown rule id) are every tool's business."""
+    pragmas, bad = collect_pragmas(ctx.relpath, ctx.source, ctx.lines)
+    pragmas = [p for p in pragmas if p.rule in active_ids]
     # a pragma covers the whole statement its target line belongs to, so
     # wrapped calls can carry the pragma on any of their physical lines
     index: Dict[Tuple[int, str], Pragma] = {}
@@ -437,11 +439,31 @@ def lint_source(source: str, relpath: str = "<snippet>",
     for p in pragmas:
         if not p.used:
             bad.append(Finding(
-                "SIM000", "error", relpath, p.line, p.col,
+                "SIM000", "error", ctx.relpath, p.line, p.col,
                 f"suppression pragma for {p.rule} matched no finding — "
                 "remove the stale pragma (or fix its rule id)"))
-    findings.extend(bad)                 # SIM000 is never suppressible
+    findings = findings + bad            # SIM000 is never suppressible
     return sorted(findings, key=Finding.sort_key)
+
+
+def lint_source(source: str, relpath: str = "<snippet>",
+                config: Optional[Config] = None,
+                rules: Optional[List[Rule]] = None) -> List[Finding]:
+    """Lint one module's source text (the test-fixture entry point)."""
+    config = config or Config()
+    rules = rules if rules is not None else default_rules()
+    try:
+        ctx = ModuleContext(relpath, source)
+    except SyntaxError as e:
+        return [Finding("SIM000", "error", relpath, e.lineno or 1,
+                        (e.offset or 1) - 1,
+                        f"file does not parse: {e.msg}")]
+    findings: List[Finding] = []
+    for rule in rules:
+        if config.is_allowed(rule.id, relpath):
+            continue
+        findings.extend(rule.run(ctx))
+    return apply_pragmas(ctx, findings, {r.id for r in rules} | {"SIM000"})
 
 
 def iter_py_files(paths: List[str], config: Config) -> List[Tuple[str, str]]:
@@ -467,10 +489,52 @@ def iter_py_files(paths: List[str], config: Config) -> List[Tuple[str, str]]:
     return sorted(set(out))
 
 
+def changed_py_files(base: str, root: str) -> Set[str]:
+    """Relpaths (from ``root``, posix) of .py files changed since git ref
+    ``base``, plus untracked ones — the ``--diff BASE`` incremental-lint
+    set.  Raises RuntimeError when git can't answer (bad ref, not a
+    repo), so the CLI can exit 2 instead of silently linting nothing.
+
+    Path bases differ between the two git commands: ``git diff
+    --name-only`` prints toplevel-relative paths while ``git ls-files``
+    (run with cwd=root) prints cwd-relative ones — so the diff output is
+    re-based onto ``root`` via ``--show-prefix`` (when root is nested in
+    the repo, a toplevel path outside root can never match the lint set
+    and is dropped)."""
+
+    def _git(args: List[str]) -> str:
+        try:
+            run = subprocess.run(["git"] + args, cwd=root,
+                                 capture_output=True, text=True,
+                                 timeout=60)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            raise RuntimeError(f"git failed: {e!r}")
+        if run.returncode != 0:
+            raise RuntimeError(
+                f"`git {' '.join(args)}` failed: {run.stderr.strip()}")
+        return run.stdout
+
+    prefix = _git(["rev-parse", "--show-prefix"]).strip()
+    out: Set[str] = set()
+    for p in _git(["diff", "--name-only", "-z", base, "--"]).split("\0"):
+        if not p.endswith(".py"):
+            continue
+        if prefix:
+            if not p.startswith(prefix):
+                continue             # changed outside the lint root
+            p = p[len(prefix):]
+        out.add(p)
+    out.update(p for p in _git(["ls-files", "--others",
+                                "--exclude-standard", "-z"]).split("\0")
+               if p.endswith(".py"))
+    return out
+
+
 @dataclass
 class LintResult:
     findings: List[Finding]
     files: int
+    tool: str = "simlint"
 
     @property
     def unsuppressed(self) -> List[Finding]:
@@ -486,7 +550,7 @@ class LintResult:
             by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
         return {
             "version": SCHEMA_VERSION,
-            "tool": "simlint",
+            "tool": self.tool,
             "files": self.files,
             "findings": [f.to_json() for f in self.unsuppressed],
             "suppressed": [f.to_json() for f in self.suppressed],
@@ -499,11 +563,16 @@ class LintResult:
 
 
 def lint_paths(paths: List[str], config: Optional[Config] = None,
-               rules: Optional[List[Rule]] = None) -> LintResult:
+               rules: Optional[List[Rule]] = None,
+               only: Optional[Set[str]] = None) -> LintResult:
+    """``only`` (when not None) restricts linting to those relpaths — the
+    ``--diff BASE`` incremental mode; an empty set lints nothing."""
     config = config or load_config(None, start=paths[0] if paths else ".")
     rules = rules if rules is not None else default_rules()
     findings: List[Finding] = []
     files = iter_py_files(paths, config)
+    if only is not None:
+        files = [(a, r) for a, r in files if r in only]
     for abspath, rel in files:
         try:
             with open(abspath, encoding="utf-8") as f:
@@ -533,6 +602,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "(default: nearest to the first path)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--diff", metavar="BASE", default=None,
+                    help="lint only .py files changed since git ref BASE "
+                         "(plus untracked files)")
     args = ap.parse_args(argv)
     rules = default_rules()
     if args.list_rules:
@@ -546,7 +618,14 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
     config = load_config(args.config, start=paths[0])
-    result = lint_paths(paths, config, rules)
+    only = None
+    if args.diff is not None:
+        try:
+            only = changed_py_files(args.diff, config.root)
+        except RuntimeError as e:
+            print(f"simlint: --diff {args.diff}: {e}", file=sys.stderr)
+            return 2
+    result = lint_paths(paths, config, rules, only=only)
     if args.json:
         json.dump(result.to_json(), sys.stdout, indent=2, sort_keys=True)
         print()

@@ -637,7 +637,7 @@ class Engine:
                         worker.round_end = self.scheduler.window_end
                         worker.run_round()
                     except BaseException as e:  # surface, don't deadlock the latch
-                        errors.append(e)
+                        errors.append(e)  # simlint: disable=SIM102 -- done_latch's condvar orders this append before the parent's post-barrier read
                     done_latch.count_down_await()
             finally:
                 worker.finish()

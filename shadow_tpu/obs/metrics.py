@@ -18,43 +18,63 @@ telemetry becomes *sources* instead of keeping its own format:
 ``enabled`` gates only the per-event recording paths (heartbeat capture,
 profiler observes); registration and :meth:`scrape` always work, so tools
 can read phase timings from a run that never wrote a metrics file.
+
+Thread safety (simrace's first customer, ISSUE 5): the registry is
+scraped from the engine loop but its instruments are incremented from
+watchdog helper threads (the dispatch-collect guard), worker threads
+(spans/heartbeats on threaded schedulers) and supervision recovery
+paths.  ONE registry RLock covers instrument mutation, instrument
+creation, heartbeat capture and the scrape snapshot, so a scrape never
+reads a histogram mid-update and concurrent ``inc()`` never loses
+counts (tests/test_concurrency_stress.py hammers exactly this).
+Reentrant because a gauge/source callable read under the scrape lock
+may itself touch the registry.
 """
 
 from __future__ import annotations
 
 import json
+import threading
 import time as _walltime
 from typing import Callable, Dict, List, Optional
 
 
 class Counter:
-    """Monotonic count."""
+    """Monotonic count (thread-safe under the registry lock)."""
 
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "value", "_lock")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, lock: Optional[threading.RLock] = None):
         self.name = name
         self.value = 0
+        self._lock = lock if lock is not None else threading.RLock()
 
     def inc(self, n: int = 1) -> None:
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 class Gauge:
     """Point-in-time value: either ``set()`` or a callable read at scrape."""
 
-    __slots__ = ("name", "value", "fn")
+    __slots__ = ("name", "value", "fn", "_lock")
 
-    def __init__(self, name: str, fn: Optional[Callable] = None):
+    def __init__(self, name: str, fn: Optional[Callable] = None,
+                 lock: Optional[threading.RLock] = None):
         self.name = name
         self.value = 0
         self.fn = fn
+        self._lock = lock if lock is not None else threading.RLock()
 
     def set(self, v) -> None:
-        self.value = v
+        with self._lock:
+            self.value = v
 
     def read(self):
-        return self.fn() if self.fn is not None else self.value
+        if self.fn is not None:
+            return self.fn()
+        with self._lock:
+            return self.value
 
 
 class Histogram:
@@ -64,39 +84,47 @@ class Histogram:
     pick units that put interesting values above 1, e.g. microseconds).
     Enough to read latency tails without per-observation storage."""
 
-    __slots__ = ("name", "count", "total", "min", "max", "buckets")
+    __slots__ = ("name", "count", "total", "min", "max", "buckets",
+                 "_lock")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, lock: Optional[threading.RLock] = None):
         self.name = name
         self.count = 0
         self.total = 0.0
         self.min = None
         self.max = None
         self.buckets: Dict[int, int] = {}
+        self._lock = lock if lock is not None else threading.RLock()
 
     def observe(self, v: float) -> None:
-        self.count += 1
-        self.total += v
-        if self.min is None or v < self.min:
-            self.min = v
-        if self.max is None or v > self.max:
-            self.max = v
-        k = -1 if v < 1 else int(v).bit_length() - 1
-        self.buckets[k] = self.buckets.get(k, 0) + 1
+        with self._lock:
+            self.count += 1
+            self.total += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            k = -1 if v < 1 else int(v).bit_length() - 1
+            self.buckets[k] = self.buckets.get(k, 0) + 1
 
     def snapshot(self) -> Dict[str, float]:
-        if not self.count:
-            return {"count": 0}
-        return {"count": self.count, "sum": self.total,
-                "min": self.min, "max": self.max,
-                "mean": self.total / self.count,
-                "buckets": {str(k): v
-                            for k, v in sorted(self.buckets.items())}}
+        with self._lock:
+            if not self.count:
+                return {"count": 0}
+            return {"count": self.count, "sum": self.total,
+                    "min": self.min, "max": self.max,
+                    "mean": self.total / self.count,
+                    "buckets": {str(k): v
+                                for k, v in sorted(self.buckets.items())}}
 
 
 class MetricsRegistry:
     def __init__(self, enabled: bool = False):
         self.enabled = enabled
+        # ONE reentrant lock shared by every instrument (see the module
+        # docstring): scrape holds it across the whole instrument
+        # snapshot, so a single scrape record is internally consistent
+        self._lock = threading.RLock()
         self._counters: Dict[str, Counter] = {}
         self._gauges: Dict[str, Gauge] = {}
         self._histograms: Dict[str, Histogram] = {}
@@ -107,30 +135,34 @@ class MetricsRegistry:
 
     # -- instrument construction (idempotent by name) ----------------------
     def counter(self, name: str) -> Counter:
-        c = self._counters.get(name)
-        if c is None:
-            c = self._counters[name] = Counter(name)
-        return c
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = self._counters[name] = Counter(name, self._lock)
+            return c
 
     def gauge(self, name: str, fn: Optional[Callable] = None) -> Gauge:
-        g = self._gauges.get(name)
-        if g is None:
-            g = self._gauges[name] = Gauge(name, fn)
-        elif fn is not None:
-            g.fn = fn
-        return g
+        with self._lock:
+            g = self._gauges.get(name)
+            if g is None:
+                g = self._gauges[name] = Gauge(name, fn, self._lock)
+            elif fn is not None:
+                g.fn = fn
+            return g
 
     def histogram(self, name: str) -> Histogram:
-        h = self._histograms.get(name)
-        if h is None:
-            h = self._histograms[name] = Histogram(name)
-        return h
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self._histograms[name] = Histogram(name, self._lock)
+            return h
 
     def source(self, name: str, fn: Callable[[], Dict]) -> None:
         """Register a scrape-time provider returning {metric: value};
         later registrations under one name replace earlier ones (a re-run
         engine re-registers cleanly)."""
-        self._sources[name] = fn
+        with self._lock:
+            self._sources[name] = fn
 
     # -- heartbeat promotion (the legacy log lines' values, shared) --------
     def record_host_heartbeat(self, host_name: str, vals: Dict) -> None:
@@ -139,17 +171,20 @@ class MetricsRegistry:
         scrapes a handful of totals, not 10k series."""
         if not self.enabled:
             return
-        self._host_hb[host_name] = vals
+        with self._lock:
+            self._host_hb[host_name] = vals
 
     def record_engine_heartbeat(self, vals: Dict) -> None:
         if not self.enabled:
             return
-        self._engine_hb = vals
+        with self._lock:
+            self._engine_hb = vals
 
     def set_summary_info(self, key: str, value) -> None:
         """Attach a summary-only payload (e.g. the ObjectCounter leak
         report) emitted with the final summary record."""
-        self._summary_info[key] = value
+        with self._lock:
+            self._summary_info[key] = value
 
     # -- scraping ----------------------------------------------------------
     def scrape(self) -> Dict:
@@ -157,40 +192,48 @@ class MetricsRegistry:
         dicts).  Works whether or not the registry is enabled."""
         # sorted everywhere: instrument registration order differs between
         # engine configurations, and the scrape reaches user-visible JSONL
-        # — explicit ordering keeps reports byte-stable across runs
+        # — explicit ordering keeps reports byte-stable across runs.
+        # The registry lock is held across the whole snapshot (reentrant:
+        # gauge fns / sources read back through it), so one scrape record
+        # is internally consistent even under concurrent increments.
         out: Dict = {}
-        for name, c in sorted(self._counters.items()):
-            out[name] = c.value
-        for name, g in sorted(self._gauges.items()):
-            try:
-                out[name] = g.read()
-            except Exception as e:  # a broken gauge fn must not kill a run
-                out[name] = f"gauge_error: {e!r}"
-        for name, h in sorted(self._histograms.items()):
-            out[name] = h.snapshot()
-        for sname, fn in sorted(self._sources.items()):
-            try:
-                vals = fn() or {}
-            except Exception as e:  # a broken source must not kill the run
-                vals = {f"{sname}.scrape_error": repr(e)}
-            out.update(vals)
-        if self._host_hb:
-            agg: Dict[str, int] = {}
-            for vals in self._host_hb.values():
-                for k, v in vals.items():
-                    if isinstance(v, (int, float)):
-                        agg[k] = agg.get(k, 0) + v
-            out.update({f"tracker.{k}": v for k, v in sorted(agg.items())})
-            out["tracker.hosts_reporting"] = len(self._host_hb)
-        if self._engine_hb:
-            out.update({f"engine_heartbeat.{k}": v
-                        for k, v in sorted(self._engine_hb.items())})
+        with self._lock:
+            for name, c in sorted(self._counters.items()):
+                out[name] = c.value
+            for name, g in sorted(self._gauges.items()):
+                try:
+                    out[name] = g.read()
+                except Exception as e:  # a broken gauge must not kill a run
+                    out[name] = f"gauge_error: {e!r}"
+            for name, h in sorted(self._histograms.items()):
+                out[name] = h.snapshot()
+            for sname, fn in sorted(self._sources.items()):
+                try:
+                    vals = fn() or {}
+                except Exception as e:  # broken source must not kill a run
+                    vals = {f"{sname}.scrape_error": repr(e)}
+                out.update(vals)
+            if self._host_hb:
+                agg: Dict[str, int] = {}
+                for vals in self._host_hb.values():
+                    for k, v in vals.items():
+                        if isinstance(v, (int, float)):
+                            agg[k] = agg.get(k, 0) + v
+                out.update({f"tracker.{k}": v
+                            for k, v in sorted(agg.items())})
+                out["tracker.hosts_reporting"] = len(self._host_hb)
+            if self._engine_hb:
+                out.update({f"engine_heartbeat.{k}": v
+                            for k, v in sorted(self._engine_hb.items())})
         return out
 
     def summary(self) -> Dict:
         """The final-summary payload: a scrape + the summary-only info
-        (leak report, supervision ledger, plane stats...)."""
-        return {"metrics": self.scrape(), **self._summary_info}
+        (leak report, supervision ledger, plane stats...).  One lock
+        hold across both (reentrant into scrape) so the record cannot
+        pair fresh info with a scrape from a different instant."""
+        with self._lock:
+            return {"metrics": self.scrape(), **dict(self._summary_info)}
 
 
 class MetricsWriter:

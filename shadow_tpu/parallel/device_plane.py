@@ -878,13 +878,23 @@ class DeviceTrafficPlane:
         import threading
         import time as _wt
         t_g = _wt.perf_counter_ns()
+        # the result box is written by the helper thread and read by the
+        # dispatcher: one lock covers both sides (simrace SIM102 — a
+        # timed-out join() returning does NOT order the abandoned
+        # helper's late write against the dispatcher's read, so the
+        # dict-sharing idiom was a real, if narrow, race window)
         box: Dict[str, object] = {}
+        box_lock = threading.Lock()
 
         def _work() -> None:
             try:
-                box["out"] = np.asarray(handle)
+                out = np.asarray(handle)
             except BaseException as e:  # noqa: BLE001 - forwarded below
-                box["err"] = e
+                with box_lock:
+                    box["err"] = e
+            else:
+                with box_lock:
+                    box["out"] = out
 
         th = threading.Thread(target=_work, daemon=True,
                               name="device-dispatch-collect")
@@ -898,10 +908,11 @@ class DeviceTrafficPlane:
                 f"device dispatch did not complete within "
                 f"{self._watchdog_sec:.0f}s (--device-watchdog-sec)")
         t_g = _wt.perf_counter_ns()
-        err = box.get("err")
+        with box_lock:
+            err = box.get("err")
+            out = box.get("out")
         if err is not None:
             raise err
-        out = box["out"]
         engine.supervision.overhead_ns += _wt.perf_counter_ns() - t_g
         return out
 

@@ -60,15 +60,24 @@ def _load_module():
     to a sanitizer-instrumented twin, ``_shadow_dataplane_san.so``, built
     via ``make SANITIZE=...`` with ``-fno-omit-frame-pointer`` — a
     separate artifact so the hardened test run (tests/test_native_sanitize
-    .py) never clobbers the production extension.  Loading an ASan build
-    into a stock interpreter additionally needs the runtime preloaded
-    (LD_PRELOAD=libasan.so); the sanitize test arranges that."""
+    .py) never clobbers the production extension.  ``SHADOW_SANITIZE=
+    thread`` selects the ThreadSanitizer twin ``_shadow_dataplane_tsan
+    .so`` instead (its own artifact: TSan cannot link with ASan, and the
+    matrix run builds both).  Loading a sanitized build into a stock
+    interpreter additionally needs the runtime preloaded
+    (LD_PRELOAD=libasan.so / libtsan.so); the sanitize tests arrange
+    that."""
     global _MOD, _MOD_TRIED
     if _MOD_TRIED:
         return _MOD
     _MOD_TRIED = True
     san = os.environ.get("SHADOW_SANITIZE", "").strip()
-    artifact = "_shadow_dataplane_san.so" if san else "_shadow_dataplane.so"
+    if san == "thread":
+        artifact = "_shadow_dataplane_tsan.so"
+    elif san:
+        artifact = "_shadow_dataplane_san.so"
+    else:
+        artifact = "_shadow_dataplane.so"
     make_args = [f"SANITIZE={san}"] if san else []
     here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     path = os.path.join(here, "native", artifact)

@@ -1,0 +1,151 @@
+"""ThreadSanitizer-hardened native plane (ISSUE 5 tentpole leg, slow
+tier) — completes the ASan/UBSan/TSan matrix started in PR 4.
+
+Builds the C data plane as ``_shadow_dataplane_tsan.so`` with
+``-fsanitize=thread`` (native/Makefile ``sanitize-thread``), then replays
+the native dataplane digest-parity suite (tests/test_native_dataplane.py)
+in a subprocess running under the instrumented extension —
+``SHADOW_SANITIZE=thread`` makes ``native_plane._load_module`` pick the
+TSan twin, and ``LD_PRELOAD`` supplies the TSan runtime a stock
+interpreter lacks.
+
+TSan instruments EVERYTHING in the process, including CPython itself,
+and a stock CPython is known to trip benign-but-reported races in its
+allocator/GIL internals on some builds — so unlike the ASan gate, this
+test runs with ``halt_on_error=0`` and fails only on ThreadSanitizer
+reports whose stacks reach the data plane (``dataplane`` frames): those
+are OUR races.  Interpreter-internal reports are counted and logged but
+tolerated.  A toolchain without the TSan runtime skips LOUDLY.
+
+Fork discipline (learned the hard way in this container): a ``fork()``
+from a process whose OTHER threads hold TSan-internal locks deadlocks
+the child pre-exec, hanging the parent on the exec errpipe.  Two forks
+exist on this suite's path: numpy.testing's import-time SVE subprocess
+probe (forking after OpenBLAS spun its pool) and the multi-process
+sharding case (mp ``spawn`` after jax's XLA threads exist).  So the
+replay runs with ``OPENBLAS_NUM_THREADS=1`` / ``OMP_NUM_THREADS=1``
+(no BLAS pool → the import-time fork is single-threaded and safe) and
+excludes the ``shards`` case (its C plane is identical to the serial
+cases that DO run instrumented; the fork is in the uninstrumented-
+python parent, not the plane).
+
+Slow-marked: TSan costs a 5-15x slowdown on top of the suite.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_DIR = os.path.join(REPO, "native")
+TSAN_SO = os.path.join(REPO, "shadow_tpu", "native",
+                       "_shadow_dataplane_tsan.so")
+
+
+def _tsan_toolchain_or_skip(tmp_path) -> str:
+    """Verify g++ can produce AND link TSan objects here; return the
+    libtsan runtime path for LD_PRELOAD.  Skips loudly otherwise."""
+    gxx = os.environ.get("CXX") or "g++"
+    if shutil.which(gxx) is None:
+        pytest.skip(f"no C++ compiler ({gxx}) — cannot build the TSan "
+                    "native plane")
+    smoke = tmp_path / "smoke.cc"
+    smoke.write_text("extern \"C\" int shd_smoke(int x) { return x + 1; }\n")
+    try:
+        probe = subprocess.run(
+            [gxx, "-fsanitize=thread", "-fno-omit-frame-pointer",
+             "-shared", "-fPIC", "-o", str(tmp_path / "smoke.so"),
+             str(smoke)],
+            capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        pytest.skip(f"TSan smoke compile failed to run: {e!r}")
+    if probe.returncode != 0:
+        pytest.skip("toolchain lacks the ThreadSanitizer runtime "
+                    f"(-fsanitize=thread failed):\n{probe.stderr}")
+    libtsan = subprocess.run(
+        [gxx, "-print-file-name=libtsan.so"],
+        capture_output=True, text=True, timeout=60).stdout.strip()
+    if not os.path.isabs(libtsan) or not os.path.exists(libtsan):
+        pytest.skip("libtsan runtime not found "
+                    f"(g++ -print-file-name gave {libtsan!r})")
+    return libtsan
+
+
+def _tsan_env(libtsan: str) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "SHADOW_SANITIZE": "thread",
+        "LD_PRELOAD": libtsan,
+        # halt_on_error=0: CPython internals can trip benign reports on
+        # some builds; we triage by stack below instead of aborting on
+        # the first report.  exitcode=0 keeps the suite's own pass/fail
+        # meaningful; history_size raises the per-thread event window so
+        # report stacks stay complete.
+        "TSAN_OPTIONS": "halt_on_error=0:exitcode=0:history_size=4",
+        "JAX_PLATFORMS": "cpu",
+        # no BLAS thread pool: numpy.testing's import-time subprocess
+        # probe must fork while the process is still single-threaded
+        # (see the module docstring's fork discipline)
+        "OPENBLAS_NUM_THREADS": "1",
+        "OMP_NUM_THREADS": "1",
+    })
+    return env
+
+
+def _dataplane_reports(text: str):
+    """ThreadSanitizer report blocks whose stacks reach the data plane."""
+    blocks = re.split(r"(?=WARNING: ThreadSanitizer:)", text)
+    return [b for b in blocks
+            if b.startswith("WARNING: ThreadSanitizer:") and
+            "dataplane" in b]
+
+
+def test_native_dataplane_suite_under_tsan(tmp_path):
+    libtsan = _tsan_toolchain_or_skip(tmp_path)
+    build = subprocess.run(
+        ["make", "sanitize-thread"],
+        cwd=NATIVE_DIR, capture_output=True, text=True, timeout=300)
+    if build.returncode != 0:
+        pytest.skip("TSan dataplane build failed (toolchain lacks "
+                    f"sanitizer support?):\n{build.stderr[-2000:]}")
+    assert os.path.exists(TSAN_SO), "make succeeded but produced no .so"
+    env = _tsan_env(libtsan)
+    # the instrumented twin must actually LOAD — otherwise the suite
+    # below would skip its native cases and pass vacuously
+    probe = subprocess.run(
+        [sys.executable, "-c",
+         "from shadow_tpu.parallel import native_plane as n; import sys; "
+         "sys.exit(0 if n.native_available() else 3)"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    if probe.returncode == 3:
+        pytest.skip("TSan extension built but did not load (runtime "
+                    f"mismatch?) — stderr:\n{probe.stderr[-2000:]}")
+    assert probe.returncode == 0, (
+        f"probe interpreter died under TSan (rc={probe.returncode}):\n"
+        f"{probe.stderr[-3000:]}")
+    run = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         "-k", "not shards",
+         os.path.join("tests", "test_native_dataplane.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=3600)
+    text = run.stdout + run.stderr
+    ours = _dataplane_reports(text)
+    assert not ours, (
+        f"ThreadSanitizer reported {len(ours)} race(s) reaching the "
+        f"data plane:\n{ours[0][:4000]}")
+    total = text.count("WARNING: ThreadSanitizer:")
+    if total:
+        # interpreter-internal reports: tolerated, but visible
+        print(f"note: {total} TSan report(s) outside the data plane "
+              "(CPython internals) were tolerated")
+    assert run.returncode == 0, (
+        f"TSan dataplane suite failed (rc={run.returncode}):\n"
+        f"{text[-4000:]}")
