@@ -3,19 +3,26 @@
 
 PY ?= python
 # `make lint-diff BASE=origin/main` lints only files changed since BASE
-# (simlint) / reports only changed-file findings (simrace — its rules
-# are cross-module, so the ANALYSIS stays package-wide either way).
+# (simlint) / reports only changed-file findings (simrace/simtwin —
+# their rules are cross-module/cross-plane, so the ANALYSIS stays
+# package-wide either way).
 BASE ?= HEAD
 
-.PHONY: lint lint-diff test native sanitize sanitize-thread
+.PHONY: lint lint-diff spec test native sanitize sanitize-thread
 
 lint:
 	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu
 	$(PY) -m shadow_tpu.analysis.simrace shadow_tpu
+	$(PY) -m shadow_tpu.analysis.simtwin shadow_tpu native
 
 lint-diff:
 	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu --diff $(BASE)
 	$(PY) -m shadow_tpu.analysis.simrace shadow_tpu --diff $(BASE)
+	$(PY) -m shadow_tpu.analysis.simtwin shadow_tpu native --diff $(BASE)
+
+# regenerate the checked-in cross-plane protocol IR (byte-stable)
+spec:
+	$(PY) -m shadow_tpu.analysis.simtwin --emit-spec spec/protocol.json
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

@@ -587,11 +587,12 @@ def main() -> None:
     # run, reported alongside as tor200_tpu for continuity)
     tor200 = sims["tor200_serial"]["sim_sec_per_wall_sec"]
     c_rate = chot.get("c_hotloop_events_per_sec")
-    # static-analysis health (ISSUE 4 + 5): the same simlint/simrace
-    # passes the tier-1 gates enforce, timed — findings must stay 0 and
-    # both passes must stay cheap enough to run on every PR
+    # static-analysis health (ISSUE 4 + 5 + 6): the same simlint/simrace/
+    # simtwin passes the tier-1 gates enforce, timed — findings must stay
+    # 0 and every pass must stay cheap enough to run on every PR
     from shadow_tpu.analysis.simlint import lint_paths, load_config
     from shadow_tpu.analysis.simrace import race_paths
+    from shadow_tpu.analysis.simtwin import load_map, twin_paths
     _repo = os.path.dirname(os.path.abspath(__file__))
     _cfg = load_config(os.path.join(_repo, "pyproject.toml"))
     _lint_t0 = time.perf_counter()
@@ -600,6 +601,11 @@ def main() -> None:
     _race_t0 = time.perf_counter()
     _race = race_paths([os.path.join(_repo, "shadow_tpu")], _cfg)
     simrace_sec = round(time.perf_counter() - _race_t0, 3)
+    _twin_t0 = time.perf_counter()
+    _twin = twin_paths([os.path.join(_repo, "shadow_tpu"),
+                        os.path.join(_repo, "native")], _cfg,
+                       load_map(None, _cfg))
+    simtwin_sec = round(time.perf_counter() - _twin_t0, 3)
     out = {
         "metric": "tor200_sim_sec_per_wall_sec",
         "value": tor200,
@@ -630,6 +636,9 @@ def main() -> None:
         "simrace_findings": len(_race.unsuppressed),
         "simrace_suppressed": len(_race.suppressed),
         "simrace_sec": simrace_sec,
+        "simtwin_findings": len(_twin.unsuppressed),
+        "simtwin_suppressed": len(_twin.suppressed),
+        "simtwin_sec": simtwin_sec,
         "kernel_transfer_inclusive_mpkts": round(dev_rate / 1e6, 3),
         "kernel_device_compute_mpkts": round(dev_compute / 1e6, 2),
         "own_scalar_python_mpkts": round(cpu_rate / 1e6, 4),
@@ -707,11 +716,13 @@ def main() -> None:
         # workload — must be ~0 (ISSUE 3)
         "obs_overhead_sec":
             sims.get("tor200_serial", {}).get("obs_overhead_sec"),
-        # static-analysis gates (ISSUE 4 + 5): must be 0 findings each
+        # static-analysis gates (ISSUE 4 + 5 + 6): must be 0 findings each
         "simlint_findings": out["simlint_findings"],
         "simlint_sec": simlint_sec,
         "simrace_findings": out["simrace_findings"],
         "simrace_sec": simrace_sec,
+        "simtwin_findings": out["simtwin_findings"],
+        "simtwin_sec": simtwin_sec,
         "gates_enforced": True,
     }
     blob = json.dumps(summary)

@@ -221,21 +221,27 @@ class ModuleContext:
 # ---------------------------------------------------------------------------
 # suppression pragmas
 
+# one pragma vocabulary for the whole analysis family: the introducer may
+# be spelled simlint:/simrace:/simtwin: (all equivalent), and the rule ids
+# scope ownership — each tool judges staleness only for rules it runs
 PRAGMA_RE = re.compile(
-    r"#\s*simlint:\s*disable=([A-Za-z0-9_,\s]*?)\s*(?:--\s*(.*))?$")
+    r"#\s*sim(?:lint|race|twin):\s*disable=([A-Za-z0-9_,\s]*?)"
+    r"\s*(?:--\s*(.*))?$")
 _KNOWN_RULES_CACHE: Optional[set] = None
 
 
 def known_rule_ids() -> set:
     """Every rule id any tool in this package owns: simlint's SIM00x
-    catalog plus simrace's SIM1xx concurrency catalog.  Pragmas may name
-    any of them; each TOOL only judges staleness for the rules it RUNS
-    (a ``disable=SIM103`` pragma is invisible to simlint, not stale)."""
+    catalog, simrace's SIM1xx concurrency catalog, and simtwin's SIM2xx
+    cross-plane catalog.  Pragmas may name any of them; each TOOL only
+    judges staleness for the rules it RUNS (a ``disable=SIM103`` pragma
+    is invisible to simlint, not stale)."""
     global _KNOWN_RULES_CACHE
     if _KNOWN_RULES_CACHE is None:
         ids = {r.id for r in default_rules()} | {"SIM000"}
-        from . import race_rules
+        from . import race_rules, twin_rules
         ids |= {r.id for r in race_rules.CATALOG}
+        ids |= {r.id for r in twin_rules.CATALOG}
         _KNOWN_RULES_CACHE = ids
     return _KNOWN_RULES_CACHE
 
@@ -489,11 +495,13 @@ def iter_py_files(paths: List[str], config: Config) -> List[Tuple[str, str]]:
     return sorted(set(out))
 
 
-def changed_py_files(base: str, root: str) -> Set[str]:
-    """Relpaths (from ``root``, posix) of .py files changed since git ref
-    ``base``, plus untracked ones — the ``--diff BASE`` incremental-lint
-    set.  Raises RuntimeError when git can't answer (bad ref, not a
-    repo), so the CLI can exit 2 instead of silently linting nothing.
+def changed_py_files(base: str, root: str,
+                     exts: Tuple[str, ...] = (".py",)) -> Set[str]:
+    """Relpaths (from ``root``, posix) of files with an ``exts`` suffix
+    changed since git ref ``base``, plus untracked ones — the ``--diff
+    BASE`` incremental-lint set (simtwin passes C suffixes too).  Raises
+    RuntimeError when git can't answer (bad ref, not a repo), so the CLI
+    can exit 2 instead of silently linting nothing.
 
     Path bases differ between the two git commands: ``git diff
     --name-only`` prints toplevel-relative paths while ``git ls-files``
@@ -517,7 +525,7 @@ def changed_py_files(base: str, root: str) -> Set[str]:
     prefix = _git(["rev-parse", "--show-prefix"]).strip()
     out: Set[str] = set()
     for p in _git(["diff", "--name-only", "-z", base, "--"]).split("\0"):
-        if not p.endswith(".py"):
+        if not p.endswith(exts):
             continue
         if prefix:
             if not p.startswith(prefix):
@@ -526,7 +534,7 @@ def changed_py_files(base: str, root: str) -> Set[str]:
         out.add(p)
     out.update(p for p in _git(["ls-files", "--others",
                                 "--exclude-standard", "-z"]).split("\0")
-               if p.endswith(".py"))
+               if p.endswith(exts))
     return out
 
 

@@ -1,0 +1,275 @@
+"""simtwin: cross-plane protocol-equivalence static analysis.
+
+The simulator's protocol logic exists three times: the Python modules
+(authoritative), the hand-transcribed native C data plane, and the
+JAX/numpy kernel family.  Runtime digest tests keep them honest — hours
+into a run.  simtwin fails the drift at LINT time instead: three
+extractors (Python AST, cspec's regex+brace C reader, the kernel dtype
+pass) feed one table-driven IR, and the SIM2xx rules diff the planes:
+
+=======  ========  ====================================================
+SIM201   error     protocol constant / threshold drift between twins
+SIM202   error     TCP state-transition table drift
+SIM203   error     twin missing a mapped counterpart surface
+                   ([tool.simtwin.map] in pyproject.toml)
+SIM204   error     dtype/overflow hazard in a device kernel
+=======  ========  ====================================================
+
+Usage::
+
+    python -m shadow_tpu.analysis.simtwin [paths...] [--json]
+        [--list-rules] [--config pyproject.toml] [--diff BASE]
+        [--emit-spec [PATH]]
+
+Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage error.
+
+Everything else is shared with simlint/simrace: severity model, JSON
+schema (``"tool": "simtwin"``), ``[tool.simlint.allow]`` allowlists, and
+the pragma vocabulary — ``# simtwin: disable=SIM2xx -- <why>`` in Python
+files, ``// simtwin: disable=SIM2xx -- <why>`` in C files (the
+``simlint:`` spelling works too; each tool judges staleness only for the
+rules it runs, so a SIM2xx pragma is never "stale" to simlint or simrace
+and vice versa).  ``--diff BASE`` keeps the ANALYSIS whole-model (a
+constant changed in an untouched twin still has to agree with the edited
+one) and filters only the report, exactly like simrace.
+
+``--emit-spec`` serializes the extracted IR to ``spec/protocol.json`` —
+checked in, byte-stable across regeneration and PYTHONHASHSEED values
+(everything sorted, no ids, no timestamps).  That file is the seed
+artifact for ROADMAP item 4's single-source protocol spec: the planes are
+diffed against ONE table today so they can be *generated* from one table
+tomorrow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Set
+
+from . import twin_rules
+from .simlint import (Config, Finding, LintResult, _toml_section,
+                      apply_pragmas, changed_py_files, load_config)
+from .twin_rules import CATALOG, MapEntry, TwinModel, build_spec, parse_map
+
+TWIN_EXTS = (".py", ".cc", ".cpp", ".h")
+
+
+def default_rules() -> List[twin_rules.TwinRule]:
+    return list(CATALOG)
+
+
+def active_ids(rules: Optional[List] = None) -> Set[str]:
+    return {r.id for r in (rules or default_rules())} | {"SIM000"}
+
+
+def load_map(config_path: Optional[str], config: Config
+             ) -> Dict[str, List[MapEntry]]:
+    """[tool.simtwin.map] from the same pyproject the Config came from."""
+    path = config_path
+    if path is None:
+        cand = os.path.join(config.root, "pyproject.toml")
+        path = cand if os.path.isfile(cand) else None
+    if path is None:
+        return {}
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return {}
+    return parse_map(_toml_section(text, "tool.simtwin.map"))
+
+
+def _apply_c_pragmas(path: str, source: str, findings: List[Finding],
+                     ids: Set[str]) -> List[Finding]:
+    """C-file counterpart of simlint.apply_pragmas: // pragma comments,
+    reason required, rule-scoped ownership, stale pragma = SIM000."""
+    pragmas, malformed = cspec_pragmas(source)
+    bad = [Finding("SIM000", "error", path, ln, col, msg)
+           for ln, col, msg in malformed]
+    pragmas = [p for p in pragmas if p.rule in ids]
+    index = {(p.target, p.rule): p for p in pragmas}
+    for f in findings:
+        p = index.get((f.line, f.rule))
+        if p is not None:
+            f.suppressed, f.reason = True, p.reason
+            p.used = True
+    for p in pragmas:
+        if not p.used:
+            bad.append(Finding(
+                "SIM000", "error", path, p.line, p.col,
+                f"suppression pragma for {p.rule} matched no finding — "
+                "remove the stale pragma (or fix its rule id)"))
+    return sorted(findings + bad, key=Finding.sort_key)
+
+
+def cspec_pragmas(source: str):
+    from . import cspec
+    from .simlint import known_rule_ids
+    return cspec.collect_c_pragmas(source, known_rule_ids())
+
+
+def twin_sources(sources: Dict[str, str],
+                 config: Optional[Config] = None,
+                 surface_map: Optional[Dict[str, List[MapEntry]]] = None,
+                 rules: Optional[List] = None) -> List[Finding]:
+    """Analyze in-memory planes ({relpath: source}) — the fixture entry
+    point (the cross-plane analog of simlint.lint_source)."""
+    config = config or Config()
+    rules = rules if rules is not None else default_rules()
+    surface_map = surface_map or {}
+    twin = TwinModel(sources, surface_map)
+    per_file: Dict[str, List[Finding]] = {}
+    for rule in rules:
+        for f in rule.run(twin):
+            if not config.is_allowed(f.rule, f.path):
+                per_file.setdefault(f.path, []).append(f)
+    ids = {r.id for r in rules} | {"SIM000"}
+    out: List[Finding] = list(twin.parse_errors)
+    handled: Set[str] = set()
+    for rel, ctx in twin.py_ctx.items():
+        out.extend(apply_pragmas(ctx, per_file.get(rel, []), ids))
+        handled.add(rel)
+    for rel in twin.c_extracts:
+        out.extend(_apply_c_pragmas(rel, sources[rel],
+                                    per_file.get(rel, []), ids))
+        handled.add(rel)
+    for rel, fs in per_file.items():        # e.g. pyproject-anchored SIM203
+        if rel not in handled:
+            out.extend(fs)
+    return sorted(out, key=Finding.sort_key)
+
+
+def _load_mapped_sources(config: Config,
+                         surface_map: Dict[str, List[MapEntry]]
+                         ) -> Dict[str, str]:
+    sources: Dict[str, str] = {}
+    for entries in surface_map.values():
+        for e in entries:
+            if e.path in sources:
+                continue
+            abspath = os.path.join(config.root, e.path)
+            try:
+                with open(abspath, encoding="utf-8") as f:
+                    sources[e.path] = f.read()
+            except (OSError, UnicodeDecodeError):
+                pass                  # SurfaceMapRule reports the absence
+    return sources
+
+
+def twin_paths(paths: List[str], config: Optional[Config] = None,
+               surface_map: Optional[Dict[str, List[MapEntry]]] = None,
+               rules: Optional[List] = None,
+               only: Optional[Set[str]] = None) -> LintResult:
+    """Analyze the mapped twin files under the config root.  ``paths``
+    and ``only`` restrict REPORTING (the model is cross-plane: every
+    mapped file participates in extraction regardless)."""
+    config = config or load_config(None, start=paths[0] if paths else ".")
+    if surface_map is None:
+        surface_map = load_map(None, config)
+    sources = _load_mapped_sources(config, surface_map)
+    findings = twin_sources(sources, config, surface_map, rules)
+
+    scoped: Set[str] = set()
+    for p in paths:
+        rel = os.path.relpath(os.path.abspath(p), config.root)
+        rel = rel.replace(os.sep, "/")
+        prefix = "" if rel == "." else rel.rstrip("/") + "/"
+        for rel_file in sources:
+            if prefix == "" or rel_file.startswith(prefix) \
+                    or rel_file == rel:
+                scoped.add(rel_file)
+    # pyproject-anchored findings (missing mapped file) always report
+    scoped.add("pyproject.toml")
+    findings = [f for f in findings if f.path in scoped]
+    if only is not None:
+        # pyproject-anchored findings (a map entry whose file is gone)
+        # survive the --diff filter too: .toml never enters the changed
+        # set, and a broken map must fail the incremental gate as well
+        findings = [f for f in findings
+                    if f.path in only or f.path == "pyproject.toml"]
+    findings.sort(key=Finding.sort_key)
+    n_files = len([s for s in sources if s in scoped])
+    return LintResult(findings, n_files, tool="simtwin")
+
+
+def emit_spec(out_path: str, config: Config,
+              surface_map: Dict[str, List[MapEntry]]) -> bytes:
+    """Serialize the IR; returns the exact bytes written."""
+    sources = _load_mapped_sources(config, surface_map)
+    twin = TwinModel(sources, surface_map)
+    spec = build_spec(twin)
+    blob = (json.dumps(spec, indent=2, sort_keys=True) + "\n").encode()
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    with open(out_path, "wb") as f:
+        f.write(blob)
+    return blob
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="simtwin",
+        description="cross-plane protocol-equivalence static analysis "
+                    "(shadow-tpu)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories to report on "
+                         "(default: shadow_tpu/ native/)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON on stdout")
+    ap.add_argument("--config", default=None,
+                    help="pyproject.toml carrying [tool.simlint] + "
+                         "[tool.simtwin.map]")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--diff", metavar="BASE", default=None,
+                    help="report only findings in files changed since git "
+                         "ref BASE (analysis stays cross-plane)")
+    ap.add_argument("--emit-spec", nargs="?", const="", default=None,
+                    metavar="PATH",
+                    help="write the extracted protocol IR to PATH "
+                         "(default: spec/protocol.json under the config "
+                         "root) and exit")
+    args = ap.parse_args(argv)
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.severity:<7}  {r.short}")
+        return 0
+    paths = args.paths or ["shadow_tpu", "native"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing and args.emit_spec is None:
+        print(f"simtwin: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    config = load_config(args.config, start=paths[0] if not missing else ".")
+    surface_map = load_map(args.config, config)
+    if args.emit_spec is not None:
+        out_path = args.emit_spec or os.path.join(config.root, "spec",
+                                                  "protocol.json")
+        blob = emit_spec(out_path, config, surface_map)
+        print(f"simtwin: wrote {out_path} ({len(blob)} bytes)")
+        return 0
+    only = None
+    if args.diff is not None:
+        try:
+            only = changed_py_files(args.diff, config.root, exts=TWIN_EXTS)
+        except RuntimeError as e:
+            print(f"simtwin: --diff {args.diff}: {e}", file=sys.stderr)
+            return 2
+    result = twin_paths(paths, config, surface_map, rules, only=only)
+    if args.json:
+        json.dump(result.to_json(), sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        for f in result.unsuppressed:
+            print(f.render())
+        print(f"simtwin: {len(result.unsuppressed)} finding(s), "
+              f"{len(result.suppressed)} suppressed, "
+              f"{result.files} file(s)")
+    return 1 if result.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,704 @@
+"""simtwin (shadow_tpu/analysis/simtwin.py): the cross-plane
+protocol-equivalence static-analysis pass, ISSUE 6's tentpole.
+
+Fixture pairs (fire + suppress) for every SIM2xx rule, the deliberately
+drifted C/Python/kernel triple the ISSUE requires, spec-emission byte
+stability (including PYTHONHASHSEED independence and the checked-in
+spec/protocol.json staying current), the ``--diff BASE`` report filter,
+JSON/CLI semantics, cross-tool pragma ownership (a SIM2xx pragma is never
+"stale" to simlint or simrace and vice versa) — and THE GATE: simtwin
+over shadow_tpu/ + native/ must report ZERO unsuppressed findings, so a
+constant, transition or dtype that drifts between the Python plane, the
+native C plane and the JAX kernel family fails lint in any future PR.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from shadow_tpu.analysis.simlint import Config, lint_source, load_config
+from shadow_tpu.analysis.simrace import race_sources
+from shadow_tpu.analysis.simtwin import (emit_spec, load_map, twin_paths,
+                                         twin_sources)
+from shadow_tpu.analysis.twin_rules import parse_map
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _twin(sources, surface_map, config=None):
+    srcs = {k: textwrap.dedent(v) for k, v in sources.items()}
+    return twin_sources(srcs, config, parse_map(surface_map))
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings if not f.suppressed})
+
+
+# ---------------------------------------------------------------------------
+# SIM201 — protocol constant / threshold drift
+
+
+_PY_DEFS = """
+    CONFIG_MTU = 1500
+    CONFIG_TCP_MAX_SEGMENT_SIZE = 1460
+"""
+
+_WIRE_MAP = {"wire-constants": ["py:shadow_tpu/fake/defs.py",
+                                "c:native/fake.cc"]}
+
+
+def test_sim201_quiet_when_planes_agree():
+    out = _twin({"shadow_tpu/fake/defs.py": _PY_DEFS,
+                 "native/fake.cc": """
+                     constexpr int MTU = 1500;
+                     constexpr int64_t MSS = 1460LL;
+                 """}, _WIRE_MAP)
+    assert out == []
+
+
+def test_sim201_fires_on_constant_drift():
+    out = _twin({"shadow_tpu/fake/defs.py": _PY_DEFS,
+                 "native/fake.cc": """
+                     constexpr int MTU = 9000;
+                     constexpr int MSS = 1460;
+                 """}, _WIRE_MAP)
+    assert _rules_of(out) == ["SIM201"]
+    (f,) = out
+    assert f.path == "native/fake.cc"
+    assert "MTU" in f.message and "9000" in f.message and "1500" in f.message
+    assert "python plane" in f.message
+
+
+def test_sim201_suppressible_with_reason():
+    out = _twin({"shadow_tpu/fake/defs.py": _PY_DEFS,
+                 "native/fake.cc": (
+                     "constexpr int MTU = 9000; "
+                     "// simtwin: disable=SIM201 -- fixture divergence\n"
+                     "constexpr int MSS = 1460;\n")}, _WIRE_MAP)
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM201"]
+    assert out[0].reason == "fixture divergence"
+
+
+def test_sim201_folds_expressions_not_tokens():
+    # 2 * 746 on one side vs 1492 on the other must COMPARE EQUAL — the
+    # extractors fold constant arithmetic before diffing
+    out = _twin({"shadow_tpu/fake/defs.py": "CONFIG_MTU = 2 * 750\n",
+                 "native/fake.cc": "#define MTU (1500)\n"}, _WIRE_MAP)
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SIM202 — TCP state-transition table drift
+
+
+_PY_TCP = """
+    ESTABLISHED = "established"
+    CLOSE_WAIT = "close_wait"
+
+    class Sock:
+        def on_fin(self):
+            if self.state == ESTABLISHED:
+                self.state = CLOSE_WAIT
+"""
+
+_C_TCP_OK = """
+    enum TcpState { ST_ESTABLISHED = 0, ST_CLOSE_WAIT = 1 };
+    struct Sock { int state; };
+    void on_fin(struct Sock* s) {
+      if (s->state == ST_ESTABLISHED) {
+        s->state = ST_CLOSE_WAIT;
+      }
+    }
+"""
+
+_STATE_MAP = {"tcp-state-machine": ["py:shadow_tpu/fake/tcp.py",
+                                    "c:native/fake.cc"]}
+
+
+def test_sim202_quiet_when_tables_agree():
+    out = _twin({"shadow_tpu/fake/tcp.py": _PY_TCP,
+                 "native/fake.cc": _C_TCP_OK}, _STATE_MAP)
+    assert out == []
+
+
+def test_sim202_fires_on_missing_transition():
+    # the C twin knows both states but never makes the transition
+    out = _twin({"shadow_tpu/fake/tcp.py": _PY_TCP,
+                 "native/fake.cc": """
+                     enum TcpState { ST_ESTABLISHED = 0, ST_CLOSE_WAIT = 1 };
+                     struct Sock { int state; };
+                     void on_fin(struct Sock* s) { (void)s; }
+                 """}, _STATE_MAP)
+    assert _rules_of(out) == ["SIM202"]
+    (f,) = out
+    assert f.path == "native/fake.cc"
+    assert "established -> close_wait" in f.message
+    assert "no counterpart" in f.message
+
+
+def test_sim202_fires_on_extra_transition_and_suppresses():
+    c_extra = _C_TCP_OK + """
+    void reset(struct Sock* s) {
+      s->state = ST_ESTABLISHED;{P}
+    }
+    """
+    out = _twin({"shadow_tpu/fake/tcp.py": _PY_TCP,
+                 "native/fake.cc": c_extra.replace("{P}", "")}, _STATE_MAP)
+    assert _rules_of(out) == ["SIM202"]
+    assert "only in this twin" in out[0].message
+    out = _twin({"shadow_tpu/fake/tcp.py": _PY_TCP,
+                 "native/fake.cc": c_extra.replace(
+                     "{P}", "  // simtwin: disable=SIM202 -- fixture")},
+                _STATE_MAP)
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM202"]
+
+
+def test_sim202_fires_on_state_universe_drift():
+    # a whole state the python plane has and the C enum lacks
+    py = _PY_TCP + """
+    TIME_WAIT = "time_wait"
+
+    class Sock2:
+        def on_close(self):
+            self.state = TIME_WAIT
+    """
+    out = _twin({"shadow_tpu/fake/tcp.py": py,
+                 "native/fake.cc": _C_TCP_OK}, _STATE_MAP)
+    rules = [f.rule for f in out if not f.suppressed]
+    assert set(rules) == {"SIM202"}
+    assert any("time_wait" in f.message and "state" in f.message
+               for f in out)
+
+
+# ---------------------------------------------------------------------------
+# SIM203 — missing mapped counterpart surface
+
+
+def test_sim203_fires_on_missing_file():
+    out = _twin({"shadow_tpu/fake/defs.py": _PY_DEFS},
+                {"wire-constants": ["py:shadow_tpu/fake/defs.py",
+                                    "c:native/nope.cc"]})
+    assert _rules_of(out) == ["SIM203"]
+    (f,) = out
+    assert f.path == "pyproject.toml"
+    assert "native/nope.cc" in f.message and "does not exist" in f.message
+
+
+def test_sim203_fires_on_missing_symbol_and_suppresses():
+    srcs = {"shadow_tpu/fake/mod.py": "def push_out():\n    pass\n",
+            "native/fake.cc": "void push_out(void) { }\n"}
+    smap = {"tcp-send-pipeline": ["py:shadow_tpu/fake/mod.py:push_in",
+                                  "c:native/fake.cc:push_out"]}
+    out = _twin(srcs, smap)
+    assert _rules_of(out) == ["SIM203"]
+    (f,) = out
+    assert f.path == "shadow_tpu/fake/mod.py"
+    assert "push_in" in f.message
+    srcs["shadow_tpu/fake/mod.py"] = (
+        "def push_out():  # simtwin: disable=SIM203 -- renamed, map pending\n"
+        "    pass\n")
+    out = _twin(srcs, smap)
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM203"]
+
+
+def test_sim203_sees_class_and_method_symbols():
+    out = _twin({"shadow_tpu/fake/mod.py": """
+                     class Bucket:
+                         def refill(self):
+                             pass
+                 """,
+                 "native/fake.cc": "struct Bucket { int toks; };\n"},
+                {"token-bucket": ["py:shadow_tpu/fake/mod.py:Bucket.refill",
+                                  "c:native/fake.cc:Bucket"]})
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SIM204 — dtype/overflow hazard in a device kernel
+
+
+_KERNEL_MAP = {"arrival-ring": ["kernel:shadow_tpu/fake/kern.py"]}
+
+
+def test_sim204_fires_on_narrowed_time_cast():
+    out = _twin({"shadow_tpu/fake/kern.py": """
+                     import jax.numpy as jnp
+
+                     def pack(send_times):
+                         return send_times.astype(jnp.int32)
+                 """}, _KERNEL_MAP)
+    assert _rules_of(out) == ["SIM204"]
+    assert "send_times" in out[0].message and "int32" in out[0].message
+
+
+def test_sim204_fires_on_narrow_carrier_store_and_suppresses():
+    src = """
+        import jax.numpy as jnp
+
+        def kernel(deliver_ns):
+            ring = jnp.zeros(8, dtype=jnp.int32)
+            ring = ring.at[0].set(deliver_ns){P}
+            return ring
+    """
+    out = _twin({"shadow_tpu/fake/kern.py": src.replace("{P}", "")},
+                _KERNEL_MAP)
+    assert _rules_of(out) == ["SIM204"]
+    assert "deliver_ns" in out[0].message and "ring" in out[0].message
+    out = _twin({"shadow_tpu/fake/kern.py": src.replace(
+        "{P}", "  # simtwin: disable=SIM204 -- bounded cell counts")},
+        _KERNEL_MAP)
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM204"]
+
+
+def test_sim204_quiet_on_counts_and_non_kernel_files():
+    # int32 cell COUNTS are fine; and the dtype pass only runs on files
+    # tagged plane:kernel in the map
+    out = _twin({"shadow_tpu/fake/kern.py": """
+                     import jax.numpy as jnp
+
+                     def kernel(cell_counts):
+                         ring = jnp.zeros(8, dtype=jnp.int32)
+                         return ring.at[0].set(cell_counts)
+                 """}, _KERNEL_MAP)
+    assert out == []
+    out = _twin({"shadow_tpu/fake/mod.py": """
+                     import jax.numpy as jnp
+
+                     def pack(send_times):
+                         return send_times.astype(jnp.int32)
+                 """},
+                {"tcp-send-pipeline": ["py:shadow_tpu/fake/mod.py"]})
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# the deliberately drifted C/Python/kernel triple (ISSUE acceptance)
+
+
+def test_drifted_triple_fails_sim201_and_sim202():
+    """One surface carried by all three planes: the kernel drifts a
+    constant (SIM201) and the C twin grows an extra transition (SIM202)
+    — both named in the findings."""
+    out = _twin(
+        {"shadow_tpu/fake/iface.py":
+            "INTERFACE_REFILL_INTERVAL_NS = 1_000_000\n",
+         "shadow_tpu/fake/kern.py":
+            "REFILL_INTERVAL_NS = 2_000_000\n",
+         "shadow_tpu/fake/tcp.py": _PY_TCP,
+         "native/fake.cc": _C_TCP_OK + """
+             #define REFILL_NS 1000000
+             void reset(struct Sock* s) {
+               s->state = ST_ESTABLISHED;
+             }
+         """},
+        {"token-bucket": ["py:shadow_tpu/fake/iface.py",
+                          "c:native/fake.cc",
+                          "kernel:shadow_tpu/fake/kern.py"],
+         "tcp-state-machine": ["py:shadow_tpu/fake/tcp.py",
+                               "c:native/fake.cc"]})
+    assert _rules_of(out) == ["SIM201", "SIM202"]
+    drift = [f for f in out if f.rule == "SIM201"]
+    assert drift[0].path == "shadow_tpu/fake/kern.py"
+    assert "REFILL_INTERVAL_NS" in drift[0].message
+    extra = [f for f in out if f.rule == "SIM202"]
+    assert extra[0].path == "native/fake.cc"
+    assert "? -> established" in extra[0].message
+
+
+# ---------------------------------------------------------------------------
+# cross-tool pragma ownership
+
+
+def test_sim2xx_pragmas_invisible_to_simlint_and_simrace():
+    # a USED simtwin pragma in a python plane file must not be "stale"
+    # to simlint or simrace (they don't run SIM2xx)
+    drifted = ("MTU = 9000  "
+               "# simtwin: disable=SIM201 -- intentional divergence\n")
+    out = _twin({"shadow_tpu/fake/a_defs.py": "CONFIG_MTU = 1500\n",
+                 "shadow_tpu/fake/b_defs.py": drifted},
+                {"wire-constants": ["py:shadow_tpu/fake/a_defs.py",
+                                    "py:shadow_tpu/fake/b_defs.py"]})
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM201"]
+    assert lint_source(drifted) == []
+    assert race_sources({"shadow_tpu/fake/b_defs.py": drifted}) == []
+
+
+def test_simtwin_ignores_other_tools_pragmas():
+    # a SIM005 (simlint) pragma inside a mapped file is not simtwin's
+    # business: no suppression, no staleness
+    src = """
+        import time as _wt
+
+        CONFIG_MTU = 1500
+
+        def stall():
+            _wt.sleep(1.0)  # simlint: disable=SIM005 -- fault harness
+    """
+    out = _twin({"shadow_tpu/fake/defs.py": src,
+                 "native/fake.cc": "constexpr int MTU = 1500;\n"}, _WIRE_MAP)
+    assert out == []
+
+
+def test_reasonless_or_unknown_pragma_is_sim000_in_c_too():
+    out = _twin({"shadow_tpu/fake/defs.py": _PY_DEFS,
+                 "native/fake.cc": """
+                     constexpr int MTU = 1500; // simtwin: disable=SIM201
+                     constexpr int MSS = 1460; // simtwin: disable=SIM299 -- x
+                 """}, _WIRE_MAP)
+    assert [f.rule for f in out] == ["SIM000", "SIM000"]
+    assert any("missing its reason" in f.message for f in out)
+    assert any("unknown rule" in f.message for f in out)
+
+
+def test_stale_c_pragma_is_sim000():
+    out = _twin({"shadow_tpu/fake/defs.py": _PY_DEFS,
+                 "native/fake.cc": """
+                     constexpr int MTU = 1500; // simtwin: disable=SIM201 -- x
+                 """}, _WIRE_MAP)
+    assert _rules_of(out) == ["SIM000"]
+    assert "matched no finding" in out[0].message
+
+
+# ---------------------------------------------------------------------------
+# allowlist
+
+
+def test_allowlist_exempts_by_rule_and_path():
+    cfg = Config(allow={"SIM201": ["native/legacy/*"]})
+    srcs = {"shadow_tpu/fake/defs.py": textwrap.dedent(_PY_DEFS),
+            "native/legacy/fake.cc": "constexpr int MTU = 9000;\n"}
+    smap = parse_map({"wire-constants": ["py:shadow_tpu/fake/defs.py",
+                                         "c:native/legacy/fake.cc"]})
+    assert twin_sources(srcs, cfg, smap) == []
+    assert _rules_of(twin_sources(srcs, Config(), smap)) == ["SIM201"]
+
+
+def test_unparsable_python_plane_is_a_finding_not_a_crash():
+    out = _twin({"shadow_tpu/fake/defs.py": "def f(:\n",
+                 "native/fake.cc": "constexpr int MTU = 1500;\n"}, _WIRE_MAP)
+    assert "SIM000" in [f.rule for f in out]
+    assert any("parse" in f.message for f in out)
+
+
+# ---------------------------------------------------------------------------
+# spec emission: byte-stable, hash-seed independent, checked in
+
+
+def test_spec_emission_is_byte_stable_and_checked_in(tmp_path):
+    cfg = load_config(os.path.join(REPO, "pyproject.toml"))
+    smap = load_map(None, cfg)
+    blob1 = emit_spec(str(tmp_path / "a.json"), cfg, smap)
+    blob2 = emit_spec(str(tmp_path / "b.json"), cfg, smap)
+    assert blob1 == blob2
+    with open(os.path.join(REPO, "spec", "protocol.json"), "rb") as f:
+        checked_in = f.read()
+    assert blob1 == checked_in, (
+        "spec/protocol.json is stale — regenerate with `make spec` "
+        "(simtwin --emit-spec) and commit the result")
+
+
+def test_spec_emission_is_hash_seed_independent(tmp_path):
+    blobs = []
+    for seed in ("1", "2"):
+        out = tmp_path / f"spec_{seed}.json"
+        env = dict(os.environ, PYTHONHASHSEED=seed)
+        run = subprocess.run(
+            [sys.executable, "-m", "shadow_tpu.analysis.simtwin",
+             "--emit-spec", str(out)],
+            capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+        assert run.returncode == 0, run.stderr
+        blobs.append(out.read_bytes())
+    assert blobs[0] == blobs[1]
+
+
+def test_spec_content_proves_extraction_is_alive():
+    """Zero findings must mean `the planes agree`, not `nothing was
+    extracted` — pin the IR's density."""
+    with open(os.path.join(REPO, "spec", "protocol.json"),
+              encoding="utf-8") as f:
+        spec = json.load(f)
+    consts = spec["constants"]
+    assert len(consts) >= 40
+    multi = [k for k, v in consts.items() if len(v) >= 2]
+    assert len(multi) == len(consts), (
+        "single-plane constants (extractor gap?): "
+        f"{sorted(set(consts) - set(multi))}")
+    tables = spec["transitions"]
+    assert set(tables) == {"native/dataplane.cc",
+                           "shadow_tpu/descriptor/tcp.py"}
+    py_pairs = tables["shadow_tpu/descriptor/tcp.py"]["pairs"]
+    c_pairs = tables["native/dataplane.cc"]["pairs"]
+    assert len(py_pairs) >= 10
+    assert py_pairs == c_pairs
+    assert len(spec["surfaces"]) >= 10
+    # a surface mapping several symbols of ONE file keeps them all
+    cong = spec["surfaces"]["congestion-control"]
+    assert cong["py:shadow_tpu/descriptor/tcp_cong.py"] == [
+        "CongestionControl", "Cubic"]
+
+
+# ---------------------------------------------------------------------------
+# --diff report filter + Makefile wiring
+
+
+def _git(cwd, *args):
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t"] + list(args),
+        cwd=cwd, capture_output=True, text=True, timeout=60)
+
+
+_FIXTURE_PYPROJECT = """\
+[tool.simlint]
+
+[tool.simtwin.map]
+wire-constants = [
+    "py:pkg/defs.py",
+    "c:pkg/fake.cc",
+]
+arrival-ring = [
+    "kernel:pkg/kern.py",
+]
+"""
+
+
+def _write_fixture_tree(root, c_mtu=9000):
+    (root / "pkg").mkdir(exist_ok=True)
+    (root / "pyproject.toml").write_text(_FIXTURE_PYPROJECT)
+    (root / "pkg" / "defs.py").write_text("CONFIG_MTU = 1500\n")
+    (root / "pkg" / "fake.cc").write_text(
+        f"constexpr int MTU = {c_mtu};\n")
+    (root / "pkg" / "kern.py").write_text(textwrap.dedent("""
+        import jax.numpy as jnp
+
+        def pack(send_times):
+            return send_times.astype(jnp.int32)
+    """))
+
+
+def test_diff_mode_filters_report_not_analysis(tmp_path):
+    _write_fixture_tree(tmp_path, c_mtu=9000)
+    assert _git(tmp_path, "init", "-q").returncode == 0
+    assert _git(tmp_path, "add", "-A").returncode == 0
+    assert _git(tmp_path, "commit", "-qm", "base").returncode == 0
+    # touch ONLY the C twin; the SIM204 finding in the untouched kernel
+    # file must drop out of the report while the (cross-plane!) SIM201
+    # drift in the changed file stays
+    (tmp_path / "pkg" / "fake.cc").write_text("constexpr int MTU = 8000;\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    full = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simtwin", "pkg",
+         "--json", "--config", str(tmp_path / "pyproject.toml")],
+        capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+    doc = json.loads(full.stdout)
+    assert doc["summary"]["by_rule"] == {"SIM201": 1, "SIM204": 1}
+    diffed = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simtwin", "pkg",
+         "--json", "--diff", "HEAD",
+         "--config", str(tmp_path / "pyproject.toml")],
+        capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+    doc = json.loads(diffed.stdout)
+    assert doc["summary"]["by_rule"] == {"SIM201": 1}
+    (f,) = doc["findings"]
+    assert f["path"].endswith("fake.cc")
+
+
+def test_diff_mode_still_reports_broken_map_entries(tmp_path):
+    # pyproject-anchored SIM203 findings survive the --diff filter: .toml
+    # never enters the changed-file set, but a map entry whose file is
+    # gone must fail the incremental gate too
+    _write_fixture_tree(tmp_path, c_mtu=1500)
+    (tmp_path / "pkg" / "kern.py").write_text("X = 1\n")
+    (tmp_path / "pyproject.toml").write_text(
+        _FIXTURE_PYPROJECT.replace("pkg/fake.cc", "pkg/gone.cc"))
+    (tmp_path / "pkg" / "fake.cc").unlink()
+    assert _git(tmp_path, "init", "-q").returncode == 0
+    assert _git(tmp_path, "add", "-A").returncode == 0
+    assert _git(tmp_path, "commit", "-qm", "base").returncode == 0
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simtwin", "pkg",
+         "--json", "--diff", "HEAD",
+         "--config", str(tmp_path / "pyproject.toml")],
+        capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+    assert run.returncode == 1, run.stdout + run.stderr
+    doc = json.loads(run.stdout)
+    assert doc["summary"]["by_rule"] == {"SIM203": 1}
+    assert doc["findings"][0]["path"] == "pyproject.toml"
+
+
+def test_bare_emit_spec_works_without_default_paths(tmp_path):
+    # `simtwin --emit-spec` (no PATH) must emit even where the default
+    # report paths shadow_tpu/ native/ don't exist under cwd
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simtwin",
+         "--emit-spec"],
+        capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "wrote" in run.stdout
+
+
+def test_cspec_hex_literals_fold_with_suffixes():
+    from shadow_tpu.analysis.cspec import eval_c_expr
+    assert eval_c_expr("0xFF", {}) == 255
+    assert eval_c_expr("0xFFFF", {}) == 0xFFFF
+    assert eval_c_expr("0x1BD11BDAULL", {}) == 0x1BD11BDA
+    assert eval_c_expr("1000LL", {}) == 1000
+    assert eval_c_expr("1.0f", {}) == 1.0
+    assert eval_c_expr("2 * 0xF", {}) == 30
+
+
+def test_cspec_array_with_trailing_comma_still_extracts():
+    from shadow_tpu.analysis import cspec
+    ext = cspec.extract(
+        "t.cc", "const int _ROT[8] = {13, 15, 26, 6, 17, 29, 16, 24,};\n")
+    assert ext.constants["_ROT"][0] == [13, 15, 26, 6, 17, 29, 16, 24]
+
+
+def test_cspec_probe_disagreement_surfaces_as_drift():
+    # two divergent spellings of one coefficient inside the C plane must
+    # COMPARE UNEQUAL against the python plane, not silently drop the
+    # canon from the comparison
+    out = _twin(
+        {"shadow_tpu/fake/tcp.py": """
+             class S:
+                 def on_dup(self, count):
+                     if count == 3:
+                         pass
+         """,
+         "native/fake.cc": """
+             void a(int count) { if (count == 3) {} }
+             void b(int count) { if (count == 4) {} }
+         """},
+        {"tcp-send-pipeline": ["py:shadow_tpu/fake/tcp.py",
+                               "c:native/fake.cc"]})
+    assert _rules_of(out) == ["SIM201"]
+    assert "DUP_ACK_THRESHOLD" in out[0].message
+
+
+def test_diff_mode_bad_ref_is_usage_error():
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simtwin",
+         "shadow_tpu", "native", "--diff", "no-such-ref-xyz"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert run.returncode == 2
+    assert "--diff" in run.stderr
+
+
+def test_make_lint_runs_all_three_analyzers():
+    with open(os.path.join(REPO, "Makefile"), encoding="utf-8") as f:
+        text = f.read()
+    lint_body = text.split("lint:", 1)[1].split("\n\n", 1)[0]
+    for tool in ("simlint", "simrace", "simtwin"):
+        assert tool in lint_body
+    assert "simtwin" in text.split("lint-diff:", 1)[1].split("\n\n", 1)[0]
+    assert "--emit-spec" in text       # `make spec` regenerates the IR
+
+
+# ---------------------------------------------------------------------------
+# JSON schema + CLI semantics
+
+
+def test_json_schema_and_cli_roundtrip(tmp_path):
+    _write_fixture_tree(tmp_path, c_mtu=9000)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simtwin", "pkg",
+         "--json", "--config", str(tmp_path / "pyproject.toml")],
+        capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+    assert run.returncode == 1, run.stderr
+    doc = json.loads(run.stdout)
+    assert doc["version"] == 1 and doc["tool"] == "simtwin"
+    assert doc["summary"]["findings"] == 2
+    assert doc["summary"]["suppressed"] == 0
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "severity", "path", "line", "col",
+                          "message"}
+        assert f["severity"] == "error"
+
+
+def test_cli_exit_codes(tmp_path):
+    _write_fixture_tree(tmp_path, c_mtu=1500)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    ok = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simtwin", "pkg/defs.py",
+         "--config", str(tmp_path / "pyproject.toml")],
+        capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    missing = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simtwin",
+         str(tmp_path / "nope")],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert missing.returncode == 2
+    rules = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simtwin",
+         "--list-rules"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert rules.returncode == 0
+    for rid in ("SIM201", "SIM202", "SIM203", "SIM204"):
+        assert rid in rules.stdout
+
+
+def test_path_scoping_filters_report(tmp_path):
+    # reporting scoped to pkg/defs.py must hide the C-file drift finding
+    # (the ANALYSIS still ran cross-plane: the kernel finding's absence
+    # proves scoping, the exit code pins it)
+    _write_fixture_tree(tmp_path, c_mtu=9000)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simtwin", "pkg/defs.py",
+         "--json", "--config", str(tmp_path / "pyproject.toml")],
+        capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+    doc = json.loads(run.stdout)
+    assert doc["summary"]["findings"] == 0
+
+
+# ---------------------------------------------------------------------------
+# THE GATE: zero unsuppressed findings over shadow_tpu/ + native/
+
+
+def test_gate_zero_findings_over_tree():
+    """The three protocol planes agree — enforced, not hoped.
+
+    A future PR that changes a constant, a transition, or a kernel dtype
+    in ONE plane without its twins fails HERE with the drift named, and
+    the only ways out are to fix the twin or to justify the divergence
+    with a reasoned pragma in the diff."""
+    cfg = load_config(os.path.join(REPO, "pyproject.toml"))
+    result = twin_paths([os.path.join(REPO, "shadow_tpu"),
+                         os.path.join(REPO, "native")], cfg,
+                        load_map(None, cfg))
+    assert result.files >= 15, "surface map discovery looks broken"
+    pretty = "\n".join(f.render() for f in result.unsuppressed)
+    assert not result.unsuppressed, (
+        f"simtwin found cross-plane drift:\n{pretty}\n"
+        "fix the twin, or justify with "
+        "`# simtwin: disable=<RULE> -- <why>`")
+    for f in result.suppressed:
+        assert f.reason, f"reasonless suppression survived: {f.render()}"
+
+
+def test_gate_cli_matches_api():
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simtwin",
+         "shadow_tpu", "native", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr
+    doc = json.loads(run.stdout)
+    assert doc["tool"] == "simtwin"
+    assert doc["summary"]["findings"] == 0
