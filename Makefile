@@ -8,7 +8,7 @@ PY ?= python
 # package-wide either way).
 BASE ?= HEAD
 
-.PHONY: lint lint-diff spec test native sanitize sanitize-thread
+.PHONY: lint lint-diff spec test bench-smoke native sanitize sanitize-thread
 
 lint:
 	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu
@@ -26,6 +26,13 @@ spec:
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+# <60s perf-machinery gate (ISSUE 7): a phold+star pass asserting
+# superwindows engage (rounds_per_launch > 1) and the overlap/host-exec
+# telemetry lands in the metrics JSONL (read back via
+# tools/trace_report.py --metrics).  Gates the machinery, not rates.
+bench-smoke:
+	JAX_PLATFORMS=cpu $(PY) bench.py --smoke
 
 native:
 	$(MAKE) -C native

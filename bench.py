@@ -303,7 +303,14 @@ def _run_sim(xml, policy: str, workers: int, stop: int, **opt_kw) -> dict:
         "sim_sec_per_wall_sec": round(stop / wall, 4),
         "wall_sec": round(wall, 2),
         "host_exec_sec": round(scrape["engine.host_exec_sec"], 2),
+        # host_exec split (ISSUE 7): wall resuming plugin code vs engine
+        # control-plane work on the round path — the attribution that says
+        # whether a host-wall cut actually removed engine overhead
+        "host_exec_plugin_sec": round(
+            scrape["engine.host_exec_plugin_sec"], 2),
+        "host_exec_ctrl_sec": round(scrape["engine.host_exec_ctrl_sec"], 2),
         "flush_sec": round(scrape["engine.flush_sec"], 2),
+        "rounds": eng.rounds_executed,
         # supervision columns (ISSUE 2): recoveries must be 0 in a healthy
         # bench run, and the watchdog bookkeeping (guard-thread spawn per
         # dispatch collect; the waits themselves are the dispatch's own
@@ -351,6 +358,11 @@ def _run_sim(xml, policy: str, workers: int, stop: int, **opt_kw) -> dict:
         out["plane_device_calls"] = st["device_calls"]
         out["plane_calls_per_dispatch"] = round(
             st["device_calls"] / max(st["dispatches"], 1), 2)
+        # superwindow columns (ISSUE 7): virtual engine rounds covered per
+        # kernel launch — the dispatch-amortization factor the tor10k host
+        # wall is attacked with (>1 means multi-round launches engaged)
+        out["rounds_per_launch"] = st["rounds_per_launch"]
+        out["superwindows"] = st["superwindows"]
     return out
 
 
@@ -494,6 +506,24 @@ def bench_full_sims() -> dict:
                                      device_data=True)
     out["star100_device_plane"] = _run_sim(xml_star_d, "tpu", 0, 30)
 
+    # superwindow showcase (ISSUE 7): the tor10k-class device-bound regime
+    # measurable without the reference topology — few circuits, long
+    # transfers, so the bulk phase is a host-quiet stretch the K-round
+    # negotiation can merge deep.  Same workload at K=1 is the dispatch-
+    # per-round baseline the host_exec/dispatch reduction is attributed
+    # against (digest parity between the two is a tier-1 gate,
+    # tests/test_superwindow.py).
+    xml_sw = workloads.star_bulk(8, stoptime=120,
+                                 bulk_bytes=256 * 1024 * 1024,
+                                 device_data=True)
+    sw_on = _run_sim(xml_sw, "tpu", 0, 120)
+    sw_off = _run_sim(xml_sw, "tpu", 0, 120, superwindow_rounds=1)
+    out["star8_superwindow"] = sw_on
+    out["star8_superwindow_k1"] = sw_off
+    out["star8_dispatch_reduction"] = round(
+        sw_off.get("plane", {}).get("dispatches", 0)
+        / max(sw_on.get("plane", {}).get("dispatches", 1), 1), 2)
+
     # tor10k: workload #4 on the reference's Internet GraphML
     topo_path = "/root/reference/resource/topology.graphml.xml.xz"
     if os.path.exists(topo_path):
@@ -566,8 +596,70 @@ def bench_full_sims() -> dict:
     return out
 
 
+def bench_smoke() -> int:
+    """``make bench-smoke``: a <60s phold+star pass that gates the perf
+    MACHINERY, not absolute rates — superwindows must engage
+    (rounds_per_launch > 1), and the overlap/host-exec telemetry must land
+    in the metrics JSONL exactly as a production ``--metrics`` run writes
+    it (read back through tools/trace_report.py --metrics, the same path
+    CI and humans use).  Prints one JSON line; exits 1 on any gate miss."""
+    import sys
+    import tempfile
+
+    from shadow_tpu.obs.metrics import read_metrics_file
+    from shadow_tpu.tools import workloads
+    from shadow_tpu.tools.trace_report import summarize_metrics
+
+    # phold: the reference's own scheduler benchmark through the full
+    # engine (uniform all-to-all UDP) — the host-plane half of the smoke
+    n = 16
+    xml = (f'<shadow stoptime="10"><plugin id="phold" path="python:phold" />'
+           f'<host id="phold" quantity="{n}" bandwidthdown="10240" '
+           f'bandwidthup="10240"><process plugin="phold" starttime="1" '
+           f'arguments="{n} 2 9000" /></host></shadow>')
+    r_phold = _run_sim(xml, "global", 0, 10)
+    # star: the device plane's superwindow regime (few circuits, long
+    # transfers => host-quiet bulk phase), metrics streamed to disk
+    mpath = os.path.join(tempfile.mkdtemp(prefix="bench-smoke-"),
+                         "metrics.jsonl")
+    xml_sw = workloads.star_bulk(8, stoptime=120,
+                                 bulk_bytes=256 * 1024 * 1024,
+                                 device_data=True)
+    _run_sim(xml_sw, "tpu", 0, 120, metrics_path=mpath)
+    final = summarize_metrics(read_metrics_file(mpath))["final"]
+    rpl = final.get("plane.rounds_per_launch", 0)
+    out = {
+        "phold_events": r_phold["events"],
+        "rounds_per_launch": rpl,
+        "superwindows": final.get("plane.superwindows"),
+        "overlap_efficiency": final.get("plane.overlap_efficiency"),
+        "host_exec_ctrl_sec": final.get("engine.host_exec_ctrl_sec"),
+    }
+    failures = []
+    if r_phold["events"] <= 0:
+        failures.append("phold executed no events")
+    if not rpl or rpl <= 1:
+        failures.append(f"rounds_per_launch={rpl}: superwindows never "
+                        "engaged on the device-bound star run")
+    for key in ("plane.overlap_efficiency", "engine.host_exec_plugin_sec",
+                "engine.host_exec_ctrl_sec"):
+        if key not in final:
+            failures.append(f"{key} missing from the metrics JSONL")
+    print(json.dumps({"bench_smoke": out,
+                      "pass": not failures,
+                      "failures": failures}), flush=True)
+    if failures:
+        print("BENCH SMOKE FAILURES: " + "; ".join(failures),
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
 def main() -> None:
     import sys
+
+    if "--smoke" in sys.argv:
+        sys.exit(bench_smoke())
 
     import jax
 
@@ -702,6 +794,11 @@ def main() -> None:
         "star100_device_traffic_fraction":
             sims.get("star100_device_plane",
                      {}).get("device_traffic_fraction"),
+        # superwindow columns (ISSUE 7): rounds merged per kernel launch on
+        # the device-bound showcase, and the K=1-baseline dispatch ratio
+        "star8_rounds_per_launch":
+            sims.get("star8_superwindow", {}).get("rounds_per_launch"),
+        "star8_dispatch_reduction": sims.get("star8_dispatch_reduction"),
         # supervision steady-state cost: recoveries summed over every run
         # this round; watchdog_overhead_sec from tor200_device_plane (the
         # always-measured config whose dispatch guard threads every

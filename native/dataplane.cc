@@ -2655,6 +2655,41 @@ PyObject *Plane_tracker(PyObject *self, PyObject *arg) {
   return out;
 }
 
+// Bulk tracker snapshot: ONE call returning every host's 34-wide row
+// [hid, 32 counter fields, drops] as a packed int64 little buffer the
+// Python side reads with numpy — the vectorized control-plane feed
+// (host heartbeats / end-of-run sweeps stop paying a C round-trip per
+// host; parallel/native_plane.py bulk_sync()).
+PyObject *Plane_tracker_all(PyObject *self, PyObject *) {
+  Plane *pl = SELF;
+  size_t n = 0;
+  for (HostS *h : *pl->hosts)
+    if (h) n++;
+  PyObject *buf = PyBytes_FromStringAndSize(nullptr,
+                                            (Py_ssize_t)(n * 34 * 8));
+  if (!buf) return nullptr;
+  int64_t *out = (int64_t *)PyBytes_AS_STRING(buf);
+  for (HostS *h : *pl->hosts) {
+    if (!h) continue;
+    *out++ = h->id;
+    const TrackCtr *cs[4] = {&h->in_local, &h->in_remote, &h->out_local,
+                             &h->out_remote};
+    for (int i = 0; i < 4; i++) {
+      const TrackCtr *c = cs[i];
+      *out++ = c->packets_total;
+      *out++ = c->bytes_total;
+      *out++ = c->packets_control;
+      *out++ = c->bytes_control;
+      *out++ = c->packets_data;
+      *out++ = c->bytes_data;
+      *out++ = c->packets_retrans;
+      *out++ = c->bytes_retrans;
+    }
+    *out++ = h->drops;
+  }
+  return buf;
+}
+
 PyObject *Plane_iface_state(PyObject *self, PyObject *arg) {
   long long hid = PyLong_AsLongLong(arg);
   if (PyErr_Occurred()) return nullptr;
@@ -2765,6 +2800,7 @@ PyMethodDef Plane_methods[] = {
     {"sock_state", Plane_sock_state, METH_O, nullptr},
     {"sock_fields", Plane_sock_fields, METH_O, nullptr},
     {"tracker", Plane_tracker, METH_O, nullptr},
+    {"tracker_all", Plane_tracker_all, METH_NOARGS, nullptr},
     {"iface_state", Plane_iface_state, METH_O, nullptr},
     {"counters", Plane_counters, METH_NOARGS, nullptr},
     {"next_key", Plane_next_key, METH_NOARGS, nullptr},

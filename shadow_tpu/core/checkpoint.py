@@ -61,6 +61,10 @@ def _host_state(host) -> Dict:
     plane = getattr(host, "native_plane", None)
     if plane is not None:
         plane.sync_tracker(host.id, t)
+    # the digest is an observation point: fold the device plane's pending
+    # per-node byte deltas (lazily accumulated by its collects) so the
+    # snapshot carries the true totals at this boundary
+    t.pull_device()
     return {
         "name": host.name,
         "descriptors": descriptors,

@@ -79,6 +79,9 @@ class Options:
                                          # (parallel/native_plane.py)
     device_plane_granule_ms: int = 0     # step size override (0 = auto)
     device_plane_batch_steps: int = 8    # min steps per kernel dispatch
+    superwindow_rounds: int = 8          # max lookahead rounds merged into
+                                         # one device launch when no host
+                                         # event falls inside (1 = off)
     device_plane_sync: bool = False      # block on the dispatch at launch
                                          # (serial oracle; digests identical
                                          # to the pipelined default)
@@ -229,6 +232,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="accumulate at least N plane steps per kernel "
                         "dispatch (amortizes the per-dispatch state copy "
                         "on backends where the carried state cannot alias)")
+    p.add_argument("--superwindow-rounds", type=int, default=8,
+                   dest="superwindow_rounds",
+                   help="merge up to N consecutive lookahead rounds into "
+                        "ONE device-plane kernel launch whenever no "
+                        "host-side event falls inside them (digest-"
+                        "identical to per-round dispatch; 1 = disable)")
     p.add_argument("--tpu-chunk", type=int, default=0, dest="tpu_chunk",
                    help="launch a device step as soon as N packet hops "
                         "accumulate mid-round, overlapping device compute "

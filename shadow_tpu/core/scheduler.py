@@ -676,6 +676,15 @@ class Scheduler:
     def next_event_time(self) -> int:
         return self.policy.next_time()
 
+    def set_window(self, start: int, end: int) -> None:
+        """Rebind the current round window.  Used by the device plane's
+        superwindow collect to align the engine's bookkeeping with the
+        virtual round a multi-round kernel launch actually reached (the
+        kernel may halt at an earlier negotiated boundary on a completion
+        — parallel/device_plane.py consume())."""
+        self.window_start = start
+        self.window_end = end
+
     def stop(self) -> None:
         self._running = False
 

@@ -63,6 +63,12 @@ class Tracker:
         self.allocated_bytes = 0
         self.deallocated_bytes = 0
         self.socket_stats: Dict[int, Dict[str, int]] = defaultdict(dict)
+        # authoritative external counter feeds, folded in lazily:
+        # _native -> (NativePlane, hid): the C data plane's counters;
+        # _device_feed -> (DeviceTrafficPlane, node indices): the device
+        # plane's vectorized per-node byte deltas (pull_device)
+        self._native = None
+        self._device_feed = None
 
     def add_input_bytes(self, packet, iface_ip: int) -> None:
         c = self.in_local if iface_ip == LOCALHOST_IP else self.in_remote
@@ -102,13 +108,23 @@ class Tracker:
                 "retrans": r_out.packets_retrans, "drops": self.drops,
                 "proc_ms": round(self.processing_ns / 1e6, 3)}
 
+    def pull_device(self) -> None:
+        """Fold pending device-plane byte deltas into the counters (no-op
+        unless this host contributes plane nodes): the device plane's
+        collects accumulate per-node deltas in ONE numpy array, and the
+        per-host split happens here, only when something actually reads
+        the tracker (heartbeat, state digest, teardown)."""
+        feed = self._device_feed
+        if feed is not None:
+            plane, nodes = feed
+            plane.pull_tracker_nodes(self, nodes)
+
     def heartbeat(self, now: int) -> None:
-        native = getattr(self, "_native", None)
-        if native is not None:
+        if self._native is not None:
             # native dataplane: the authoritative counters live in C
-            plane, hid = native
+            plane, hid = self._native
             plane.sync_tracker(hid, self)
-        vals = self.heartbeat_values()
+        self.pull_device()
         # the owning engine's registry when attached (robust against
         # another engine re-installing the global between construction and
         # shutdown, e.g. interleaved parity runs); the global otherwise
@@ -117,10 +133,19 @@ class Tracker:
         if registry is None:
             from ..obs.metrics import get_metrics
             registry = get_metrics()
-        registry.record_host_heartbeat(self.host.name, vals)
         level = getattr(self.host.params, "heartbeat_log_level", None) \
             or "message"
-        get_logger().log(
+        log = get_logger()
+        emit = log.would_log(level)
+        if not emit and not registry.enabled:
+            return                  # 10k silent hosts pay only the pulls
+        vals = self.heartbeat_values()
+        registry.record_host_heartbeat(self.host.name, vals)
+        if not emit:
+            # the log line is filtered out: skip the format entirely —
+            # the registry record above carries the same values
+            return
+        log.log(
             level,
             "tracker",
             f"[shadow-heartbeat] [{self.host.name}] "

@@ -301,25 +301,32 @@ def torcells_step_window(t0, queued, ring, tokens, delivered, target,
 # device-side cursor: only chains that completed THIS window and only nodes
 # whose sent-byte counter moved occupy slots; the header carries the counts.
 #
-# Layout ([4 + 2C + 2H] int64, C = chains, H = nodes):
+# Layout ([5 + 2C + 2H] int64, C = chains, H = nodes):
 #   [0] forwards this window
 #   [1] cumulative delivered cells summed over chain-exit flows
 #   [2] n_done   — chains newly completed this window
 #   [3] n_nodes  — nodes with a nonzero sent-byte delta this window
-#   [4        : 4+n_done]        newly-done chain indices (ascending)
-#   [4+C      : 4+C+n_done]      their completion steps
-#   [4+2C     : 4+2C+n_nodes]    touched node indices (ascending)
-#   [4+2C+H   : 4+2C+H+n_nodes]  their sent-byte deltas
+#   [4] t_stop   — the absolute step the kernel actually advanced to (the
+#                  final target, or an earlier sub-window boundary when the
+#                  superwindow loop halted at a completion — see
+#                  _step_span_impl); carried in the flush so the host never
+#                  pays a second device read to learn where a multi-round
+#                  dispatch stopped
+#   [5        : 5+n_done]        newly-done chain indices (ascending)
+#   [5+C      : 5+C+n_done]      their completion steps
+#   [5+2C     : 5+2C+n_nodes]    touched node indices (ascending)
+#   [5+2C+H   : 5+2C+H+n_nodes]  their sent-byte deltas
 # ---------------------------------------------------------------------------
 
-FLUSH_HEADER = 4
+FLUSH_HEADER = 5
 
 
 def flush_len(n_chains: int, n_nodes: int) -> int:
     return FLUSH_HEADER + 2 * n_chains + 2 * n_nodes
 
 
-def _pack_flush_jnp(forwards, delivered_sum, newly, done_last, sent_delta):
+def _pack_flush_jnp(forwards, delivered_sum, t_stop, newly, done_last,
+                    sent_delta):
     """newly bool [C], done_last int64 [C], sent_delta int64 [H] -> packed
     buffer.  Compaction is a cumsum-cursor scatter; out-of-range slots (the
     unselected lanes) are dropped on device."""
@@ -335,6 +342,7 @@ def _pack_flush_jnp(forwards, delivered_sum, newly, done_last, sent_delta):
     buf = buf.at[1].set(delivered_sum)
     buf = buf.at[2].set(jnp.sum(newly.astype(jnp.int64)))
     buf = buf.at[3].set(jnp.sum(touched.astype(jnp.int64)))
+    buf = buf.at[4].set(t_stop)
     base = jnp.int64(FLUSH_HEADER)
     buf = buf.at[jnp.where(newly, base + pos_c, oob)].set(
         jnp.arange(c, dtype=jnp.int64), mode="drop")
@@ -347,7 +355,8 @@ def _pack_flush_jnp(forwards, delivered_sum, newly, done_last, sent_delta):
     return buf
 
 
-def pack_flush_np(forwards, delivered_sum, newly, done_last, sent_delta):
+def pack_flush_np(forwards, delivered_sum, t_stop, newly, done_last,
+                  sent_delta):
     """Bit-identical host twin of _pack_flush_jnp."""
     c = len(newly)
     h = len(sent_delta)
@@ -358,6 +367,7 @@ def pack_flush_np(forwards, delivered_sum, newly, done_last, sent_delta):
     ni = np.flatnonzero(sent_delta)
     buf[2] = len(ci)
     buf[3] = len(ni)
+    buf[4] = t_stop
     base = FLUSH_HEADER
     buf[base:base + len(ci)] = ci
     buf[base + c:base + c + len(ci)] = np.asarray(done_last)[ci]
@@ -368,12 +378,12 @@ def pack_flush_np(forwards, delivered_sum, newly, done_last, sent_delta):
 
 
 def parse_flush(buf: np.ndarray, n_chains: int, n_nodes: int):
-    """(forwards, delivered_sum, done_chains, done_steps, node_idx,
+    """(forwards, delivered_sum, t_stop, done_chains, done_steps, node_idx,
     node_delta) from a packed flush buffer — the ONE host-side reader."""
     base = FLUSH_HEADER
     n_done = int(buf[2])
     n_touch = int(buf[3])
-    return (int(buf[0]), int(buf[1]),
+    return (int(buf[0]), int(buf[1]), int(buf[4]),
             buf[base:base + n_done],
             buf[base + n_chains:base + n_chains + n_done],
             buf[base + 2 * n_chains:base + 2 * n_chains + n_touch],
@@ -381,25 +391,109 @@ def parse_flush(buf: np.ndarray, n_chains: int, n_nodes: int):
                 base + 2 * n_chains + n_nodes + n_touch])
 
 
-def _step_window_flush_impl(t0, queued, ring, tokens, delivered, target,
-                            done_tick, node_sent, inject, inject_target,
-                            n_ticks, idle_ticks, flow_node, flow_lat,
-                            flow_succ, seg_start, refill, capacity,
-                            last_flow, ring_len: int):
-    """Windowed step + packed flush in ONE dispatch: returns the 9-tuple of
-    torcells_step_window with the packed flush buffer appended as [9].
+def _step_span_impl(t0, queued, ring, tokens, delivered, target,
+                    done_tick, node_sent, inject, inject_target,
+                    targets, idle_ticks, flow_node, flow_lat,
+                    flow_succ, seg_start, refill, capacity,
+                    ring_len: int):
+    """The SUPERWINDOW step: advance the cell model from ``t0`` through the
+    ascending absolute step boundaries in ``targets`` (padded by repeating
+    the final boundary, so the array shape stays static), HALTING at the
+    end of the first sub-window in which any chain newly completed.
+
+    Each ``targets[i-1]..targets[i]`` span is one virtual engine round's
+    dispatch (device_plane negotiates the list by replaying the K=1 round
+    recurrence); running them fused amortizes the per-dispatch launch +
+    state-copy cost K-fold.  The halt rule is what keeps a K-round launch
+    bit-identical to K separate launches: a completion wakes its client at
+    the launching round's barrier under K=1, and anything that client does
+    (close a socket, activate another flow) must see plane state advanced
+    exactly to that round — so the kernel refuses to run past it.  The
+    reached boundary comes back in the flush header (t_stop), one transfer.
+
+    Per-tick math is byte-for-byte the _step_window_impl body (pinned by
+    tests/test_superwindow.py's span-vs-sequential-windows parity case).
+    Returns the same 9-tuple, with [0] = the boundary actually reached."""
+    f = queued.shape[0]
+    h = refill.shape[0]
+    p = targets.shape[0]
+    size = jnp.int64(CELL_WIRE_BYTES)
+    is_last = flow_succ < 0
+    queued = queued + inject
+    target = target + inject_target
+    tokens = jnp.minimum(capacity, tokens + refill * idle_ticks)
+    ring = jax.lax.cond(idle_ticks > 0,
+                        lambda hh: jnp.zeros_like(hh),
+                        lambda hh: hh, ring)
+    arr_lat = jnp.zeros(f, jnp.int64).at[jnp.maximum(flow_succ, 0)].add(
+        jnp.where(is_last, jnp.int64(0), flow_lat))
+    cols = jnp.arange(f)
+    end = targets[p - 1]
+
+    def body(state):
+        (t, idx, halt, span_done, queued, hist, tokens, delivered, target,
+         done_tick, node_sent, forwards) = state
+        arr = hist[jnp.mod(t - arr_lat, ring_len), cols]
+        queued = queued + arr
+        tokens = jnp.minimum(capacity, tokens + refill)
+        cap_cells = tokens[flow_node] // size
+        csum = jnp.cumsum(queued)
+        before = csum - queued - jnp.where(
+            seg_start > 0, csum[jnp.maximum(seg_start - 1, 0)],
+            jnp.int64(0)) * (seg_start > 0)
+        served = jnp.clip(cap_cells - before, 0, queued)
+        queued = queued - served
+        spent = jax.ops.segment_sum(served * size, flow_node,
+                                    num_segments=h)
+        tokens = tokens - spent
+        node_sent = node_sent + spent
+        delivered = delivered + jnp.where(is_last, served, 0)
+        newly_done = (is_last & (target > 0) & (done_tick < 0)
+                      & (delivered >= target))
+        done_tick = jnp.where(newly_done, t, done_tick)
+        v = jnp.zeros(f, jnp.int64).at[jnp.maximum(flow_succ, 0)].add(
+            jnp.where(is_last, jnp.int64(0), served))
+        hist = hist.at[jnp.mod(t, ring_len)].set(v.astype(hist.dtype))
+        forwards = forwards + jnp.sum(served)
+        # sub-window bookkeeping: at a boundary, halt iff this span saw a
+        # completion; otherwise roll into the next span with a clean flag
+        span_done = span_done | jnp.any(newly_done)
+        boundary = (t + 1) == targets[jnp.minimum(idx, p - 1)]
+        halt = boundary & span_done
+        idx = jnp.where(boundary, idx + 1, idx)
+        span_done = span_done & ~boundary
+        return (t + 1, idx, halt, span_done, queued, hist, tokens,
+                delivered, target, done_tick, node_sent, forwards)
+
+    def cond(state):
+        return (state[0] < end) & ~state[2]
+
+    state = (t0, jnp.int64(0), jnp.bool_(False), jnp.bool_(False),
+             queued, ring, tokens, delivered, target, done_tick,
+             node_sent, jnp.int64(0))
+    out = jax.lax.while_loop(cond, body, state)
+    return (out[0], *out[4:])
+
+
+def _step_span_flush_impl(t0, queued, ring, tokens, delivered, target,
+                          done_tick, node_sent, inject, inject_target,
+                          targets, idle_ticks, flow_node, flow_lat,
+                          flow_succ, seg_start, refill, capacity,
+                          last_flow, ring_len: int):
+    """Superwindow step + packed flush in ONE dispatch: the 9-tuple of
+    _step_span_impl with the packed flush buffer appended as [9].
     ``last_flow`` [C] maps each chain to its exit flow row."""
     done_in_last = done_tick[last_flow]
     node_sent_in = node_sent
-    out = _step_window_impl(t0, queued, ring, tokens, delivered, target,
-                            done_tick, node_sent, inject, inject_target,
-                            n_ticks, idle_ticks, flow_node, flow_lat,
-                            flow_succ, seg_start, refill, capacity,
-                            ring_len)
+    out = _step_span_impl(t0, queued, ring, tokens, delivered, target,
+                          done_tick, node_sent, inject, inject_target,
+                          targets, idle_ticks, flow_node, flow_lat,
+                          flow_succ, seg_start, refill, capacity,
+                          ring_len)
     done_last = out[6][last_flow]
     newly = (done_last >= 0) & (done_in_last < 0)
-    flush = _pack_flush_jnp(out[8], jnp.sum(out[4][last_flow]), newly,
-                            done_last, out[7] - node_sent_in)
+    flush = _pack_flush_jnp(out[8], jnp.sum(out[4][last_flow]), out[0],
+                            newly, done_last, out[7] - node_sent_in)
     return (*out, flush)
 
 
@@ -412,10 +506,10 @@ def _step_window_flush_impl(t0, queued, ring, tokens, delivered, target,
 # behind the round's host work.
 torcells_step_window_flush = partial(
     jax.jit, static_argnames=("ring_len",),
-    donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))(_step_window_flush_impl)
+    donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))(_step_span_flush_impl)
 
 torcells_step_window_flush_nodonate = partial(
-    jax.jit, static_argnames=("ring_len",))(_step_window_flush_impl)
+    jax.jit, static_argnames=("ring_len",))(_step_span_flush_impl)
 
 
 def step_window_flush_for_backend():
@@ -426,24 +520,87 @@ def step_window_flush_for_backend():
     return torcells_step_window_flush
 
 
+def torcells_step_span_numpy(t0, queued, ring, tokens, delivered, target,
+                             done_tick, node_sent, inject, inject_target,
+                             targets, idle_ticks, flow_node, flow_lat,
+                             flow_succ, seg_start, refill, capacity,
+                             ring_len: int):
+    """Bit-identical host twin of _step_span_impl (same boundary/halt
+    rule) — the parity oracle and the --device-plane=numpy execution
+    mode's superwindow step."""
+    f = len(queued)
+    h = len(refill)
+    size = CELL_WIRE_BYTES
+    is_last = flow_succ < 0
+    queued = queued + inject
+    target = target + inject_target
+    tokens = np.minimum(capacity, tokens + refill * int(idle_ticks))
+    if int(idle_ticks) > 0:
+        ring = np.zeros_like(ring)   # idle jump: stale send history cleared
+    arr_lat = np.zeros(f, dtype=np.int64)
+    np.add.at(arr_lat, np.maximum(flow_succ, 0),
+              np.where(is_last, 0, flow_lat))
+    cols = np.arange(f)
+    bounds = [int(x) for x in np.asarray(targets)]
+    end = bounds[-1]
+    forwards = 0
+    t = int(t0)
+    idx = 0
+    span_done = False
+    while t < end:
+        arr = ring[(t - arr_lat) % ring_len, cols]
+        queued = queued + arr
+        tokens = np.minimum(capacity, tokens + refill)
+        cap_cells = tokens[flow_node] // size
+        csum = np.cumsum(queued)
+        seg_base = np.where(seg_start > 0, csum[np.maximum(seg_start - 1, 0)],
+                            0) * (seg_start > 0)
+        before = csum - queued - seg_base
+        served = np.clip(cap_cells - before, 0, queued)
+        queued = queued - served
+        spent = np.bincount(flow_node, weights=served * size,
+                            minlength=h).astype(np.int64)
+        tokens = tokens - spent
+        node_sent = node_sent + spent
+        delivered = delivered + np.where(is_last, served, 0)
+        newly_done = (is_last & (target > 0) & (done_tick < 0)
+                      & (delivered >= target))
+        done_tick = np.where(newly_done, t, done_tick)
+        v = np.zeros(f, dtype=np.int64)
+        np.add.at(v, np.maximum(flow_succ, 0), np.where(is_last, 0, served))
+        ring[t % ring_len] = v
+        forwards += int(served.sum())
+        span_done = span_done or bool(newly_done.any())
+        t += 1
+        if t == bounds[min(idx, len(bounds) - 1)]:
+            idx += 1
+            if span_done:
+                break
+            span_done = False
+    return (np.int64(t), queued, ring, tokens, delivered, target, done_tick,
+            node_sent, np.int64(forwards))
+
+
 def torcells_step_window_numpy_flush(t0, queued, ring, tokens, delivered,
                                      target, done_tick, node_sent, inject,
-                                     inject_target, n_ticks, idle_ticks,
+                                     inject_target, targets, idle_ticks,
                                      flow_node, flow_lat, flow_succ,
                                      seg_start, refill, capacity, last_flow,
                                      ring_len: int):
-    """Host twin of torcells_step_window_flush (same 10-tuple contract)."""
+    """Host twin of torcells_step_window_flush (same 10-tuple contract,
+    same ``targets`` superwindow boundaries)."""
     done_in_last = np.asarray(done_tick)[last_flow].copy()
     node_sent_in = np.asarray(node_sent).copy()
-    out = torcells_step_window_numpy(t0, queued, ring, tokens, delivered,
-                                     target, done_tick, node_sent, inject,
-                                     inject_target, n_ticks, idle_ticks,
-                                     flow_node, flow_lat, flow_succ,
-                                     seg_start, refill, capacity, ring_len)
+    out = torcells_step_span_numpy(t0, queued, ring, tokens, delivered,
+                                   target, done_tick, node_sent, inject,
+                                   inject_target, targets, idle_ticks,
+                                   flow_node, flow_lat, flow_succ,
+                                   seg_start, refill, capacity, ring_len)
     done_last = out[6][last_flow]
     newly = (done_last >= 0) & (done_in_last < 0)
-    flush = pack_flush_np(int(out[8]), int(out[4][last_flow].sum()), newly,
-                          done_last, out[7] - node_sent_in)
+    flush = pack_flush_np(int(out[8]), int(out[4][last_flow].sum()),
+                          int(out[0]), newly, done_last,
+                          out[7] - node_sent_in)
     return (*out, flush)
 
 
@@ -629,14 +786,16 @@ def make_torcells_sharded_window_flush(mesh, axis: str, ring_len: int,
                                        last_flow_pad: np.ndarray,
                                        node_src: np.ndarray,
                                        n_nodes: int):
-    """Sharded windowed step + packed flush in ONE dispatch (the sharded
+    """Sharded SUPERWINDOW step + packed flush in ONE dispatch (the sharded
     analog of torcells_step_window_flush): same arguments as the step built
-    by make_torcells_sharded_window, returns its 9-tuple with the packed
-    flush buffer appended as [9].  ``last_flow_pad`` [C] holds chain-exit
-    rows in PADDED flow space; ``node_src`` maps padded local-node slots to
-    global nodes (-1 = padding); the flush is expressed in the ORIGINAL
-    chain/node spaces, identical to the single-device layout's."""
-    raw = _make_sharded_window_raw(mesh, axis, ring_len)
+    by make_torcells_sharded_window except ``n_ticks`` is replaced by the
+    ``targets`` boundary vector (see _step_span_impl), and the 9-tuple
+    comes back with the packed flush buffer appended as [9].
+    ``last_flow_pad`` [C] holds chain-exit rows in PADDED flow space;
+    ``node_src`` maps padded local-node slots to global nodes (-1 =
+    padding); the flush is expressed in the ORIGINAL chain/node spaces,
+    identical to the single-device layout's."""
+    raw = _make_sharded_span_raw(mesh, axis, ring_len)
     lf = np.asarray(last_flow_pad, dtype=np.int64)
     nsrc = np.asarray(node_src, dtype=np.int64)
 
@@ -647,18 +806,18 @@ def make_torcells_sharded_window_flush(mesh, axis: str, ring_len: int,
                                                          mode="drop")
 
     def step_flush(t0, queued, ring, tokens, delivered, target, done_tick,
-                   node_sent, inject, inject_target, n_ticks, idle_ticks,
+                   node_sent, inject, inject_target, targets, idle_ticks,
                    flow_node_local, succ_global, seg_start_local,
                    refill, capacity, arr_lat, shard_base):
         done_in_last = done_tick[lf]
         sent_in = global_sent(node_sent)
         out = raw(t0, queued, ring, tokens, delivered, target, done_tick,
-                  node_sent, inject, inject_target, n_ticks, idle_ticks,
+                  node_sent, inject, inject_target, targets, idle_ticks,
                   flow_node_local, succ_global, seg_start_local,
                   refill, capacity, arr_lat, shard_base)
         done_last = out[6][lf]
         newly = (done_last >= 0) & (done_in_last < 0)
-        flush = _pack_flush_jnp(out[8], jnp.sum(out[4][lf]), newly,
+        flush = _pack_flush_jnp(out[8], jnp.sum(out[4][lf]), out[0], newly,
                                 done_last, global_sent(out[7]) - sent_in)
         return (*out, flush)
 
@@ -776,6 +935,115 @@ def _make_sharded_window_raw(mesh, axis: str, ring_len: int):
             check_rep=False)(
             t0, queued, ring, tokens, delivered, target, done_tick,
             node_sent, inject, inject_target, n_ticks, idle_ticks,
+            flow_node_local, succ_global, seg_start_local,
+            refill, capacity, arr_lat, shard_base)
+
+    return step
+
+
+def _make_sharded_span_raw(mesh, axis: str, ring_len: int):
+    """The SUPERWINDOW variant of _make_sharded_window_raw: ``targets``
+    replaces ``n_ticks``, and the loop halts at the end of the first
+    sub-window in which any chain (on ANY shard — one extra psum per tick
+    assembles the global completion flag) newly completed, exactly like the
+    single-device _step_span_impl.  Every shard computes the identical
+    boundary/halt decision, so the collective loop exits in lockstep."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def step(t0, queued, ring, tokens, delivered, target, done_tick,
+             node_sent, inject, inject_target, targets, idle_ticks,
+             flow_node_local, succ_global, seg_start_local,
+             refill, capacity, arr_lat, shard_base):
+        """Same sharding contract as _make_sharded_window_raw's step, with
+        ``targets`` (replicated int64 [P] ascending absolute boundaries,
+        padded by repeating the last) in place of the scalar tick count."""
+
+        def shard_body(t0, queued, ring, tokens, delivered, target,
+                       done_tick, node_sent, inject, inject_target,
+                       targets, idle_ticks, flow_node_local,
+                       succ_global, seg_start_local, refill, capacity,
+                       arr_lat, shard_base):
+            fp = queued.shape[0]
+            h_local = refill.shape[0]
+            p = targets.shape[0]
+            queued = queued + inject
+            target = target + inject_target
+            tokens = jnp.minimum(capacity, tokens + refill * idle_ticks)
+            ring = jax.lax.cond(idle_ticks > 0,
+                                lambda hh: jnp.zeros_like(hh),
+                                lambda hh: hh, ring)
+            end = targets[p - 1]
+            size = jnp.int64(CELL_WIRE_BYTES)
+            is_last = succ_global < 0
+            base = shard_base[0]
+            f_total = ring.shape[1]
+            my_arr_lat = jax.lax.dynamic_slice(arr_lat, (base,), (fp,))
+            my_cols = base + jnp.arange(fp)
+
+            def body(state):
+                (t, idx, halt, span_done, queued, ring, tokens, delivered,
+                 target, done_tick, node_sent, forwards) = state
+                arr = ring[jnp.mod(t - my_arr_lat, ring_len), my_cols]
+                queued = queued + arr
+                tokens = jnp.minimum(capacity, tokens + refill)
+                cap_cells = tokens[flow_node_local] // size
+                csum = jnp.cumsum(queued)
+                before = csum - queued - jnp.where(
+                    seg_start_local > 0,
+                    csum[jnp.maximum(seg_start_local - 1, 0)],
+                    jnp.int64(0)) * (seg_start_local > 0)
+                served = jnp.clip(cap_cells - before, 0, queued)
+                queued = queued - served
+                spent = jax.ops.segment_sum(served * size, flow_node_local,
+                                            num_segments=h_local)
+                tokens = tokens - spent
+                node_sent = node_sent + spent
+                delivered = delivered + jnp.where(is_last, served, 0)
+                newly = (is_last & (target > 0) & (done_tick < 0)
+                         & (delivered >= target))
+                done_tick = jnp.where(newly, t, done_tick)
+                fwd = jnp.where(is_last, jnp.int64(0), served)
+                v = jnp.zeros(f_total, jnp.int64).at[
+                    jnp.maximum(succ_global, 0)].add(fwd)
+                v = jax.lax.psum(v, axis)
+                ring = ring.at[jnp.mod(t, ring_len)].set(v.astype(ring.dtype))
+                forwards = forwards + jax.lax.psum(jnp.sum(served), axis)
+                # global completion flag: any shard's newly-done chain halts
+                # every shard at the same sub-window boundary
+                done_any = jax.lax.psum(
+                    jnp.sum(newly.astype(jnp.int64)), axis) > 0
+                span_done = span_done | done_any
+                boundary = (t + 1) == targets[jnp.minimum(idx, p - 1)]
+                halt = boundary & span_done
+                idx = jnp.where(boundary, idx + 1, idx)
+                span_done = span_done & ~boundary
+                return (t + 1, idx, halt, span_done, queued, ring, tokens,
+                        delivered, target, done_tick, node_sent, forwards)
+
+            def cond(state):
+                return (state[0] < end) & ~state[2]
+
+            state = (t0, jnp.int64(0), jnp.bool_(False), jnp.bool_(False),
+                     queued, ring, tokens, delivered, target,
+                     done_tick, node_sent, jnp.int64(0))
+            out = jax.lax.while_loop(cond, body, state)
+            return (out[0], *out[4:])
+
+        sharded = P(axis)
+        repl = P()
+        return shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(repl, sharded, repl, sharded, sharded, sharded,
+                      sharded, sharded, sharded, sharded, repl, repl,
+                      sharded, sharded, sharded, sharded, sharded,
+                      repl, sharded),
+            out_specs=(repl, sharded, repl, sharded, sharded, sharded,
+                       sharded, sharded, repl),
+            check_rep=False)(
+            t0, queued, ring, tokens, delivered, target, done_tick,
+            node_sent, inject, inject_target, targets, idle_ticks,
             flow_node_local, succ_global, seg_start_local,
             refill, capacity, arr_lat, shard_base)
 

@@ -92,6 +92,27 @@ class _PoisonedFlush:
         raise RuntimeError("fault injection: poisoned device dispatch")
 
 
+class _SuperPlan:
+    """One negotiated superwindow: the K=1 round recurrence replayed
+    host-side (negotiate_superwindow), executed as ONE kernel launch.
+
+    ``bounds`` is every merged virtual round's (window_start, window_end);
+    ``targets`` the absolute step boundary each dispatching round's window
+    maps to (ascending); ``round_of`` the bounds index that launched each
+    target.  consume() maps the kernel's reached boundary (flush t_stop)
+    back through ``round_of`` to learn which virtual round the plane — and
+    therefore the engine's round counter and window bookkeeping — actually
+    advanced to."""
+
+    __slots__ = ("base", "targets", "bounds", "round_of")
+
+    def __init__(self, base, targets, bounds, round_of):
+        self.base = base
+        self.targets = targets
+        self.bounds = bounds
+        self.round_of = round_of
+
+
 class _FlowSpec:
     """One device-mode client = TWO independent cell chains, e.g. a tor
     download (server -> exit -> middle -> guard -> client) and upload
@@ -243,6 +264,16 @@ class DeviceTrafficPlane:
         # parity-comparable.
         self.min_dispatch_steps = max(
             1, int(getattr(engine.options, "device_plane_batch_steps", 8)))
+        # superwindow depth: how many consecutive lookahead rounds one
+        # kernel launch may cover when no host-side event falls inside
+        # them (engine._advance_window negotiates per round; ISSUE 7).
+        # Also the static pad length of the kernel's targets vector.
+        self.superwindow_rounds = max(
+            1, int(getattr(engine.options, "superwindow_rounds", 8)))
+        self._pending_plan: Optional[_SuperPlan] = None
+        self._active_plan: Optional[_SuperPlan] = None
+        self.superwindows = 0
+        self._rounds_launched = 0    # virtual rounds covered by launches
         self._mesh = None
         self._shard = None           # layout dict when sharded
         self._sharded_step = None
@@ -466,6 +497,19 @@ class DeviceTrafficPlane:
                 "(lower --device-plane-granule-ms or the host bandwidth)")
         self.n_flows = n_flows
         self.n_nodes = len(names)
+        # Vectorized tracker feed (ISSUE 7 control-plane cut): collects
+        # fold each flush's per-node byte deltas into ONE numpy
+        # scatter-add here; the per-host split into Tracker counter
+        # objects happens lazily, only when something actually reads a
+        # tracker (heartbeat, digest, teardown) — Tracker.pull_device().
+        # 10k quiet hosts pay one np.add.at per collect instead of a
+        # Python loop over every touched node.
+        self._node_pending = np.zeros(self.n_nodes, dtype=np.int64)
+        host_nodes: Dict[int, List[int]] = {}
+        for i, host in enumerate(self.node_hosts):
+            host_nodes.setdefault(id(host), []).append(i)
+        for host in dict.fromkeys(self.node_hosts):
+            host.tracker._device_feed = (self, host_nodes[id(host)])
 
     # -- state ------------------------------------------------------------
     def _init_state(self):
@@ -647,7 +691,7 @@ class DeviceTrafficPlane:
                      jnp.zeros(fp, jnp.int64), jnp.zeros(fp, jnp.int64),
                      jnp.full(fp, -1, jnp.int64), jnp.zeros(hp, jnp.int64))
             out = self._sharded_step(
-                *state, zp, zp, np.int64(1), np.int64(0),
+                *state, zp, zp, self._pad_targets([1]), np.int64(0),
                 lay["flow_node_local"], lay["succ_global"],
                 lay["seg_start_local"], lay["refill"], lay["capacity"],
                 lay["arr_lat"], lay["shard_base"])
@@ -661,49 +705,128 @@ class DeviceTrafficPlane:
                  jnp.zeros(f, jnp.int64), jnp.zeros(f, jnp.int64),
                  jnp.full(f, -1, jnp.int64), jnp.zeros(h, jnp.int64))
         out = self._flush_step(
-            *state, z, z, np.int64(1), np.int64(0),
+            *state, z, z, self._pad_targets([1]), np.int64(0),
             self.flow_node, self.flow_lat_steps, self.flow_succ,
             self.seg_start, self.refill_step, self.capacity_step,
             self.last_flow, ring_len=self.ring_len)
         np.asarray(out[9])
 
+    def _pad_targets(self, targets: List[int]) -> np.ndarray:
+        """Pad a superwindow's boundary list to the static kernel shape by
+        repeating the final boundary (repeats are never reached: the loop
+        ends at targets[-1])."""
+        pad = self.superwindow_rounds
+        out = np.full(pad, int(targets[-1]), dtype=np.int64)
+        out[:len(targets)] = np.asarray(targets, dtype=np.int64)
+        return out
+
     # -- engine-facing ----------------------------------------------------
+    def negotiate_superwindow(self, nxt: int, lookahead: int, host_next: int,
+                              end_time: int, cap_time: Optional[int],
+                              max_rounds: int) -> Optional[int]:
+        """Replay the K=1 round recurrence forward from the window the
+        engine just computed ([nxt, nxt+lookahead)) and merge up to
+        ``max_rounds`` consecutive rounds into ONE superwindow, stopping
+        before the first round that would contain a host-side event
+        (``host_next``: the earliest Python-queue or native-C-heap event) —
+        or a checkpoint/resume boundary (``cap_time``).  Returns the merged
+        span's end (the engine's new window_end) and stages a _SuperPlan
+        for advance(), or None when no extension applies.
+
+        The plan replicates advance()'s own cadence decisions exactly, so
+        a K-round launch produces the same dispatch bases/targets — and,
+        with the kernel's halt-at-completion rule, the same wake barriers —
+        as K separate rounds: digest parity K=1-vs-K is by construction
+        (tests/test_superwindow.py pins it)."""
+        if (max_rounds <= 1 or self._state is None or self._inflight
+                or self.superwindow_rounds <= 1):
+            return None
+        if (not self._inject_buf
+                and self._cells_delivered_seen >= self._cells_dispatched):
+            # empty plane: not driving windows; nothing to merge
+            return None
+        grid = TICK_NS * self.granule
+        q = self.min_dispatch_steps
+        synced = self._ticks_synced
+        bounds: List[tuple] = []
+        targets: List[int] = []
+        round_of: List[int] = []
+        ws = nxt
+        for i in range(min(max_rounds, self.superwindow_rounds)):
+            we = min(ws + lookahead, end_time)
+            if i > 0 and cap_time is not None \
+                    and (ws >= cap_time or we > cap_time):
+                # a checkpoint/resume boundary at cap_time: the round
+                # containing (or starting at) it must run K=1 so the
+                # snapshot digest lands on an exact visited round boundary
+                break
+            if host_next < we:
+                break               # a host event falls inside this round
+            t_i = we // grid
+            if t_i - synced >= q:   # advance()'s cadence rule, replayed
+                targets.append(int(t_i))
+                round_of.append(i)
+                synced = t_i
+            bounds.append((ws, we))
+            nxt_dev = (synced + q) * grid
+            if nxt_dev >= host_next or nxt_dev >= end_time:
+                break               # next round would be host-driven
+            ws = nxt_dev
+        if len(bounds) < 2 or not targets:
+            return None
+        self._pending_plan = _SuperPlan(int(self._ticks_synced), targets,
+                                        bounds, round_of)
+        return bounds[-1][1]
+
     def advance(self, engine) -> None:
         """LAUNCH: dispatch the window step advancing the plane to the
-        current round's barrier.  Called at the TOP of the round (right
-        after the engine computes the window), so the dispatch computes
-        while the host drains the round's arrivals; consume() collects at
-        the next loop iteration, always before the next window.  Staged
-        injections (activations from earlier rounds) are folded in at the
-        dispatch's base step — the engine has already committed the
-        previous dispatch, so the one-deep in-flight slot is free here."""
+        current round's barrier — or, when a superwindow was negotiated,
+        through the whole merged span in ONE kernel launch.  Called at the
+        TOP of the round (right after the engine computes the window), so
+        the dispatch computes while the host drains the round's arrivals;
+        consume() collects at the next loop iteration, always before the
+        next window.  Staged injections (activations from earlier rounds)
+        are folded in at the dispatch's base step — the engine has already
+        committed the previous dispatch, so the one-deep in-flight slot is
+        free here."""
         import time as _wt
         t0 = _wt.perf_counter_ns()
         assert not self._inflight, \
             "device plane: launch with an uncollected dispatch in flight"
-        target_ticks = engine.scheduler.window_end // (TICK_NS * self.granule)
-        n = target_ticks - self._ticks_synced
-        if n <= 0 and not self._inject_buf:
-            return
-        n = max(n, 0)
-        if self._state is None:
-            if not self._inject_buf and self.total_injected_cells == 0:
-                # nothing has ever activated: don't spin the kernel
-                self._ticks_synced = target_ticks
+        plan, self._pending_plan = self._pending_plan, None
+        if plan is None:
+            target_ticks = engine.scheduler.window_end // (TICK_NS
+                                                           * self.granule)
+            n = target_ticks - self._ticks_synced
+            if n <= 0 and not self._inject_buf:
                 return
-            self._init_state()
-        elif (not self._inject_buf
-              and self._cells_delivered_seen >= self._cells_dispatched):
-            # plane is empty: bank the ticks, skip the dispatch
-            self._idle_ticks_banked += n
-            self._ticks_synced = target_ticks
-            self.idle_rounds_skipped += 1
-            return
-        if n < self.min_dispatch_steps:
-            # cadence batching: let ticks (and injections) accumulate a few
-            # rounds before paying a dispatch; next_time() keeps the engine
-            # window loop coming back even when the Python plane idles
-            return
+            n = max(n, 0)
+            if self._state is None:
+                if not self._inject_buf and self.total_injected_cells == 0:
+                    # nothing has ever activated: don't spin the kernel
+                    self._ticks_synced = target_ticks
+                    return
+                self._init_state()
+            elif (not self._inject_buf
+                  and self._cells_delivered_seen >= self._cells_dispatched):
+                # plane is empty: bank the ticks, skip the dispatch
+                self._idle_ticks_banked += n
+                self._ticks_synced = target_ticks
+                self.idle_rounds_skipped += 1
+                return
+            if n < self.min_dispatch_steps:
+                # cadence batching: let ticks (and injections) accumulate a
+                # few rounds before paying a dispatch; next_time() keeps the
+                # engine window loop coming back even when the Python plane
+                # idles
+                return
+            targets = [int(target_ticks)]
+        else:
+            # superwindow: the plan's targets ARE the K=1 dispatch targets;
+            # ticks_synced advances at consume, from the flush's t_stop
+            # (the kernel may halt at an earlier boundary on a completion)
+            targets = plan.targets
+            n = targets[-1] - self._ticks_synced
         inject_pairs = list(self._inject_buf)
         if self._inject_buf:
             f = self.n_flows
@@ -738,13 +861,15 @@ class DeviceTrafficPlane:
             # failed in-flight slot, so logging there (or after demotion)
             # would only accumulate memory it can never use
             self._dispatch_log.append((int(self._ticks_synced),
-                                       inject_pairs, int(n), int(idle)))
+                                       inject_pairs, list(targets),
+                                       int(idle)))
         state = (np.int64(self._ticks_synced), *self._state[1:])
+        tvec = self._pad_targets(targets)
         if self._shard is not None:
             lay = self._shard
             out = self._sharded_step(
                 *state, inject, inject_target,
-                np.int64(n), np.int64(idle), lay["flow_node_local"],
+                tvec, np.int64(idle), lay["flow_node_local"],
                 lay["succ_global"], lay["seg_start_local"],
                 lay["refill"], lay["capacity"], lay["arr_lat"],
                 lay["shard_base"])
@@ -754,18 +879,23 @@ class DeviceTrafficPlane:
                     step_window_flush_for_backend)
                 self._flush_step = step_window_flush_for_backend()
             out = self._flush_step(*state, inject, inject_target,
-                                   np.int64(n), np.int64(idle),
+                                   tvec, np.int64(idle),
                                    *self._flow_args(),
                                    ring_len=self.ring_len)
         else:
             from ..ops.torcells_device import torcells_step_window_numpy_flush
             out = torcells_step_window_numpy_flush(*state, inject,
-                                                   inject_target, n, idle,
+                                                   inject_target, tvec, idle,
                                                    *self._flow_args(),
                                                    self.ring_len)
         self._state = out[:8]
         self._flush_handle = out[9]
-        self._ticks_synced = target_ticks
+        if plan is None:
+            # single-target dispatch: the kernel cannot halt before its one
+            # boundary, so the reached step is known without the flush
+            self._ticks_synced = targets[-1]
+        else:
+            self._active_plan = plan
         self._inflight = True
         self.dispatches += 1
         if self.mode == "device":
@@ -826,40 +956,62 @@ class DeviceTrafficPlane:
                                   engine.scheduler.window_start)
         if self.mode == "device":
             self.device_calls += 1              # the flush read
-        from ..ops.torcells_device import CELL_WIRE_BYTES, parse_flush
-        (forwards, delivered_sum, done_chains, done_steps, node_idx,
+        from ..ops.torcells_device import parse_flush
+        (forwards, delivered_sum, t_stop, done_chains, done_steps, node_idx,
          node_delta) = parse_flush(flush, self.n_chains, self.n_nodes)
         self.total_forwards += forwards
         self._cells_delivered_seen = delivered_sum
+        plan, self._active_plan = self._active_plan, None
+        if plan is not None:
+            # superwindow collect: the kernel reached t_stop — the plan's
+            # final boundary, or an earlier one when a completion halted
+            # it.  Rewind the engine's bookkeeping to the virtual round
+            # that launched the reached span: the window bounds become that
+            # round's (so completion wakes clamp to ITS barrier, exactly
+            # as K=1 would), and the round counter advances by the merged
+            # rounds actually covered (state digests carry it).
+            try:
+                j = plan.targets.index(t_stop)
+            except ValueError:
+                raise AssertionError(
+                    f"device plane: superwindow stopped at step {t_stop}, "
+                    f"not one of its negotiated boundaries {plan.targets}")
+            r = plan.round_of[j]
+            ws, we = plan.bounds[r]
+            engine.scheduler.set_window(ws, we)
+            engine.rounds_executed += r
+            self._ticks_synced = t_stop
+            self.superwindows += 1
+            self._rounds_launched += r + 1
+        else:
+            self._rounds_launched += 1
 
-        # trackers: per-node spent-byte deltas, delta-compacted on device —
-        # an egress node's spend is the host's tx, an ingress (delivering
-        # hop) node's spend is its rx
-        for i, nbytes in zip(node_idx.tolist(), node_delta.tolist()):
-            tr = self.node_hosts[i].tracker
-            ncells = nbytes // CELL_WIRE_BYTES
-            c = tr.out_remote if self.node_kind[i] == "tx" else tr.in_remote
-            c.packets_total += ncells
-            c.bytes_total += nbytes
-            c.packets_data += ncells
-            c.bytes_data += nbytes
+        # trackers: per-node spent-byte deltas, delta-compacted on device,
+        # folded with ONE numpy scatter-add; the per-host split into
+        # Tracker counters happens on read (Tracker.pull_device) — the
+        # vectorized control-plane cut (ISSUE 7)
+        if len(node_idx):
+            np.add.at(self._node_pending, node_idx, node_delta)
 
         # wake completed clients: BOTH chains (download 2c, upload 2c+1)
         # must have delivered; wake at the later completion step
-        # (deterministic: ticks from the kernel, clamped to the barrier).
+        # (deterministic: ticks from the kernel, clamped to the barrier —
+        # under a superwindow the halt rule guarantees every completion
+        # here belongs to the span whose barrier the window now carries).
         # Only the chains that newly completed THIS dispatch arrive in the
         # flush buffer — O(completions), not O(circuits), per collect.
         if len(done_chains):
             barrier = engine.scheduler.window_end
             self._chain_done[done_chains] = done_steps
-            for circ in sorted({int(ch) >> 1 for ch in done_chains}):
+            circs = np.unique(np.asarray(done_chains) >> 1)
+            d = self._chain_done[2 * circs]
+            u = self._chain_done[2 * circs + 1]
+            ready = (d >= 0) & ((u >= 0) | ~self._has_upload[circs])
+            steps = np.maximum(d, u)
+            for circ, step in zip(circs[ready].tolist(),
+                                  steps[ready].tolist()):
                 if circ in self._done:
                     continue
-                d = int(self._chain_done[2 * circ])
-                u = int(self._chain_done[2 * circ + 1])
-                if d < 0 or (u < 0 and self._has_upload[circ]):
-                    continue
-                step = max(d, u)
                 wake = max((step + 1) * TICK_NS * self.granule, barrier)
                 self._done[circ] = wake
                 self._schedule_wake(engine, circ, wake)
@@ -953,7 +1105,7 @@ class DeviceTrafficPlane:
                  np.full(f, -1, dtype=np.int64), np.zeros(h, dtype=np.int64))
         args = self._flow_args()        # plain numpy now that mode flipped
         flush = None
-        for base, pairs, n, idle in self._dispatch_log:
+        for base, pairs, targets, idle in self._dispatch_log:
             inject = np.zeros(f, dtype=np.int64)
             inject_target = np.zeros(f, dtype=np.int64)
             for circ, cells in pairs:
@@ -961,7 +1113,8 @@ class DeviceTrafficPlane:
                 inject_target[self.last_flow[circ]] += cells
             out = torcells_step_window_numpy_flush(
                 np.int64(base), *state[1:], inject, inject_target,
-                np.int64(n), np.int64(idle), *args, self.ring_len)
+                self._pad_targets(targets), np.int64(idle), *args,
+                self.ring_len)
             state = out[:8]
             flush = out[9]
         self._state = state
@@ -998,6 +1151,33 @@ class DeviceTrafficPlane:
         return ((self._ticks_synced + self.min_dispatch_steps)
                 * self.granule * TICK_NS)
 
+    def pull_tracker_nodes(self, tracker, nodes: List[int]) -> None:
+        """Fold a host's pending device-plane byte deltas (accumulated by
+        consume()'s single scatter-add) into its Tracker counters: an
+        egress node's spend is the host's tx, an ingress (delivering hop)
+        node's spend is its rx.  Called from Tracker.pull_device at
+        observation points (heartbeat, digest, teardown) only — never on
+        the round path."""
+        from ..ops.torcells_device import CELL_WIRE_BYTES
+        for i in nodes:
+            nbytes = int(self._node_pending[i])
+            if not nbytes:
+                continue
+            self._node_pending[i] = 0
+            ncells = nbytes // CELL_WIRE_BYTES
+            c = tracker.out_remote if self.node_kind[i] == "tx" \
+                else tracker.in_remote
+            c.packets_total += ncells
+            c.bytes_total += nbytes
+            c.packets_data += ncells
+            c.bytes_data += nbytes
+
+    def flush_all_trackers(self) -> None:
+        """Teardown sweep: fold every pending node delta so post-run
+        readers (tests, digests, tools) see final tracker totals."""
+        for host in dict.fromkeys(self.node_hosts):
+            host.tracker.pull_device()
+
     def stats(self) -> Dict[str, int]:
         return {
             "circuits": len(self.specs),
@@ -1006,6 +1186,13 @@ class DeviceTrafficPlane:
             "completed": len(self._done),
             "dispatches": self.dispatches,
             "idle_rounds_skipped": self.idle_rounds_skipped,
+            # superwindow introspection (ISSUE 7): merged multi-round
+            # launches, and how many virtual engine rounds each kernel
+            # launch covered on average — the dispatch-amortization number
+            # the tor10k host wall is attacked with
+            "superwindows": self.superwindows,
+            "rounds_per_launch": round(
+                self._rounds_launched / max(self.dispatches, 1), 2),
             "mode": self.mode,
             # dispatch-guard outcomes: >0 recoveries means a dispatch
             # failed, the window history replayed on the numpy twin, and

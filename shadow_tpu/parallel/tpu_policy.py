@@ -235,12 +235,18 @@ class _TPUBatchMixin:
         consume_flush at the top of the next iteration, before the next
         window is computed, so the device works through the barrier
         bookkeeping.  Sharded runs consume immediately (same-round outbox
-        contract)."""
-        self._ensure_kernel(engine)
+        contract).
+
+        Quiet rounds (no offers — every superwindow-merged span, and most
+        rounds of a device-plane run whose traffic lives in HBM) return
+        after the one empty-batch check: the kernel is built lazily by the
+        first real launch (_launch_locked), and consume_flush with nothing
+        pending is the _sync path's own no-op."""
         cols = self._drain_batch()
         if cols is None:
             self.last_batch = 0
-        self._launch(engine, cols)
+        else:
+            self._launch(engine, cols)
         if self._sync:
             return self.consume_flush(engine)
         return 0

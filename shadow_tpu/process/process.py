@@ -185,6 +185,8 @@ class Process:
         span = tracer.span("plugin.continue", "plugin", sim_ns=self.host.now,
                            args={"proc": self.name}) \
             if tracer.enabled else NULL_SPAN
+        import time as _wt
+        t0 = _wt.perf_counter_ns()
         with span:
             progressed = True
             while progressed:
@@ -193,6 +195,12 @@ class Process:
                     if t.state == RUNNABLE:
                         progressed = True
                         self._run_thread(t)
+        # plugin-vs-control-plane host_exec split (ISSUE 7): wall spent
+        # resuming app code, accumulated so the engine can attribute the
+        # remaining round wall to engine overhead rather than app work
+        engine = self.host.engine
+        if engine is not None:
+            engine.add_plugin_exec_ns(_wt.perf_counter_ns() - t0)
         if all(t.state == DONE for t in self.threads) and not self.exited:
             main_done = self.threads[0].state == DONE if self.threads else True
             if main_done:

@@ -15,7 +15,16 @@ output is ONE JSON document on stdout:
 * ``tracks`` — per (shard, thread) event counts, so a sharded run's merge
   is checkable at a glance (one entry per shard track).
 
+``--metrics`` switches the input to a ``--metrics PATH`` JSONL stream
+(obs/metrics.py): the report is the run's FINAL summary scrape (the
+steady-state plane/engine/policy numbers CI gates key on —
+``plane.rounds_per_launch``, ``plane.overlap_efficiency``, the
+``engine.host_exec_*`` split) plus the scrape-record count, so
+``make bench-smoke`` asserts the perf machinery from the same artifact a
+production ``--metrics`` run writes.
+
 Usage: python -m shadow_tpu.tools.trace_report <trace.json> [--pretty]
+       python -m shadow_tpu.tools.trace_report --metrics <metrics.jsonl>
 """
 
 from __future__ import annotations
@@ -125,18 +134,48 @@ def summarize(events: List[dict]) -> Dict:
     }
 
 
+def summarize_metrics(records: List[dict]) -> Dict:
+    """Report over a metrics JSONL stream: the final summary record's
+    scrape (flat metric -> value) + stream shape.  Raises ValueError when
+    the stream has no summary record (a crashed run never writes one — CI
+    must see that as a failure, not an empty report)."""
+    summaries = [r for r in records if r.get("summary")]
+    if not summaries:
+        raise ValueError("no summary record (run did not finish?)")
+    final = summaries[-1]
+    return {
+        "scrape_records": len(records) - len(summaries),
+        "rounds": final.get("round"),
+        "sim_time_ns": final.get("sim_time_ns"),
+        "final": final.get("metrics", {}),
+    }
+
+
 def main(argv: List[str]) -> int:
+    usage = ("usage: python -m shadow_tpu.tools.trace_report "
+             "<trace.json> [--pretty] | --metrics <metrics.jsonl>")
     if not argv:
-        print("usage: python -m shadow_tpu.tools.trace_report "
-              "<trace.json> [--pretty]", file=sys.stderr)
+        print(usage, file=sys.stderr)
         return 2
     pretty = "--pretty" in argv
+    metrics_mode = "--metrics" in argv
     paths = [a for a in argv if not a.startswith("--")]
     if not paths:
-        print("usage: python -m shadow_tpu.tools.trace_report "
-              "<trace.json> [--pretty]", file=sys.stderr)
+        print(usage, file=sys.stderr)
         return 2
     path = paths[0]
+    if metrics_mode:
+        from ..obs.metrics import read_metrics_file
+        try:
+            report = summarize_metrics(read_metrics_file(path))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: cannot read metrics {path!r}: {e}",
+                  file=sys.stderr)
+            return 1
+        json.dump(report, sys.stdout, indent=2 if pretty else None,
+                  sort_keys=True)
+        print()
+        return 0
     try:
         events = load_events(path)
     except (OSError, ValueError, json.JSONDecodeError) as e:
