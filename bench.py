@@ -596,6 +596,62 @@ def bench_full_sims() -> dict:
     return out
 
 
+def _run_scale_scenario(name: str, device_plane: str = "device",
+                        stop: int = 0) -> dict:
+    """One timed scale-tier run: a generated scenario (scale/genscen.py)
+    booted through the HostTable, flows on the device plane, memory read
+    back from the scale metrics source.  Setup/boot inside the measured
+    wall — boot cost is exactly what the table exists to cut."""
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.core.logger import SimLogger, set_logger
+    from shadow_tpu.core.options import Options
+    from shadow_tpu.scale import genscen
+
+    set_logger(SimLogger(level="warning"))
+    cfg = genscen.build(name)
+    if stop:
+        cfg.stop_time_sec = stop
+    opts = Options(scheduler_policy="global", workers=0,
+                   stop_time_sec=int(cfg.stop_time_sec), host_table="on",
+                   heartbeat_interval_sec=0, device_plane=device_plane)
+    t0 = time.perf_counter()
+    ctrl = Controller(opts, cfg)
+    rc = ctrl.run()
+    wall = time.perf_counter() - t0
+    assert rc == 0
+    eng = ctrl.engine
+    scrape = eng.metrics.scrape()
+    st = eng.device_plane.stats() if eng.device_plane is not None else {}
+    return {
+        "hosts": eng.total_host_count(),
+        "sim_sec_per_wall_sec": round(cfg.stop_time_sec / wall, 2),
+        "wall_sec": round(wall, 2),
+        "boot_sec": scrape.get("scale.boot_sec"),
+        "bytes_per_host": scrape.get("scale.bytes_per_host"),
+        "table_bytes_per_host": scrape.get("scale.table_bytes_per_host"),
+        "peak_rss_mb": scrape.get("scale.peak_rss_mb"),
+        "materialized_hosts": scrape.get("scale.materialized_hosts"),
+        "flows_completed": st.get("completed"),
+        "flows": st.get("circuits"),
+        "forwards": st.get("forwards"),
+        "rounds": eng.rounds_executed,
+    }
+
+
+def bench_scale() -> dict:
+    """The scale tier's headline rows (ROADMAP item 2): 100k hosts in one
+    process, >= 1 sim-sec/wall-sec, memory gated like digests.  star100k
+    is the acceptance row; star10k tracks the knee."""
+    out = {}
+    out["scale_star10k"] = _run_scale_scenario("star10k")
+    out["scale_star100k"] = _run_scale_scenario("star100k")
+    row = out["scale_star100k"]
+    out["scale_star100k_pass"] = bool(
+        row["flows_completed"] == row["flows"]
+        and row["sim_sec_per_wall_sec"] >= 1.0)
+    return out
+
+
 def bench_smoke() -> int:
     """``make bench-smoke``: a <60s phold+star pass that gates the perf
     MACHINERY, not absolute rates — superwindows must engage
@@ -628,12 +684,37 @@ def bench_smoke() -> int:
     _run_sim(xml_sw, "tpu", 0, 120, metrics_path=mpath)
     final = summarize_metrics(read_metrics_file(mpath))["final"]
     rpl = final.get("plane.rounds_per_launch", 0)
+    # star2k scale smoke (ROADMAP item 2 / ISSUE 8): a generated 2k-host
+    # table-booted scenario, memory gated on bytes_per_host + peak RSS
+    # read back from the metrics JSONL via trace_report --metrics — the
+    # same path the 100k bench rows use
+    from shadow_tpu.core.controller import run_simulation
+    from shadow_tpu.core.options import Options
+    from shadow_tpu.scale import genscen
+    spath = os.path.join(os.path.dirname(mpath), "scale-metrics.jsonl")
+    cfg2k = genscen.build("star2k")
+    rc_scale = run_simulation(
+        Options(scheduler_policy="global", workers=0,
+                stop_time_sec=int(cfg2k.stop_time_sec), host_table="on",
+                heartbeat_interval_sec=0, device_plane="numpy",
+                metrics_path=spath), cfg2k)
+    sfinal = summarize_metrics(read_metrics_file(spath))["final"]
+    bph = sfinal.get("scale.bytes_per_host")
+    peak = sfinal.get("scale.peak_rss_mb")
     out = {
         "phold_events": r_phold["events"],
         "rounds_per_launch": rpl,
         "superwindows": final.get("plane.superwindows"),
         "overlap_efficiency": final.get("plane.overlap_efficiency"),
         "host_exec_ctrl_sec": final.get("engine.host_exec_ctrl_sec"),
+        "scale_star2k_rc": rc_scale,
+        "scale_bytes_per_host": bph,
+        "scale_table_bytes_per_host": sfinal.get(
+            "scale.table_bytes_per_host"),
+        "scale_peak_rss_mb": peak,
+        "scale_boot_sec": sfinal.get("scale.boot_sec"),
+        "scale_materialized": sfinal.get("scale.materialized_hosts"),
+        "scale_flows_completed": sfinal.get("plane.completed"),
     }
     failures = []
     if r_phold["events"] <= 0:
@@ -645,6 +726,26 @@ def bench_smoke() -> int:
                 "engine.host_exec_ctrl_sec"):
         if key not in final:
             failures.append(f"{key} missing from the metrics JSONL")
+    if rc_scale != 0:
+        failures.append(f"star2k scale run exited {rc_scale}")
+    if out["scale_flows_completed"] != 2000:
+        failures.append(f"star2k completed "
+                        f"{out['scale_flows_completed']}/2000 flows")
+    if out["scale_materialized"] not in (0,):
+        failures.append(f"star2k materialized "
+                        f"{out['scale_materialized']} hosts; quiet flow "
+                        "clients must stay table rows")
+    # bytes-per-host budget (COVERAGE.md round 13): the RSS delta per host
+    # at 2k hosts is dominated by the plane's flow tables and numpy pools,
+    # so the gate is deliberately loose; the table's own columns are the
+    # tight bound
+    if bph is None or bph > 64 * 1024:
+        failures.append(f"bytes_per_host={bph}: over the 64 KiB/host "
+                        "boot-RSS budget")
+    if sfinal.get("scale.table_bytes_per_host", 1 << 30) > 256:
+        failures.append("table columns exceed 256 bytes/host")
+    if peak is None or peak > 4096:
+        failures.append(f"peak_rss_mb={peak}: star2k must fit in 4 GiB")
     print(json.dumps({"bench_smoke": out,
                       "pass": not failures,
                       "failures": failures}), flush=True)
@@ -668,6 +769,7 @@ def main() -> None:
     # measurably slows the engine runs on a small box (observed 82k vs
     # 145k events/s on tor200_serial depending on order)
     sims = bench_full_sims()
+    sims.update(bench_scale())
     topo = build_topology(256)
     cpu_rate = bench_cpu_scalar(topo, 200_000)
     dev_rate = bench_device(topo, batch=1 << 20, iters=8)

@@ -94,14 +94,26 @@ def assemble_state(sim_time_ns: int, rounds: int, host_states: Dict,
     }
 
 
+def collect_host_states(engine) -> Dict:
+    """Per-host digest states for every host this engine owns: live Host
+    objects plus still-quiet table rows (scale/hosttable.py synthesizes
+    the identical dict from columns).  Shared by the serial collector
+    below and the sharded one (parallel/procs.py)."""
+    states = {hid: _host_state(h) for hid, h in engine.hosts.items()
+              if engine.owns_host(h)}
+    table = getattr(engine, "host_table", None)
+    if table is not None:
+        states.update(table.host_states())
+    return states
+
+
 def collect_state(engine) -> Dict:
     """The digestible snapshot of everything the simulation has computed."""
     return assemble_state(
         engine.scheduler.window_start,
         engine.rounds_executed,
-        {hid: _host_state(h) for hid, h in engine.hosts.items()
-         if engine.owns_host(h)},
-        engine.scheduler.policy.pending_count()
+        collect_host_states(engine),
+        engine.scheduler.pending_count()
         if hasattr(engine.scheduler.policy, "pending_count") else None,
     )
 

@@ -34,6 +34,54 @@ class ProcessConfig:
 
 
 @dataclasses.dataclass
+class FlowConfig:
+    """A processless device-plane bulk flow (scale tier, shadow_tpu/scale/):
+    the host transfers ``down_bytes``/``up_bytes`` with ``dest`` entirely on
+    the device-resident traffic plane — no plugin ever executes on the host,
+    so a quantity-expanded group of flow hosts stays struct-of-arrays table
+    rows for the whole run (scale/hosttable.py).
+
+    ``path`` is an optional comma-separated relay list in client order
+    (guard,middle,exit) for tor-shaped 5-hop chains; absent = the 2-hop
+    star shape (dest<->host).  ``tor_path_seed`` instead derives a distinct
+    3-relay path per quantity-expanded host from a seeded draw over
+    ``tor_relays`` hosts named ``<tor_relay_prefix>1..N`` (and a dest drawn
+    over ``tor_servers`` hosts named ``<tor_server_prefix>1..N``), so a
+    100k-client Tor shape needs ONE FlowConfig, not 100k.  ``stagger``:
+    host q's start is start_time_sec + (q %% stagger_waves) * stagger_step_sec."""
+    dest: str = ""
+    start_time_sec: float = 1.0
+    down_bytes: int = 65536
+    up_bytes: int = 0
+    path: Optional[str] = None
+    stagger_waves: int = 1
+    stagger_step_sec: float = 0.0
+    tor_path_seed: Optional[int] = None
+    tor_relays: int = 0
+    tor_relay_prefix: str = "relay"
+    tor_servers: int = 0
+    tor_server_prefix: str = "dest"
+
+
+def tokenize_arguments(arguments: str) -> List[str]:
+    """Shell-style tokenization of a <process arguments=...> string: a
+    superset of the reference's bare strtok-on-spaces (process.c:769) that
+    also supports quoted arguments.  Unbalanced quotes fall back to plain
+    split.  ONE definition shared by the eager process constructor
+    (core/controller.py) and the host table's deferred process specs
+    (scale/hosttable.py) so both paths parse identically."""
+    if not arguments:
+        return []
+    if '"' in arguments or "'" in arguments or "\\" in arguments:
+        import shlex
+        try:
+            return shlex.split(arguments)
+        except ValueError:
+            return arguments.split()
+    return arguments.split()
+
+
+@dataclasses.dataclass
 class HostConfig:
     id: str = "host"
     quantity: int = 1
@@ -56,6 +104,7 @@ class HostConfig:
     heartbeat_log_level: Optional[str] = None
     heartbeat_log_info: str = "node"
     processes: List[ProcessConfig] = dataclasses.field(default_factory=list)
+    flows: List[FlowConfig] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -154,6 +203,21 @@ def parse_xml(text: str) -> Configuration:
                         stop_time_sec=_parse_time_sec(pel.get("stoptime")),
                         arguments=pel.get("arguments", ""),
                         preload=pel.get("preload")))
+                elif pel.tag == "flow":
+                    h.flows.append(FlowConfig(
+                        dest=pel.get("dest", ""),
+                        start_time_sec=_parse_time_sec(pel.get("starttime"), 1.0),
+                        down_bytes=_to_int(pel.get("down"), 65536),
+                        up_bytes=_to_int(pel.get("up")),
+                        path=pel.get("path"),
+                        stagger_waves=_to_int(pel.get("staggerwaves"), 1),
+                        stagger_step_sec=_to_float(pel.get("staggerstep")),
+                        tor_path_seed=(_to_int(pel.get("torpathseed"))
+                                       if pel.get("torpathseed") else None),
+                        tor_relays=_to_int(pel.get("torrelays")),
+                        tor_relay_prefix=pel.get("torrelayprefix", "relay"),
+                        tor_servers=_to_int(pel.get("torservers")),
+                        tor_server_prefix=pel.get("torserverprefix", "dest")))
             cfg.hosts.append(h)
     return cfg
 
@@ -209,6 +273,22 @@ def parse_dict(d: dict) -> Configuration:
                 arguments=p.get("args", p.get("arguments", "")) if not isinstance(
                     p.get("args"), list) else " ".join(str(a) for a in p["args"]),
             ))
+        for fl in h.get("flows", []):
+            hc.flows.append(FlowConfig(
+                dest=fl.get("dest", ""),
+                start_time_sec=_parse_time_sec(fl.get("start_time"), 1.0),
+                down_bytes=_to_int(fl.get("down_bytes"), 65536),
+                up_bytes=_to_int(fl.get("up_bytes")),
+                path=fl.get("path"),
+                stagger_waves=_to_int(fl.get("stagger_waves"), 1),
+                stagger_step_sec=_to_float(fl.get("stagger_step_sec")),
+                tor_path_seed=(_to_int(fl.get("tor_path_seed"))
+                               if fl.get("tor_path_seed") is not None
+                               else None),
+                tor_relays=_to_int(fl.get("tor_relays")),
+                tor_relay_prefix=fl.get("tor_relay_prefix", "relay"),
+                tor_servers=_to_int(fl.get("tor_servers")),
+                tor_server_prefix=fl.get("tor_server_prefix", "dest")))
         cfg.hosts.append(hc)
     return cfg
 

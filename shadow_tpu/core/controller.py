@@ -44,6 +44,51 @@ class Controller:
             return Topology.from_file(path)
         return single_vertex_topology()
 
+    def _host_params_kwargs(self, hc) -> dict:
+        """The HostParams keyword set shared by a whole config entry —
+        everything but the per-host name and the topology-resolved
+        bandwidths.  ONE construction point for the eager path and the
+        HostTable's deferred materialization, so the two can never drift
+        (the table-vs-object digest parity gates lean on it)."""
+        opts = self.options
+        return dict(
+            qdisc=hc.qdisc or opts.interface_qdisc,
+            router_queue=opts.router_queue,
+            # 0 means "default start size + autotune", never a
+            # zero-byte buffer (a 0 advertised window would
+            # deadlock every transfer at handshake)
+            recv_buf_size=(hc.socket_recv_buffer
+                           or opts.socket_recv_buffer or 174760),
+            send_buf_size=(hc.socket_send_buffer
+                           or opts.socket_send_buffer or 131072),
+            autotune_recv=opts.socket_autotune and not hc.socket_recv_buffer,
+            autotune_send=opts.socket_autotune and not hc.socket_send_buffer,
+            cpu_frequency_khz=hc.cpu_frequency_khz,
+            cpu_threshold_ns=opts.cpu_threshold_ns,
+            cpu_precision_ns=opts.cpu_precision_ns,
+            interface_buffer=hc.interface_buffer or opts.interface_buffer,
+            heartbeat_interval_sec=(hc.heartbeat_interval_sec
+                                    or opts.heartbeat_interval_sec),
+            log_pcap=hc.log_pcap,
+            pcap_dir=hc.pcap_dir or opts.pcap_dir,
+            ip_hint=hc.ip_hint, city_hint=hc.city_hint,
+            country_hint=hc.country_hint, geocode_hint=hc.geocode_hint,
+            type_hint=hc.type_hint,
+            log_level=hc.log_level,
+            heartbeat_log_level=hc.heartbeat_log_level)
+
+    def _table_mode(self) -> bool:
+        """Whether hosts boot as HostTable rows (scale/hosttable.py):
+        --host-table on/off, or auto = on exactly when the config carries
+        processless device flows (generated scale scenarios) — existing
+        workloads keep the eager path and its native-plane eligibility."""
+        mode = getattr(self.options, "host_table", "auto")
+        if mode == "on":
+            return True
+        if mode == "off":
+            return False
+        return any(hc.flows for hc in self.config.hosts)
+
     def setup(self) -> None:
         """Register programs and hosts (master.c:279-392)."""
         opts = self.options
@@ -59,42 +104,15 @@ class Controller:
         for prog in self.config.programs:
             self._program_paths[prog.id] = prog.path
 
-        for hc in self.config.hosts:
-            for q in range(hc.quantity):
-                name = hc.id if hc.quantity == 1 else f"{hc.id}{q + 1}"
-                params = HostParams(
-                    name=name,
-                    bw_down_kibps=hc.bandwidth_down_kibps,
-                    bw_up_kibps=hc.bandwidth_up_kibps,
-                    qdisc=hc.qdisc or opts.interface_qdisc,
-                    router_queue=opts.router_queue,
-                    # 0 means "default start size + autotune", never a
-                    # zero-byte buffer (a 0 advertised window would
-                    # deadlock every transfer at handshake)
-                    recv_buf_size=(hc.socket_recv_buffer
-                                   or opts.socket_recv_buffer or 174760),
-                    send_buf_size=(hc.socket_send_buffer
-                                   or opts.socket_send_buffer or 131072),
-                    autotune_recv=opts.socket_autotune and not hc.socket_recv_buffer,
-                    autotune_send=opts.socket_autotune and not hc.socket_send_buffer,
-                    cpu_frequency_khz=hc.cpu_frequency_khz,
-                    cpu_threshold_ns=opts.cpu_threshold_ns,
-                    cpu_precision_ns=opts.cpu_precision_ns,
-                    interface_buffer=hc.interface_buffer or opts.interface_buffer,
-                    heartbeat_interval_sec=(hc.heartbeat_interval_sec
-                                            or opts.heartbeat_interval_sec),
-                    log_pcap=hc.log_pcap,
-                    pcap_dir=hc.pcap_dir or opts.pcap_dir,
-                    ip_hint=hc.ip_hint, city_hint=hc.city_hint,
-                    country_hint=hc.country_hint, geocode_hint=hc.geocode_hint,
-                    type_hint=hc.type_hint,
-                    log_level=hc.log_level,
-                    heartbeat_log_level=hc.heartbeat_log_level)
-                host = Host(self.engine.next_host_id(), params, self.engine.root_key)
-                requested_ip = ip_to_int(hc.ip_hint) if hc.ip_hint else None
-                self.engine.add_host(host, requested_ip)
-                for pc in hc.processes:
-                    self._add_process(host, pc)
+        from ..scale.memprof import BootProfile
+        profile = BootProfile()
+        profile.snapshot()
+        if self._table_mode():
+            self._setup_table_hosts()
+        else:
+            self._setup_eager_hosts()
+        profile.commit(self.engine.total_host_count())
+        profile.install(self.engine)
         self.topology.finalize()
         # the C data plane (parallel/native_plane.py): TCP/UDP pipeline +
         # interfaces + router + hop execute natively for eligible serial
@@ -103,25 +121,50 @@ class Controller:
         from ..parallel.native_plane import attach as attach_native
         attach_native(self.engine)
 
+    def _setup_eager_hosts(self) -> None:
+        """The classic boot path: one Host object per quantity expansion."""
+        for hc in self.config.hosts:
+            if hc.flows:
+                raise ValueError(
+                    f"host {hc.id!r} has device flows; flows need the host "
+                    "table (--host-table=on or auto)")
+            kw = self._host_params_kwargs(hc)
+            for q in range(hc.quantity):
+                name = hc.id if hc.quantity == 1 else f"{hc.id}{q + 1}"
+                params = HostParams(
+                    name=name,
+                    bw_down_kibps=hc.bandwidth_down_kibps,
+                    bw_up_kibps=hc.bandwidth_up_kibps, **kw)
+                host = Host(self.engine.next_host_id(), params,
+                            self.engine.root_key)
+                requested_ip = ip_to_int(hc.ip_hint) if hc.ip_hint else None
+                self.engine.add_host(host, requested_ip)
+                for pc in hc.processes:
+                    self._add_process(host, pc)
+
+    def _setup_table_hosts(self) -> None:
+        """Scale boot path: every host becomes a HostTable row; Host
+        objects materialize lazily (scale/hosttable.py)."""
+        from ..scale.hosttable import HostTable
+        total = sum(hc.quantity for hc in self.config.hosts)
+        table = HostTable(self.engine, total)
+        self.engine.host_table = table
+        from .configuration import tokenize_arguments
+        for hc in self.config.hosts:
+            table.reserve_group(hc, self._host_params_kwargs(hc),
+                                self._add_process)
+            grp = table.groups[-1]
+            for pc in hc.processes:
+                path = self._program_paths.get(pc.plugin, pc.plugin)
+                table.add_group_process_spec(
+                    grp, pc, path, tokenize_arguments(pc.arguments))
+        table.freeze()
+
     def _add_process(self, host: Host, pc) -> None:
         path = self._program_paths.get(pc.plugin, pc.plugin)
         app_main = app_registry.resolve(path)
-        # shell-style tokenization: a superset of the reference's bare
-        # strtok-on-spaces (process.c:769) that also supports quoted
-        # arguments, e.g. arguments='-c "import x; run(x)"' for an
-        # interpreter plugin.  Unbalanced quotes fall back to plain split.
-        if pc.arguments:
-            if '"' in pc.arguments or "'" in pc.arguments \
-                    or "\\" in pc.arguments:
-                import shlex
-                try:
-                    args = shlex.split(pc.arguments)
-                except ValueError:
-                    args = pc.arguments.split()
-            else:
-                args = pc.arguments.split()
-        else:
-            args = []
+        from .configuration import tokenize_arguments
+        args = tokenize_arguments(pc.arguments)
         stop_ns = stime.from_seconds(pc.stop_time_sec) if pc.stop_time_sec else 0
         proc = Process(host, f"{host.name}.{pc.plugin}", app_main, args,
                        start_time_ns=stime.from_seconds(pc.start_time_sec),

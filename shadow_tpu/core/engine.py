@@ -117,6 +117,12 @@ class Engine:
         # C data plane (parallel/native_plane.py); set by attach() when the
         # run is eligible — protocol/interface/hop events then execute in C
         self.native_plane = None
+        # struct-of-arrays host plane (scale/hosttable.py); set by the
+        # Controller when hosts boot as table rows — quiet hosts then cost
+        # array columns, and Host objects materialize lazily on their
+        # first boot event or incoming lookup
+        self.host_table = None
+        self._boot_done = False
         # supervision ledger: watchdog fires, degradations, resume state
         # (core/supervision.py) — every fault seam reports here
         from .supervision import SupervisionStats
@@ -197,12 +203,42 @@ class Engine:
         if self.owns_host(host):
             self.counters.count_new("host")
 
+    def adopt_host(self, host, addr, owned: bool = True) -> None:
+        """Register a host whose DNS entry and topology attachment already
+        happened at table-reserve time (scale/hosttable.py materialize):
+        the add_host tail without re-registering or re-attaching.  The
+        caller provides params with RESOLVED bandwidths, so no bucket
+        rebuild is needed either."""
+        if not owned:
+            host.params.log_pcap = False    # replica: owner holds the pcap
+        host.setup(self, addr)
+        self.hosts[host.id] = host
+        self.hosts_by_ip[addr.ip] = host
+        self.hosts_by_name[host.name] = host
+        self.scheduler.add_host(host)
+        if owned:
+            with self._counters_lock:
+                self.counters.count_new("host")
+
     def next_host_id(self) -> int:
         self._host_id_counter += 1
         return self._host_id_counter
 
+    def total_host_count(self) -> int:
+        """Materialized hosts + still-quiet table rows."""
+        n = len(self.hosts)
+        if self.host_table is not None:
+            n += self.host_table.unmaterialized_count()
+        return n
+
     def host_by_ip(self, ip: int):
-        return self.hosts_by_ip.get(ip)
+        h = self.hosts_by_ip.get(ip)
+        if h is None and self.host_table is not None:
+            # a packet (or policy delivery) reached a quiet table row:
+            # materialize it so routers/RST paths behave exactly as the
+            # eager host would
+            h = self.host_table.materialize_by_ip(ip)
+        return h
 
     def shard_of(self, host) -> int:
         """The single definition of the host partition (round-robin by id);
@@ -220,7 +256,45 @@ class Engine:
         return out
 
     def host_by_name(self, name: str):
-        return self.hosts_by_name.get(name)
+        h = self.hosts_by_name.get(name)
+        if h is None and self.host_table is not None:
+            h = self.host_table.materialize_by_name(name)
+        return h
+
+    def host_by_id(self, hid: int):
+        h = self.hosts.get(hid)
+        if h is None and self.host_table is not None:
+            h = self.host_table.materialize_by_id(hid)
+        return h
+
+    def iter_process_specs(self):
+        """(host_id, host_name, app_path, args) over every configured
+        process — live Host objects and deferred table rows alike, in
+        host-id order.  The device plane's spec scan uses this so table-on
+        and table-off builds see identical workloads."""
+        specs = []
+        for hid in sorted(self.hosts):
+            host = self.hosts[hid]
+            for proc in host.processes:
+                specs.append((hid, host.name,
+                              str(getattr(proc, "app_path", "")), proc.args))
+        if self.host_table is not None:
+            specs.extend(self.host_table.iter_process_specs())
+        specs.sort(key=lambda s: s[0])
+        return specs
+
+    def host_stream_key(self, name: str) -> Optional[int]:
+        """The per-host deterministic RNG stream key (what Host.random is
+        seeded with), WITHOUT materializing a table row — derivation is
+        arithmetic on (root_key, host id)."""
+        h = self.hosts_by_name.get(name)
+        if h is not None:
+            return h.random.key
+        if self.host_table is not None:
+            row = self.host_table.row_of_name(name)
+            if row is not None:
+                return int(self.host_table.rng_keys[row])
+        return None
 
     # -- deterministic draws ----------------------------------------------
     def packet_drop_uniform(self, packet_uid: int) -> float:
@@ -388,6 +462,9 @@ class Engine:
         finally:
             set_current_worker(None)
         self.merge_counters(boot_worker.counters)
+        # table rows boot lazily from here on: a row materialized after
+        # this point replays this exact sequence for itself
+        self._boot_done = True
 
     # -- round loop --------------------------------------------------------
     def run(self) -> int:
@@ -412,7 +489,7 @@ class Engine:
             gc.disable()
         lookahead = self.lookahead_ns
         log.message("engine",
-                    f"starting simulation: {len(self.hosts)} hosts, "
+                    f"starting simulation: {self.total_host_count()} hosts, "
                     f"policy={self.scheduler.policy_name}, "
                     f"workers={self.options.workers}, "
                     f"lookahead={lookahead / 1e6:.3f} ms, "
@@ -454,6 +531,9 @@ class Engine:
                 if iface.pcap is not None:
                     iface.pcap.close()
             self.counters.count_free("host")
+        if self.host_table is not None:
+            # never-materialized rows: balance the host ledger in bulk
+            self.host_table.close_counters()
         log.flush()
         leaks = self.counters.leaks()
         if self.device_plane is not None:
@@ -586,6 +666,11 @@ class Engine:
         if self.native_plane is not None:
             # the C plane clamps its cross-host pushes to the same barrier
             self.native_plane.set_window(self.scheduler.window_end)
+        if self.host_table is not None:
+            # promotion sweep: table rows whose first boot event falls in
+            # this window materialize NOW (main thread, workers parked) and
+            # replay their boot — event times identical to an eager boot
+            self.host_table.promote_due(self.scheduler.window_end)
         return True
 
     def _heartbeat(self) -> None:

@@ -77,6 +77,13 @@ class Options:
     dataplane: str = "auto"              # auto | native | python: C data
                                          # plane for eligible serial runs
                                          # (parallel/native_plane.py)
+    host_table: str = "auto"             # auto | on | off: struct-of-
+                                         # arrays host plane with lazy
+                                         # Host materialization
+                                         # (scale/hosttable.py); auto = on
+                                         # exactly when the config carries
+                                         # processless device flows
+                                         # (generated scale scenarios)
     device_plane_granule_ms: int = 0     # step size override (0 = auto)
     device_plane_batch_steps: int = 8    # min steps per kernel dispatch
     superwindow_rounds: int = 8          # max lookahead rounds merged into
@@ -211,6 +218,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "engage when the run is serial/global-policy "
                         "without pcap/CPU-model/debug; native: require it; "
                         "python: pure-Python plane)")
+    p.add_argument("--host-table", choices=("auto", "on", "off"),
+                   default="auto", dest="host_table",
+                   help="boot hosts as struct-of-arrays table rows with "
+                        "lazy Host materialization (scale tier; digest-"
+                        "identical to eager boot).  auto: on exactly when "
+                        "the config has processless device flows")
     p.add_argument("--device-plane", choices=("device", "numpy"),
                    default="device", dest="device_plane",
                    help="execution mode for device-registered bulk flows: "

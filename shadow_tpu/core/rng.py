@@ -191,6 +191,30 @@ def bits64_np(key: int, counter) -> np.ndarray:
     return x0.astype(np.uint64) | (x1.astype(np.uint64) << np.uint64(32))
 
 
+def bits64_keys_np(keys, counter) -> np.ndarray:
+    """64 random bits per KEY: the vector-key dual of :func:`bits64_np`
+    (one key, many counters).  Used by the scale tier to evaluate the
+    first draw of many per-host streams in one threefry call instead of a
+    Python loop over 100k scalar ciphers (scale/hosttable.py)."""
+    keys = np.asarray(keys, dtype=np.uint64)
+    k0 = (keys & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    k1 = (keys >> np.uint64(32)).astype(np.uint32)
+    c0, c1 = _split64(np.uint64(int(counter) & 0xFFFFFFFFFFFFFFFF))
+    x0, x1 = threefry2x32_np(k0, k1, c0, c1)
+    return x0.astype(np.uint64) | (x1.astype(np.uint64) << np.uint64(32))
+
+
+def derive_np(key: int, label: Any, ids) -> np.ndarray:
+    """Vectorized :func:`derive` over the FINAL path element: the child
+    keys ``derive(key, label, i) for i in ids`` as one uint64 array.
+    Bitwise identical to the scalar chain (tests/test_scale.py pins it) —
+    the scalar derive folds each label with ``k = bits64(k, label)``, so
+    only the last fold varies per id and the whole family is one
+    vectorized cipher evaluation."""
+    k1 = derive(key, label)
+    return bits64_np(k1, np.asarray(ids, dtype=np.uint64))
+
+
 def derive(key: int, *path: Any) -> int:
     """Derive a child 64-bit key from a parent key and a path of labels.
 

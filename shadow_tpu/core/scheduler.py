@@ -595,6 +595,33 @@ def make_policy(name: str, n_workers: int = 0) -> SchedulerPolicy:
     raise ValueError(f"unknown scheduler policy {name!r}")
 
 
+def shuffle_permutation(n: int, seed_key: int):
+    """The seeded Fisher-Yates permutation of range(n) as an index array.
+    The swap draws are the sequential stream ``next_int(i+1)`` for
+    i = n-1..1 — evaluated as ONE vectorized threefry call (counter c for
+    the c-th draw), bitwise identical to RandomSource's scalar chain."""
+    import numpy as np
+    from .rng import bits64_np, derive
+    idx = np.arange(n, dtype=np.int64)
+    if n < 2:
+        return idx
+    key = derive(seed_key, "host-shuffle")
+    counters = np.arange(n - 1, dtype=np.uint64)
+    bounds = np.arange(n, 1, -1, dtype=np.uint64)     # i+1 for i=n-1..1
+    draws = bits64_np(key, counters) % bounds
+    for k, i in enumerate(range(n - 1, 0, -1)):
+        j = int(draws[k])
+        idx[i], idx[j] = idx[j], idx[i]
+    return idx
+
+
+def shuffle_hosts(hosts: List, seed_key: int) -> List:
+    """Deal order for finalize_hosts: ``hosts`` permuted by the seeded
+    Fisher-Yates index array."""
+    perm = shuffle_permutation(len(hosts), seed_key)
+    return [hosts[int(i)] for i in perm]
+
+
 class Scheduler:
     """Drives rounds over worker threads (serial when n_workers == 0)."""
 
@@ -619,6 +646,7 @@ class Scheduler:
         self._host_count = 0
         self._pending_hosts: List = []
         self._hosts_finalized = False
+        self._late_add_lock = threading.Lock()
         self._running = True
         self._threads: List[threading.Thread] = []
         self._workers: List = []
@@ -628,10 +656,18 @@ class Scheduler:
     # -- host assignment (scheduler.c:437-531 random shuffle) --------------
     def add_host(self, host) -> None:
         """Hosts registered before finalize_hosts() are collected and dealt
-        to workers in seeded-shuffle order at boot; a host added after boot
-        (none today) falls back to plain round-robin."""
+        to workers in seeded-shuffle order at boot.  A host added after
+        boot — a HostTable row materializing on first need — is dealt
+        round-robin from the cursor, serialized by a lock because a
+        mid-round promote-on-lookup runs on whichever worker thread's
+        packet reached the quiet row first.  Late-assignment order is
+        therefore arrival order, exactly like a work-stealing migration:
+        it moves load balance only, never results (state digests are
+        assignment-independent — the cross-policy parity gates and the
+        threaded table-parity test pin that)."""
         if self._hosts_finalized:
-            self._assign(host)
+            with self._late_add_lock:
+                self._assign(host)
             return
         self._pending_hosts.append(host)
 
@@ -648,17 +684,19 @@ class Scheduler:
         so no adversarial config ordering can pile heavy hosts onto one
         worker.  Deterministic: same seed, same assignment — and the final
         state digest is assignment-independent anyway (the cross-policy
-        parity gates pin that), so the shuffle affects load balance only."""
+        parity gates pin that), so the shuffle affects load balance only.
+
+        The shuffle operates on a host-ID ARRAY with all swap indices
+        drawn in one vectorized threefry call — bitwise identical to the
+        sequential next_int chain it replaces (tests/test_scale.py pins
+        the permutation AND the per-seed digest), but a 100k-host boot no
+        longer permutes a Python list of Host objects through 100k scalar
+        cipher evaluations."""
         if self._hosts_finalized:
             return
         self._hosts_finalized = True
         hosts, self._pending_hosts = self._pending_hosts, []
-        from .rng import RandomSource, derive
-        rng = RandomSource(derive(self.seed_key, "host-shuffle"))
-        for i in range(len(hosts) - 1, 0, -1):
-            j = rng.next_int(i + 1)
-            hosts[i], hosts[j] = hosts[j], hosts[i]
-        for host in hosts:
+        for host in shuffle_hosts(hosts, self.seed_key):
             self._assign(host)
 
     # -- push/pop (worker-facing) -----------------------------------------
@@ -674,7 +712,28 @@ class Scheduler:
         self.policy.done(event, worker.id)
 
     def next_event_time(self) -> int:
-        return self.policy.next_time()
+        """Min pending host-side event time: the policy's queues, the
+        native C heap (folded inside the merged policy), and — under the
+        scale tier — the host table's earliest boot wake, so windows land
+        on the same boundaries whether a host is an object or a row."""
+        t = self.policy.next_time()
+        table = getattr(self.engine, "host_table", None)
+        if table is not None:
+            wake = table.next_wake()
+            if wake < t:
+                t = wake
+        return t
+
+    def pending_count(self) -> int:
+        """Queued events + the host table's deferred boot events (events
+        an eager boot would already hold in queues for still-quiet rows)
+        — the digest's pending_events field must not depend on which
+        boot path ran."""
+        n = self.policy.pending_count()
+        table = getattr(self.engine, "host_table", None)
+        if table is not None:
+            n += table.pending_boot_events()
+        return n
 
     def set_window(self, start: int, end: int) -> None:
         """Rebind the current round window.  Used by the device plane's

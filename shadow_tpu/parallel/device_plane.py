@@ -126,7 +126,7 @@ class _FlowSpec:
     the config-predicted consensus (resolve_auto_routes)."""
 
     __slots__ = ("client_name", "route_down", "route_up", "cells_down",
-                 "cells_up", "circuit", "dirspec", "dest")
+                 "cells_up", "circuit", "dirspec", "dest", "auto_start_ns")
 
     def __init__(self, client_name: str, route_down: Optional[List[str]],
                  route_up: Optional[List[str]], cells_down: int,
@@ -140,6 +140,10 @@ class _FlowSpec:
         self.circuit = -1
         self.dirspec = dirspec
         self.dest = dest
+        # processless flow (scale tier): the plane self-activates it at
+        # this sim time and completion needs no wake event — no plugin
+        # ever joins, so the quiet client host stays a table row
+        self.auto_start_ns: Optional[int] = None
 
 
 def _cells_for(nstreams: int, specs: List[str]):
@@ -210,27 +214,30 @@ def resolve_auto_routes(engine, specs: List[_FlowSpec]) -> None:
     if not autos:
         return
     from ..apps.tor import pick_weighted
+    from ..core.rng import RandomSource, derive
     relays = {}
-    for hid in sorted(engine.hosts):
-        host = engine.hosts[hid]
-        for proc in host.processes:
-            if not str(getattr(proc, "app_path", "")).endswith("tor"):
-                continue
-            a = proc.args
-            # relay <orport> <dirauth_host:port> <bw>: publishes into the
-            # consensus (apps/tor.py relay role)
-            if a and a[0] == "relay" and len(a) > 2 and a[2]:
-                orport = int(a[1]) if len(a) > 1 else 9001
-                bw = int(a[3]) if len(a) > 3 else 100
-                relays[host.name] = (orport, bw)
+    for _hid, host_name, app, a in engine.iter_process_specs():
+        if not app.endswith("tor"):
+            continue
+        # relay <orport> <dirauth_host:port> <bw>: publishes into the
+        # consensus (apps/tor.py relay role)
+        if a and a[0] == "relay" and len(a) > 2 and a[2]:
+            orport = int(a[1]) if len(a) > 1 else 9001
+            bw = int(a[3]) if len(a) > 3 else 100
+            relays[host_name] = (orport, bw)
     consensus = [(n, p, w) for n, (p, w) in sorted(relays.items())]
     if not consensus:
         raise ValueError(
             "device plane: auto: clients configured but no publishing "
             "relays found (no dirauth-registered relay processes)")
     for s in autos:
-        host = engine.host_by_name(s.client_name)
-        rng = host.random.spawn("device-circuit")
+        # the client's derived path stream, computed arithmetically so a
+        # table-resident client needs no Host object to predict its route
+        key = engine.host_stream_key(s.client_name)
+        if key is None:
+            raise ValueError(f"device plane: unknown host "
+                             f"{s.client_name!r}")
+        rng = RandomSource(derive(key, "device-circuit"))
         path = [name for name, _port in pick_weighted(rng, consensus)]
         if len(path) != 3:
             raise ValueError(
@@ -306,6 +313,13 @@ class DeviceTrafficPlane:
             if n_dev > 1:
                 self._setup_sharding(n_dev)
         self._state = None           # lazy: built at first activation
+        # processless flows (scale tier): (start_ns, circuit) ascending;
+        # the plane self-activates each at its start time — next_time()
+        # keeps the engine's windows coming until the last one is staged
+        self._auto = sorted(
+            (s.auto_start_ns, i) for i, s in enumerate(specs)
+            if s.auto_start_ns is not None)
+        self._auto_pos = 0
         self._inflight = False
         self._flush_handle = None    # in-flight packed flush (1-deep slot)
         self._flush_step = None      # backend-selected flush kernel (lazy)
@@ -410,14 +424,26 @@ class DeviceTrafficPlane:
                                     dtype=bool)
         rows = np.empty(len(names), dtype=np.int64)
         rates = np.empty(len(names), dtype=np.int64)
+        table = getattr(engine, "host_table", None)
         for i, (nm, kind) in enumerate(names):
-            host = engine.host_by_name(nm)
-            if host is None:
+            # deliberately NOT engine.host_by_name: that would materialize
+            # every table row the flow table references — the whole point
+            # is that quiet hosts contribute array rows, so read the
+            # table's columns instead
+            host = engine.hosts_by_name.get(nm)
+            if host is not None:
+                self.node_hosts.append(host)
+                rows[i] = host.topo_row
+                rates[i] = (host.params.bw_up_kibps if kind == "tx"
+                            else host.params.bw_down_kibps)
+                continue
+            info = table.plane_host_info(nm) if table is not None else None
+            if info is None:
                 raise ValueError(f"device plane: unknown host {nm!r}")
-            self.node_hosts.append(host)
-            rows[i] = host.topo_row
-            rates[i] = (host.params.bw_up_kibps if kind == "tx"
-                        else host.params.bw_down_kibps)
+            self.node_hosts.append(None)
+            topo_row, bw_up, bw_down = info
+            rows[i] = topo_row
+            rates[i] = bw_up if kind == "tx" else bw_down
         from ..ops.bandwidth import bucket_params
         refill, capacity = bucket_params(rates)
         self.refill = refill.astype(np.int64)
@@ -505,11 +531,18 @@ class DeviceTrafficPlane:
         # 10k quiet hosts pay one np.add.at per collect instead of a
         # Python loop over every touched node.
         self._node_pending = np.zeros(self.n_nodes, dtype=np.int64)
-        host_nodes: Dict[int, List[int]] = {}
-        for i, host in enumerate(self.node_hosts):
-            host_nodes.setdefault(id(host), []).append(i)
-        for host in dict.fromkeys(self.node_hosts):
-            host.tracker._device_feed = (self, host_nodes[id(host)])
+        self._table = table
+        name_nodes: Dict[str, List[int]] = {}
+        for i, (nm, _kind) in enumerate(names):
+            name_nodes.setdefault(nm, []).append(i)
+        for nm, nodes in name_nodes.items():
+            host = engine.hosts_by_name.get(nm)
+            if host is not None:
+                host.tracker._device_feed = (self, nodes)
+            else:
+                # table row: the table folds these nodes' deltas into its
+                # tracker columns, and wires the feed at materialization
+                table.set_device_nodes(nm, nodes, self)
 
     # -- state ------------------------------------------------------------
     def _init_state(self):
@@ -793,6 +826,16 @@ class DeviceTrafficPlane:
         t0 = _wt.perf_counter_ns()
         assert not self._inflight, \
             "device plane: launch with an uncollected dispatch in flight"
+        if self._auto_pos < len(self._auto):
+            ws = engine.scheduler.window_start
+            if self._state is None and not self._inject_buf \
+                    and self.total_injected_cells == 0:
+                # nothing has ever dispatched: re-base the step counter to
+                # the window so the first dispatch does not grind through
+                # the pre-traffic idle gap tick by tick
+                self._ticks_synced = max(self._ticks_synced,
+                                         ws // (TICK_NS * self.granule))
+            self._stage_autos(ws)
         plan, self._pending_plan = self._pending_plan, None
         if plan is None:
             target_ticks = engine.scheduler.window_end // (TICK_NS
@@ -1125,6 +1168,10 @@ class DeviceTrafficPlane:
     def _schedule_wake(self, engine, circuit: int, when: int) -> None:
         if when >= engine.end_time:
             return
+        if self.specs[circuit].auto_start_ns is not None:
+            # processless flow: no client will ever join — a wake event
+            # would only materialize a quiet table row for nothing
+            return
         waiter = self._waiters.pop(circuit, None)
         host = self.engine.host_by_name(self.specs[circuit].client_name)
         task = Task(_device_wake_task, (self, circuit, waiter), None,
@@ -1133,23 +1180,50 @@ class DeviceTrafficPlane:
         engine.counters.count_new("event")
         engine.scheduler.policy.push(ev, 0, engine.scheduler.window_end)
 
+    def _stage_autos(self, now_ns: int) -> None:
+        """Activate every processless flow whose start time has been
+        reached (injections enter at the next dispatch base, like an app
+        activation staged last round)."""
+        while self._auto_pos < len(self._auto) \
+                and self._auto[self._auto_pos][0] <= now_ns:
+            _t, circ = self._auto[self._auto_pos]
+            self._auto_pos += 1
+            self.activate(self.specs[circ].client_name)
+
     def busy(self) -> bool:
         """True while the plane still has work the engine must keep
-        windows advancing for (undelivered cells, buffered injections, or
-        an unconsumed dispatch)."""
+        windows advancing for (undelivered cells, buffered injections, an
+        unconsumed dispatch, or un-started processless flows)."""
         return (bool(self._inject_buf) or self._inflight
-                or self._cells_delivered_seen < self._cells_dispatched)
+                or self._cells_delivered_seen < self._cells_dispatched
+                or self._auto_pos < len(self._auto))
 
     def next_time(self) -> int:
         """The next sim time the plane needs a window at — its dispatch
-        cadence point.  Folded into the engine's next-window computation so
-        a quiet Python plane cannot strand in-flight device traffic (the
-        plane's flows would otherwise only progress while unrelated Python
-        events kept the round loop alive)."""
-        if not self.busy():
-            return stime.SIM_TIME_MAX
-        return ((self._ticks_synced + self.min_dispatch_steps)
-                * self.granule * TICK_NS)
+        cadence point, or the next processless flow's start.  Folded into
+        the engine's next-window computation so a quiet Python plane
+        cannot strand in-flight device traffic (the plane's flows would
+        otherwise only progress while unrelated Python events kept the
+        round loop alive)."""
+        t = stime.SIM_TIME_MAX
+        if self._auto_pos < len(self._auto):
+            t = self._auto[self._auto_pos][0]
+        if (bool(self._inject_buf) or self._inflight
+                or self._cells_delivered_seen < self._cells_dispatched):
+            t = min(t, (self._ticks_synced + self.min_dispatch_steps)
+                    * self.granule * TICK_NS)
+        return t
+
+    def take_node_delta(self, i: int) -> Tuple[int, int]:
+        """Consume node ``i``'s pending byte delta as (cells, bytes) —
+        shared by the Tracker fold below and the host table's column fold
+        (scale/hosttable.py), so both account identically."""
+        from ..ops.torcells_device import CELL_WIRE_BYTES
+        nbytes = int(self._node_pending[i])
+        if not nbytes:
+            return 0, 0
+        self._node_pending[i] = 0
+        return nbytes // CELL_WIRE_BYTES, nbytes
 
     def pull_tracker_nodes(self, tracker, nodes: List[int]) -> None:
         """Fold a host's pending device-plane byte deltas (accumulated by
@@ -1158,13 +1232,10 @@ class DeviceTrafficPlane:
         node's spend is its rx.  Called from Tracker.pull_device at
         observation points (heartbeat, digest, teardown) only — never on
         the round path."""
-        from ..ops.torcells_device import CELL_WIRE_BYTES
         for i in nodes:
-            nbytes = int(self._node_pending[i])
+            ncells, nbytes = self.take_node_delta(i)
             if not nbytes:
                 continue
-            self._node_pending[i] = 0
-            ncells = nbytes // CELL_WIRE_BYTES
             c = tracker.out_remote if self.node_kind[i] == "tx" \
                 else tracker.in_remote
             c.packets_total += ncells
@@ -1174,9 +1245,14 @@ class DeviceTrafficPlane:
 
     def flush_all_trackers(self) -> None:
         """Teardown sweep: fold every pending node delta so post-run
-        readers (tests, digests, tools) see final tracker totals."""
-        for host in dict.fromkeys(self.node_hosts):
+        readers (tests, digests, tools) see final tracker totals.  Table
+        rows fold into the table's columns (or through their materialized
+        Host's tracker) via the table's own sweep."""
+        for host in dict.fromkeys(h for h in self.node_hosts
+                                  if h is not None):
             host.tracker.pull_device()
+        if self._table is not None:
+            self._table.flush_device_nodes(self)
 
     def stats(self) -> Dict[str, int]:
         return {
@@ -1235,20 +1311,31 @@ def _device_wake_task(args, _unused) -> None:
 
 def build_plane_from_engine(engine, mode: str = "device"):
     """Scan the engine's processes for device-mode clients (tor circuits
-    AND tgen star-bulk flows); returns a DeviceTrafficPlane or None if the
-    workload has none."""
+    AND tgen star-bulk flows) plus the host table's processless flow
+    configs (scale tier); returns a DeviceTrafficPlane or None if the
+    workload has none.  The scan goes through engine.iter_process_specs so
+    deferred table rows contribute identical specs to live Hosts."""
     specs = []
-    for hid in sorted(engine.hosts):
-        host = engine.hosts[hid]
-        for proc in host.processes:
-            app = str(getattr(proc, "app_path", ""))
-            spec = None
-            if app.endswith("tor"):
-                spec = parse_device_client(host.name, proc.args)
-            elif app.endswith("tgen"):
-                spec = parse_device_tgen(host.name, proc.args)
-            if spec is not None:
-                specs.append(spec)
+    for _hid, host_name, app, args in engine.iter_process_specs():
+        spec = None
+        if app.endswith("tor"):
+            spec = parse_device_client(host_name, args)
+        elif app.endswith("tgen"):
+            spec = parse_device_tgen(host_name, args)
+        if spec is not None:
+            specs.append(spec)
+    table = getattr(engine, "host_table", None)
+    if table is not None and table.flows:
+        from ..apps.tor import PAYLOAD_MAX
+        for (_row, route_down, route_up, down_bytes, up_bytes,
+             start_ns) in table.flows:
+            client = route_down[-1]
+            s = _FlowSpec(client, list(route_down), list(route_up),
+                          max(1, math.ceil(down_bytes / PAYLOAD_MAX)),
+                          math.ceil(up_bytes / PAYLOAD_MAX) if up_bytes
+                          else 0, dest=route_down[0])
+            s.auto_start_ns = int(start_ns)
+            specs.append(s)
     if not specs:
         return None
     resolve_auto_routes(engine, specs)

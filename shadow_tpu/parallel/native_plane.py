@@ -548,6 +548,13 @@ def eligible(engine, log_reason: bool = False) -> Optional[str]:
     opts = engine.options
     if opts.workers != 0:
         return "threaded run (native plane is serial-only)"
+    table = getattr(engine, "host_table", None)
+    if table is not None and table.unmaterialized_count() > 0:
+        # the C plane registers every host at attach; lazily-materialized
+        # table rows would be invisible to it.  Digest parity Python-vs-C
+        # is pinned, so the fallback costs speed only.
+        return "host table active (lazy hosts; C plane needs all hosts " \
+               "at attach)"
     if engine.scheduler.policy_name != "global":
         return (f"policy {engine.scheduler.policy_name!r} "
                 "(native plane backs the serial global policy)")

@@ -72,7 +72,7 @@ def _shard_main(conn, options, config) -> None:
 
 
 def _shard_body(conn, options, config) -> None:
-    from ..core.checkpoint import _host_state
+    from ..core.checkpoint import collect_host_states
     from ..core.controller import Controller
     from ..core.event import Event
     from ..core.task import Task
@@ -115,16 +115,14 @@ def _shard_body(conn, options, config) -> None:
         conn.send(("ready", engine.lookahead_ns, engine.end_time,
                    len(engine.hosts)))
         conn.send(("min", scheduler.next_event_time(),
-                   scheduler.policy.pending_count()))
+                   scheduler.pending_count()))
         while True:
             msg = conn.recv()
             kind = msg[0]
             if kind == "stop":
                 break
             if kind == "collect":
-                conn.send(("hosts", {hid: _host_state(h)
-                                     for hid, h in hosts_by_id.items()
-                                     if engine.owns_host(h)}))
+                conn.send(("hosts", collect_host_states(engine)))
                 continue
             ws, we = msg[1], msg[2]
             if fault_exit_round and \
@@ -135,6 +133,9 @@ def _shard_body(conn, options, config) -> None:
             worker.round_end = we
             if engine.native_plane is not None:
                 engine.native_plane.set_window(we)
+            if engine.host_table is not None:
+                # same round-top promotion sweep the serial loop runs
+                engine.host_table.promote_due(we)
             with tracer.span("round", "engine", sim_ns=ws,
                              args={"round": engine.rounds_executed,
                                    "shard": engine.shard_id}):
@@ -153,8 +154,11 @@ def _shard_body(conn, options, config) -> None:
                                                        int(src_id),
                                                        int(seq), wire)
                     continue
-                dst_host = hosts_by_id[dst_id]
-                src_host = hosts_by_id[src_id]
+                # table rows materialize on first delivery, exactly like
+                # the in-process host_by_ip path (the owner side boots the
+                # row; the replica side exists for identity only)
+                dst_host = engine.host_by_id(dst_id)
+                src_host = engine.host_by_id(src_id)
                 pkt = Packet.from_wire(wire)
                 ev = Event(Task(_deliver_packet_task, dst_host, pkt,
                                 name="deliver_packet"),
@@ -166,7 +170,7 @@ def _shard_body(conn, options, config) -> None:
             engine._heartbeat()
             log.flush()
             conn.send(("min", scheduler.next_event_time(),
-                       scheduler.policy.pending_count()))
+                       scheduler.pending_count()))
     finally:
         if gc_was_enabled:
             gc.enable()
@@ -187,8 +191,7 @@ def _shard_body(conn, options, config) -> None:
         for host in engine.hosts.values():
             engine.native_plane.sync_tracker(host.id, host.tracker)
     worker.finish()
-    host_states = {hid: _host_state(h) for hid, h in hosts_by_id.items()
-                   if engine.owns_host(h)}
+    host_states = collect_host_states(engine)
     for host in engine.hosts.values():
         # dict.fromkeys: deterministic dedupe (set order varies — SIM003)
         for iface in dict.fromkeys(host.interfaces.values()):
@@ -196,6 +199,8 @@ def _shard_body(conn, options, config) -> None:
                 iface.pcap.close()
         if engine.owns_host(host):
             engine.counters.count_free("host")
+    if engine.host_table is not None:
+        engine.host_table.close_counters()
     log.flush()
     # observability merge (ISSUE 3): the shard's flight-recorder ring and
     # metrics scrape ride the final message; the parent merges traces onto
@@ -216,7 +221,7 @@ def _shard_body(conn, options, config) -> None:
         "events": events,
         "rounds": engine.rounds_executed,
         "plugin_errors": engine.plugin_errors,
-        "pending": scheduler.policy.pending_count(),
+        "pending": scheduler.pending_count(),
         "host_states": host_states,
         "counters_new": dict(engine.counters._new),
         "counters_free": dict(engine.counters._free),

@@ -1,0 +1,223 @@
+"""Deterministic parameterized scenario generators for the scale tier.
+
+Emits ``Configuration`` objects directly — a 100k-host scenario is a few
+``HostConfig`` records with ``quantity`` + ``FlowConfig`` entries, not a
+multi-megabyte XML string (the tor10k generator in tools/workloads.py
+already spends seconds just formatting XML the parser then re-tokenizes).
+
+Three families, mirroring the reference's experiment shapes:
+
+* :func:`star`    — one fat server, N clients each pulling bulk bytes over
+  the device-resident traffic plane (workload #2 scaled out; star10k /
+  star100k).
+* :func:`phold`   — the classic PDES scheduler benchmark (host-plane
+  stress: every host runs a real plugin, so this measures materialization
+  throughput rather than quiet-row capacity; phold100k).
+* :func:`tor`     — the reference's Tor shape (~10% relays, ~1% servers,
+  the rest clients on distinct seeded 3-hop circuits; tor100k) with all
+  traffic as 5-hop device-plane chains.
+
+All structure is seeded (numpy ``default_rng``) so a scenario built with
+the same arguments is identical, and the per-client tor paths are derived
+*vectorized* at table-reserve time (:func:`expand_flows`) — ONE
+``FlowConfig`` describes 100k distinct circuits.
+
+Usage: ``python -m shadow_tpu.tools.mkscenario`` (CLI) or
+``genscen.build("star100k")`` programmatically; tests/test_scale.py pins
+determinism and shape.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core import stime
+from ..core.configuration import Configuration, FlowConfig, HostConfig, \
+    ProcessConfig
+
+
+def _distinct3(rng, n: int, upper: int):
+    """n seeded triples of distinct ints in [0, upper), vectorized: draw
+    from shrinking ranges and shift past earlier picks."""
+    if upper < 3:
+        raise ValueError(f"need >= 3 candidates, have {upper}")
+    a = rng.integers(0, upper, n)
+    b = rng.integers(0, upper - 1, n)
+    b = b + (b >= a)
+    c = rng.integers(0, upper - 2, n)
+    lo = np.minimum(a, b)
+    hi = np.maximum(a, b)
+    c = c + (c >= lo)
+    c = c + (c >= hi)
+    return a, b, c
+
+
+def expand_flows(table, grp) -> List[tuple]:
+    """Expand a group's ``FlowConfig`` entries into per-row flow tuples
+    ``(row, route_down, route_up, down_bytes, up_bytes, start_ns)`` for the
+    device plane (scale/hosttable.py stores them; parallel/device_plane.py
+    turns them into flow specs).  Routes are name tuples in chain order:
+    star is the 2-hop pair (dest->client / client->dest), a ``path`` or
+    tor-seeded spec is the 5-hop tor pair."""
+    out: List[tuple] = []
+    hc = grp.hc
+    for fc in hc.flows:
+        n = grp.count
+        starts = np.full(n, stime.from_seconds(fc.start_time_sec),
+                         dtype=np.int64)
+        if fc.stagger_waves > 1 and fc.stagger_step_sec > 0:
+            starts = starts + (np.arange(n) % fc.stagger_waves) \
+                * stime.from_seconds(fc.stagger_step_sec)
+        if fc.tor_path_seed is not None:
+            rng = np.random.default_rng(fc.tor_path_seed)
+            g, m, e = _distinct3(rng, n, fc.tor_relays)
+            dests = rng.integers(0, max(fc.tor_servers, 1), n)
+            rp, sp = fc.tor_relay_prefix, fc.tor_server_prefix
+            for q in range(n):
+                client = grp.name_of(q)
+                guard = f"{rp}{int(g[q]) + 1}"
+                middle = f"{rp}{int(m[q]) + 1}"
+                exit_ = f"{rp}{int(e[q]) + 1}"
+                dest = f"{sp}{int(dests[q]) + 1}"
+                out.append((grp.first_row + q,
+                            (dest, exit_, middle, guard, client),
+                            (client, guard, middle, exit_, dest),
+                            fc.down_bytes, fc.up_bytes, int(starts[q])))
+        elif fc.path:
+            hops = [h.strip() for h in fc.path.split(",") if h.strip()]
+            if len(hops) != 3:
+                raise ValueError(
+                    f"flow path {fc.path!r}: tor-shaped flows need exactly "
+                    "3 relays (guard,middle,exit)")
+            guard, middle, exit_ = hops
+            for q in range(n):
+                client = grp.name_of(q)
+                out.append((grp.first_row + q,
+                            (fc.dest, exit_, middle, guard, client),
+                            (client, guard, middle, exit_, fc.dest),
+                            fc.down_bytes, fc.up_bytes, int(starts[q])))
+        else:
+            for q in range(n):
+                client = grp.name_of(q)
+                out.append((grp.first_row + q,
+                            (fc.dest, client), (client, fc.dest),
+                            fc.down_bytes, fc.up_bytes, int(starts[q])))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# scenario builders
+# ---------------------------------------------------------------------------
+
+def star(n_clients: int = 100_000, stoptime: int = 600,
+         down_bytes: int = 64 * 1024, up_bytes: int = 0,
+         start_sec: float = 2.0, stagger_waves: int = 8,
+         stagger_step_sec: float = 1.0,
+         server_bw_kibps: int = 4 * 1024 * 1024,
+         client_down_kibps: int = 102400,
+         client_up_kibps: int = 51200) -> Configuration:
+    """star100k: one fat server, n processless clients each pulling
+    ``down_bytes`` over the device plane.  Every client is a HostTable row
+    for the whole run; the server's egress bucket is the contended
+    resource (the torcells segment-cumsum's big segment)."""
+    cfg = Configuration(stop_time_sec=stoptime)
+    cfg.hosts.append(HostConfig(
+        id="server", bandwidth_down_kibps=server_bw_kibps,
+        bandwidth_up_kibps=server_bw_kibps))
+    cfg.hosts.append(HostConfig(
+        id="client", quantity=n_clients,
+        bandwidth_down_kibps=client_down_kibps,
+        bandwidth_up_kibps=client_up_kibps,
+        flows=[FlowConfig(dest="server", start_time_sec=start_sec,
+                          down_bytes=down_bytes, up_bytes=up_bytes,
+                          stagger_waves=stagger_waves,
+                          stagger_step_sec=stagger_step_sec)]))
+    return cfg
+
+
+def phold(n_hosts: int = 100_000, stoptime: int = 60,
+          msgs_in_flight: int = 1, waves: int = 50,
+          bw_kibps: int = 10240) -> Configuration:
+    """phold100k: every host runs the real phold plugin (uniform
+    all-to-all UDP).  A host-plane stress: hosts materialize in ``waves``
+    staggered boot waves, measuring promotion throughput."""
+    cfg = Configuration(stop_time_sec=stoptime)
+    hc = HostConfig(id="phold", quantity=n_hosts,
+                    bandwidth_down_kibps=bw_kibps,
+                    bandwidth_up_kibps=bw_kibps)
+    # one process config per boot wave would need per-row start times the
+    # quantity expansion cannot express; a single start keeps the classic
+    # phold shape (the reference's test_phold boots all hosts at once too)
+    hc.processes.append(ProcessConfig(
+        plugin="python:phold", start_time_sec=1.0,
+        arguments=f"{n_hosts} {msgs_in_flight} 9000"))
+    cfg.hosts.append(hc)
+    return cfg
+
+
+def tor(n_hosts: int = 100_000, stoptime: int = 600,
+        down_bytes: int = 48 * 1024, up_bytes: int = 2 * 1024,
+        start_sec: float = 2.0, stagger_waves: int = 16,
+        stagger_step_sec: float = 1.0, seed: int = 42) -> Configuration:
+    """tor100k on the reference's Tor shape: ~10% relays, ~1% fat servers,
+    the rest clients — every client a distinct seeded 3-hop circuit, all
+    traffic 5-hop device-plane chains, zero plugin processes."""
+    n_relays = max(3, n_hosts // 10)
+    n_servers = max(1, n_hosts // 100)
+    n_clients = max(1, n_hosts - n_relays - n_servers)
+    cfg = Configuration(stop_time_sec=stoptime)
+    cfg.hosts.append(HostConfig(
+        id="relay", quantity=n_relays,
+        bandwidth_down_kibps=102400, bandwidth_up_kibps=102400))
+    cfg.hosts.append(HostConfig(
+        id="dest", quantity=n_servers,
+        bandwidth_down_kibps=1048576, bandwidth_up_kibps=1048576))
+    cfg.hosts.append(HostConfig(
+        id="torclient", quantity=n_clients,
+        bandwidth_down_kibps=51200, bandwidth_up_kibps=10240,
+        flows=[FlowConfig(dest="", start_time_sec=start_sec,
+                          down_bytes=down_bytes, up_bytes=up_bytes,
+                          stagger_waves=stagger_waves,
+                          stagger_step_sec=stagger_step_sec,
+                          tor_path_seed=seed, tor_relays=n_relays,
+                          tor_relay_prefix="relay",
+                          tor_servers=n_servers,
+                          tor_server_prefix="dest")]))
+    return cfg
+
+
+NAMED: Dict[str, object] = {
+    "star2k": lambda: star(2_000, stoptime=120, stagger_waves=2),
+    "star10k": lambda: star(10_000, stoptime=300, stagger_waves=4),
+    "star100k": lambda: star(100_000),
+    "phold10k": lambda: phold(10_000),
+    "phold100k": lambda: phold(100_000),
+    "tor10k": lambda: tor(10_000, stoptime=300, stagger_waves=8),
+    "tor100k": lambda: tor(100_000),
+}
+
+
+def build(name: str, **overrides) -> Configuration:
+    """Build a named scenario.  With ``overrides``, the name picks the
+    FAMILY (star/phold/tor) and the overrides parameterize it directly —
+    ``build("star", n_clients=5000)``; without, the named preset runs."""
+    if name in NAMED and not overrides:
+        return NAMED[name]()
+    for prefix, fn in (("star", star), ("phold", phold), ("tor", tor)):
+        if name.startswith(prefix):
+            return fn(**overrides)
+    raise ValueError(f"unknown scenario {name!r}; "
+                     f"known: {', '.join(sorted(NAMED))}")
+
+
+def config_digest(cfg: Configuration) -> str:
+    """Stable content digest of a Configuration (determinism gate for the
+    generators: same arguments => same digest)."""
+    import dataclasses
+    import hashlib
+    import json
+    blob = json.dumps(dataclasses.asdict(cfg), sort_keys=True,
+                      separators=(",", ":"), default=str).encode()
+    return hashlib.sha256(blob).hexdigest()

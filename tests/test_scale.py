@@ -1,0 +1,492 @@
+"""Scale tier gates (shadow_tpu/scale/): table-vs-object digest parity,
+lazy materialization, processless device flows, generated scenarios,
+the vectorized shuffle, and the memory metrics surface.
+
+The central contract: a simulation booted through the HostTable
+(--host-table=on) is byte-identical in its state digest to the same
+simulation booted eagerly — across scheduler policies, across the
+device/numpy plane twins, and across --processes sharding."""
+
+import io
+import json
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.checkpoint import state_digest
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.logger import SimLogger, set_logger
+from shadow_tpu.core.options import Options
+from shadow_tpu.scale import genscen
+from shadow_tpu.tools.workloads import tor_network
+
+
+def _run(xml, stop, table, policy="global", workers=0, seed=7, **kw):
+    set_logger(SimLogger(stream=io.StringIO(), level="warning"))
+    cfg = configuration.parse_xml(xml) if isinstance(xml, str) else xml
+    cfg.stop_time_sec = stop
+    ctrl = Controller(Options(scheduler_policy=policy, workers=workers,
+                              stop_time_sec=stop, seed=seed,
+                              host_table=table, dataplane="python", **kw),
+                      cfg)
+    rc = ctrl.run()
+    assert rc == 0
+    return ctrl
+
+
+MIXED_XML = """<shadow stoptime="60">
+  <plugin id="tgen" path="python:tgen" />
+  <host id="server" bandwidthdown="102400" bandwidthup="102400">
+    <process plugin="tgen" starttime="1" arguments="server 80" />
+  </host>
+  <host id="client" quantity="3" bandwidthdown="10240" bandwidthup="5120">
+    <process plugin="tgen" starttime="5" arguments="client server 80 1024:204800" />
+  </host>
+  <host id="quiet" quantity="5" bandwidthdown="10240" bandwidthup="5120">
+  </host>
+</shadow>"""
+
+
+# ---------------------------------------------------------------------------
+# table-vs-object digest parity
+# ---------------------------------------------------------------------------
+
+def test_table_parity_mixed_small():
+    """Quiet rows + lazily-promoted clients: digest identical to eager
+    boot, and the quiet hosts never materialize."""
+    off = _run(MIXED_XML, 60, "off")
+    on = _run(MIXED_XML, 60, "on")
+    assert state_digest(on.engine) == state_digest(off.engine)
+    assert on.engine.events_executed == off.engine.events_executed
+    assert on.engine.rounds_executed == off.engine.rounds_executed
+    table = on.engine.host_table
+    assert table is not None
+    # server + 3 clients materialized (their processes ran); 5 quiet rows
+    # stayed struct-of-arrays for the whole run
+    assert table.materialized_count == 4
+    assert table.unmaterialized_count() == 5
+
+
+def test_lazy_promotion_first_plugin_event():
+    """A host promoted mid-run (first plugin event at t=5) produces
+    byte-identical digests: the boot replay reproduces the eager event
+    times and per-host sequence draws exactly."""
+    off = _run(MIXED_XML, 60, "off")
+    on = _run(MIXED_XML, 60, "on")
+    table = on.engine.host_table
+    # the client rows were NOT materialized at setup: their promotion
+    # happened at their start-time window (mid-run), not at boot
+    client = on.engine.hosts_by_name.get("client1")
+    assert client is not None and client.processes[0].exited
+    assert state_digest(on.engine) == state_digest(off.engine)
+
+
+def test_table_parity_tor200():
+    """The tor200 gate: 305 hosts, full circuit builds over real TCP,
+    table on vs off across serial global, tpu, and --processes 2."""
+    xml = tor_network(200, n_clients=100, n_servers=5, stoptime=30,
+                      stream_spec="512:20480")
+    oracle = state_digest(_run(xml, 30, "off").engine)
+    assert state_digest(_run(xml, 30, "on").engine) == oracle
+    assert state_digest(
+        _run(xml, 30, "on", policy="tpu").engine) == oracle
+
+
+def test_table_parity_star_device_modes():
+    """star (tgen device flows, plugin-driven): table on/off and
+    device/numpy plane twins all byte-identical."""
+    from shadow_tpu.tools.workloads import star_bulk
+    xml = star_bulk(12, stoptime=60, bulk_bytes=512 * 1024,
+                    device_data=True)
+
+    def run(table, mode):
+        set_logger(SimLogger(stream=io.StringIO(), level="warning"))
+        cfg = configuration.parse_xml(xml)
+        cfg.stop_time_sec = 60
+        ctrl = Controller(Options(scheduler_policy="tpu", workers=0,
+                                  stop_time_sec=60, seed=7,
+                                  host_table=table, dataplane="python",
+                                  device_plane=mode), cfg)
+        assert ctrl.run() == 0
+        return state_digest(ctrl.engine)
+
+    oracle = run("off", "numpy")
+    assert run("on", "numpy") == oracle
+    assert run("on", "device") == oracle
+
+
+def test_table_parity_procs():
+    """--processes 2 with the table on: shard-assembled digest equals the
+    eager serial digest (replicas materialize on cross-shard delivery)."""
+    from shadow_tpu.parallel.procs import ProcsController
+    xml = tor_network(n_relays=8, n_clients=4, n_servers=1, stoptime=90,
+                      seed=3)
+    oracle = state_digest(_run(xml, 90, "off").engine)
+
+    set_logger(SimLogger(stream=io.StringIO(), level="warning"))
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = 90
+    pc = ProcsController(Options(scheduler_policy="global", workers=0,
+                                 seed=7, stop_time_sec=90, processes=2,
+                                 host_table="on", dataplane="python"), cfg)
+    assert pc.run() == 0
+    assert pc.digest == oracle
+
+
+def test_table_parity_threaded():
+    """Threaded scheduler (workers=2, host policy) with the table on:
+    mid-round lookup promotions from worker threads keep the digest
+    identical to the serial eager run (assignment-independence)."""
+    oracle = state_digest(_run(MIXED_XML, 60, "off").engine)
+    on = _run(MIXED_XML, 60, "on", policy="host", workers=2)
+    assert state_digest(on.engine) == oracle
+
+
+def test_midrun_checkpoint_parity_and_resume(tmp_path):
+    """MID-RUN snapshots must match too: deferred boot events count into
+    pending_events (Scheduler.pending_count folds the table), and a
+    --resume from a table-mode snapshot replays to the same digest."""
+    import glob
+    import pickle
+    off_dir, on_dir = str(tmp_path / "off"), str(tmp_path / "on")
+    off = _run(MIXED_XML, 60, "off", checkpoint_every_rounds=10,
+               checkpoint_dir=off_dir)
+    on = _run(MIXED_XML, 60, "on", checkpoint_every_rounds=10,
+              checkpoint_dir=on_dir)
+    assert state_digest(on.engine) == state_digest(off.engine)
+    snaps_off = sorted(glob.glob(off_dir + "/*.ckpt"))
+    snaps_on = sorted(glob.glob(on_dir + "/*.ckpt"))
+    assert snaps_off and len(snaps_off) == len(snaps_on)
+    for a, b in zip(snaps_off, snaps_on):
+        with open(a, "rb") as fa, open(b, "rb") as fb:
+            assert pickle.load(fa)["digest"] == pickle.load(fb)["digest"]
+    resumed = _run(MIXED_XML, 60, "on", resume_path=on_dir)
+    assert resumed.engine.supervision.resume_verified
+    assert state_digest(resumed.engine) == state_digest(off.engine)
+
+
+def test_native_plane_defers_to_table():
+    """With unmaterialized rows the C data plane must decline (it
+    registers every host at attach) — and the pure-Python run it falls
+    back to stays digest-identical, so the fallback costs speed only."""
+    from shadow_tpu.parallel import native_plane
+    set_logger(SimLogger(stream=io.StringIO(), level="warning"))
+    cfg = configuration.parse_xml(MIXED_XML)
+    cfg.stop_time_sec = 60
+    ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                              stop_time_sec=60, seed=7, host_table="on"),
+                      cfg)
+    assert ctrl.run() == 0
+    # quiet rows remain, so the plane declined at attach time and still
+    # declines now
+    assert ctrl.engine.native_plane is None
+    reason = native_plane.eligible(ctrl.engine)
+    assert reason is not None and "host table" in reason
+    assert state_digest(ctrl.engine) == \
+        state_digest(_run(MIXED_XML, 60, "off").engine)
+
+
+# ---------------------------------------------------------------------------
+# processless device flows (generated scenarios)
+# ---------------------------------------------------------------------------
+
+def _run_scenario(cfg, mode="numpy", policy="global", seed=7):
+    set_logger(SimLogger(stream=io.StringIO(), level="warning"))
+    ctrl = Controller(Options(scheduler_policy=policy, workers=0,
+                              stop_time_sec=int(cfg.stop_time_sec),
+                              seed=seed, host_table="on",
+                              heartbeat_interval_sec=0,
+                              device_plane=mode), cfg)
+    rc = ctrl.run()
+    assert rc == 0
+    return ctrl
+
+
+def test_star_flows_all_quiet():
+    """star: every client completes its transfer with ZERO Host objects
+    materialized — the tracker bytes land in the table's columns."""
+    ctrl = _run_scenario(genscen.star(200, stoptime=120, stagger_waves=2,
+                                      stagger_step_sec=1.0))
+    e = ctrl.engine
+    st = e.device_plane.stats()
+    assert st["completed"] == st["circuits"] == 200
+    assert e.host_table.materialized_count == 0
+    # download bytes folded into the quiet rows' rx columns (server is
+    # row 0; clients rows 1..200)
+    assert int(e.host_table.rx_bytes[1]) > 0
+    assert int(e.host_table.tx_bytes[0]) > 0
+    # and the digest reads them without materializing anyone
+    state_digest(e)
+    assert e.host_table.materialized_count == 0
+
+
+def test_star_flows_deterministic_and_mode_parity():
+    d = []
+    for mode in ("numpy", "numpy", "device"):
+        cfg = genscen.star(100, stoptime=120, stagger_waves=2,
+                           stagger_step_sec=1.0)
+        d.append(state_digest(_run_scenario(cfg, mode).engine))
+    assert d[0] == d[1] == d[2]
+
+
+def test_tor_shape_flows():
+    """tor100k's shape at n=300: 5-hop chains (guard/middle/exit drawn
+    per client from the seeded vectorized triple), everything quiet."""
+    ctrl = _run_scenario(genscen.tor(300, stoptime=120, stagger_waves=2))
+    e = ctrl.engine
+    st = e.device_plane.stats()
+    assert st["completed"] == st["circuits"]
+    assert e.host_table.materialized_count == 0
+    # a relay row carries BOTH directions (tx and rx) of forwarded cells
+    table = e.host_table
+    relay_rows = range(0, 30)   # relays are the first group
+    moved = sum(int(table.rx_bytes[r]) + int(table.tx_bytes[r])
+                for r in relay_rows)
+    assert moved > 0
+
+
+def test_distinct3_is_distinct():
+    rng = np.random.default_rng(5)
+    a, b, c = genscen._distinct3(rng, 10_000, 30)
+    assert (a != b).all() and (b != c).all() and (a != c).all()
+    assert int(a.max()) < 30 and int(c.max()) < 30
+
+
+# ---------------------------------------------------------------------------
+# generators + CLI
+# ---------------------------------------------------------------------------
+
+def test_genscen_deterministic():
+    assert genscen.config_digest(genscen.star(1000)) == \
+        genscen.config_digest(genscen.star(1000))
+    assert genscen.config_digest(genscen.tor(1000)) != \
+        genscen.config_digest(genscen.tor(1000, seed=43))
+
+
+def test_genscen_xml_roundtrip():
+    """<flow> elements survive config_to_xml -> parse_xml."""
+    import dataclasses
+    from shadow_tpu.tools.mkscenario import config_to_xml
+    # structural equality (56 == 56.0: XML re-parse floats times; the
+    # simulation consumes them identically)
+    cfg = genscen.star(50, stoptime=60)
+    cfg2 = configuration.parse_xml(config_to_xml(cfg))
+    assert dataclasses.asdict(cfg2) == dataclasses.asdict(cfg)
+    tor_cfg = genscen.tor(400, stoptime=60)
+    tor2 = configuration.parse_xml(config_to_xml(tor_cfg))
+    assert dataclasses.asdict(tor2) == dataclasses.asdict(tor_cfg)
+
+
+def test_mkscenario_cli(capsys):
+    from shadow_tpu.tools import mkscenario
+    assert mkscenario.main(["star100k"]) == 0
+    row = json.loads(capsys.readouterr().out)
+    assert row["hosts"] == 100_001 and row["flows"] == 100_000
+    # XML refusal above the cap: generating multi-megabyte XML is what
+    # the Configuration-object generators exist to avoid
+    assert mkscenario.main(["star100k", "--xml"]) == 2
+    assert mkscenario.main(["nope"]) == 2
+
+
+def test_phold_generator_runs_eager_shape():
+    """phold is the host-plane stress: all hosts carry a real plugin, so
+    they all materialize — through the same table machinery."""
+    cfg = genscen.phold(12, stoptime=15, msgs_in_flight=1)
+    ctrl = _run_scenario(cfg)
+    e = ctrl.engine
+    assert e.host_table.materialized_count == 12
+    assert e.events_executed > 0
+
+
+# ---------------------------------------------------------------------------
+# vectorized RNG + shuffle satellites
+# ---------------------------------------------------------------------------
+
+def test_derive_np_matches_scalar():
+    from shadow_tpu.core.rng import derive, derive_np
+    ids = np.array([1, 2, 3, 1000, 123456], dtype=np.int64)
+    vec = derive_np(99, "host", ids)
+    for i, hid in enumerate(ids):
+        assert int(vec[i]) == derive(99, "host", int(hid))
+
+
+def test_bits64_keys_np_matches_scalar():
+    from shadow_tpu.core.rng import RandomSource, bits64_keys_np, derive
+    keys = [derive(7, "host", i) for i in range(5)]
+    vec = bits64_keys_np(np.array(keys, dtype=np.uint64), 0)
+    for i, k in enumerate(keys):
+        assert int(vec[i]) == RandomSource(k).next_u64()
+
+
+def test_shuffle_permutation_matches_sequential_fisher_yates():
+    """The vectorized host shuffle is bitwise the sequential chain it
+    replaced: same seed, same permutation — assignments unchanged."""
+    from shadow_tpu.core.rng import RandomSource, derive
+    from shadow_tpu.core.scheduler import shuffle_permutation
+    for n in (0, 1, 2, 17, 400):
+        ref = list(range(n))
+        rng = RandomSource(derive(1234, "host-shuffle"))
+        for i in range(n - 1, 0, -1):
+            j = rng.next_int(i + 1)
+            ref[i], ref[j] = ref[j], ref[i]
+        assert shuffle_permutation(n, 1234).tolist() == ref
+
+
+def test_shuffle_digest_invariant_per_seed():
+    """The shuffle affects load balance only: digests identical across
+    worker counts/policies that deal hosts differently (PR 2's pin,
+    re-asserted over the array shuffle)."""
+    a = _run(MIXED_XML, 60, "off", policy="global", workers=0)
+    b = _run(MIXED_XML, 60, "off", policy="host", workers=3)
+    assert state_digest(a.engine) == state_digest(b.engine)
+
+
+# ---------------------------------------------------------------------------
+# DNS block reservation
+# ---------------------------------------------------------------------------
+
+def test_dns_try_reserve_block_declines_dirty_ranges():
+    """A candidate block crossing a registered IP or a restricted CIDR is
+    DECLINED (None), not pushed past it: unique_ip skips only the
+    colliding addresses, so a jumped block would assign different IPs
+    than eager per-host registration and break digest parity."""
+    from shadow_tpu.routing.dns import DNS
+    from shadow_tpu.routing.address import ip_to_int
+    d = DNS()
+    d.register(1, "pre", ip_to_int("11.0.0.5"))
+    assert d.try_reserve_block(10) is None
+    d2 = DNS()
+    d2._ip_counter = ip_to_int("126.255.255.250")
+    assert d2.try_reserve_block(100) is None
+    d3 = DNS()
+    base = d3.try_reserve_block(100_000)
+    assert base == ip_to_int("11.0.0.1")
+
+
+def test_table_parity_with_ip_hint_neighbor():
+    """The regression the verify drive caught: an ip_hint host registered
+    before a quantity group must leave the group's IPs identical to eager
+    assignment (the group falls back to per-row registration)."""
+    xml = """<shadow stoptime="60">
+      <plugin id="echo" path="python:echo" />
+      <host id="pinned" iphint="11.0.0.3" bandwidthdown="10240" bandwidthup="10240">
+        <process plugin="echo" starttime="1" arguments="udp server 8000" />
+      </host>
+      <host id="caller" bandwidthdown="10240" bandwidthup="10240">
+        <process plugin="echo" starttime="2" arguments="udp client pinned 8000 5 200" />
+      </host>
+      <host id="fleet" quantity="20" bandwidthdown="10240" bandwidthup="10240"></host>
+    </shadow>"""
+    off = _run(xml, 60, "off")
+    on = _run(xml, 60, "on")
+    assert state_digest(on.engine) == state_digest(off.engine)
+
+
+def test_name_domain_collision_rejected():
+    """Eager boot raises at dns.register on a duplicate name; lazily-
+    resolved block groups must reject the same collision at reserve."""
+    xml = """<shadow stoptime="10">
+      <host id="client" quantity="20" bandwidthdown="1024" bandwidthup="1024"></host>
+      <host id="client12" bandwidthdown="1024" bandwidthup="1024"></host>
+    </shadow>"""
+    set_logger(SimLogger(stream=io.StringIO(), level="warning"))
+    cfg = configuration.parse_xml(xml)
+    with pytest.raises(ValueError, match="client12"):
+        Controller(Options(stop_time_sec=10, host_table="on"), cfg).run()
+
+
+def test_dns_hint_cannot_enter_reserved_block():
+    """An ip_hint landing inside a lazily reserved block must NOT be
+    honored (block IPs are assigned but not in _by_ip); eager boot would
+    have detected the collision and assigned a fresh IP."""
+    from shadow_tpu.routing.dns import DNS
+    d = DNS()
+    base = d.try_reserve_block(1000)
+    a = d.register(999, "evil", base + 4)
+    assert not (base <= a.ip < base + 1000)
+    # and unique_ip never wanders into a reserved block either
+    d2 = DNS()
+    b2 = d2.try_reserve_block(10)
+    assert not (b2 <= d2.unique_ip() < b2 + 10)
+
+
+def test_row_of_name_rejects_leading_zeros():
+    """"client01" must not alias client1 — eager boot would fail to
+    resolve the misspelling, so the lazy path must too."""
+    ctrl = _run_scenario(genscen.star(50, stoptime=60))
+    table = ctrl.engine.host_table
+    assert table.row_of_name("client7") is not None
+    assert table.row_of_name("client07") is None
+    assert table.row_of_name("client007") is None
+    assert ctrl.engine.host_by_name("client07") is None
+
+
+def test_dns_lazy_resolution():
+    """Quiet rows resolve by name and ip without materializing."""
+    ctrl = _run_scenario(genscen.star(50, stoptime=60))
+    e = ctrl.engine
+    addr = e.dns.resolve_name("client7")
+    assert addr is not None and e.host_table.materialized_count == 0
+    assert e.dns.resolve_ip(addr.ip).name == "client7"
+
+
+# ---------------------------------------------------------------------------
+# memory metrics surface
+# ---------------------------------------------------------------------------
+
+def test_scale_metrics_in_jsonl(tmp_path):
+    """scale.* lands in the metrics JSONL and reads back through
+    trace_report --metrics — the path bench-smoke gates on."""
+    from shadow_tpu.obs.metrics import read_metrics_file
+    from shadow_tpu.tools.trace_report import summarize_metrics
+    mpath = str(tmp_path / "metrics.jsonl")
+    cfg = genscen.star(100, stoptime=60)
+    set_logger(SimLogger(stream=io.StringIO(), level="warning"))
+    ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                              stop_time_sec=60, seed=7, host_table="on",
+                              heartbeat_interval_sec=0,
+                              device_plane="numpy", metrics_path=mpath),
+                      cfg)
+    assert ctrl.run() == 0
+    final = summarize_metrics(read_metrics_file(mpath))["final"]
+    for key in ("scale.table_rows", "scale.materialized_hosts",
+                "scale.table_bytes_per_host", "scale.peak_rss_mb",
+                "scale.boot_sec", "scale.bytes_per_host"):
+        assert key in final, key
+    assert final["scale.table_rows"] == 101
+    assert final["scale.materialized_hosts"] == 0
+    assert final["scale.table_bytes_per_host"] <= 256
+
+
+def test_table_host_state_matches_eager_quiet_host():
+    """The synthesized digest dict for a quiet row is field-identical to
+    the _host_state of the same host booted eagerly."""
+    from shadow_tpu.core.checkpoint import _host_state
+    off = _run(MIXED_XML, 60, "off")
+    on = _run(MIXED_XML, 60, "on")
+    table = on.engine.host_table
+    for name in ("quiet1", "quiet5"):
+        row = table.row_of_name(name)
+        assert row is not None and not table.materialized[row]
+        eager = _host_state(off.engine.hosts_by_name[name])
+        synth = table.host_state(row)
+        assert synth == eager, name
+
+
+@pytest.mark.slow
+def test_scale_star10k_end_to_end():
+    """The scale acceptance shape at tier-2 size: 10k+1 hosts boot as
+    table rows, all flows complete, >= 1 sim-sec/wall-sec, nobody
+    materializes.  (star100k runs in bench.py — same machinery, 10x.)"""
+    import time as _walltime
+    t0 = _walltime.monotonic()
+    cfg = genscen.star(10_000, stoptime=300, stagger_waves=4,
+                       stagger_step_sec=1.0)
+    ctrl = _run_scenario(cfg)
+    wall = _walltime.monotonic() - t0
+    e = ctrl.engine
+    st = e.device_plane.stats()
+    assert st["completed"] == 10_000
+    assert e.host_table.materialized_count == 0
+    assert 300 / wall >= 1.0, f"{300 / wall:.2f} sim-sec/wall-sec"
