@@ -8,7 +8,8 @@ PY ?= python
 # package-wide either way).
 BASE ?= HEAD
 
-.PHONY: lint lint-diff spec test bench-smoke native sanitize sanitize-thread
+.PHONY: lint lint-diff spec test bench-smoke bench-multichip native \
+	sanitize sanitize-thread
 
 lint:
 	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu
@@ -33,6 +34,13 @@ test:
 # tools/trace_report.py --metrics).  Gates the machinery, not rates.
 bench-smoke:
 	JAX_PLATFORMS=cpu $(PY) bench.py --smoke
+
+# the MULTICHIP bench row (ISSUE 9): the mesh traffic plane over >= 2
+# devices (the 8-virtual-device CPU mesh when no accelerator pool is
+# present), bounded — a wedged run is killed and reported, never rc 124.
+# Also gated inside bench-smoke via trace_report's metrics read-back.
+bench-multichip:
+	JAX_PLATFORMS=cpu $(PY) bench.py --multichip
 
 native:
 	$(MAKE) -C native

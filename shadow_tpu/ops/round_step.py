@@ -259,42 +259,17 @@ class PacketHopKernel:
 # Multi-chip round step: the packet batch is sharded across the mesh (the
 # simulator's data-parallel axis); the path matrices are replicated (attached
 # vertex counts are small even for 10k-host graphs — SURVEY.md §3.5) or, for
-# huge graphs, row-sharded with an all-gather.  The per-shard min next event
-# time is combined with a psum-style collective over ICI, mirroring the
-# round-barrier reduction the CPU scheduler does with latches
-# (scheduler.c:359-414).
+# huge graphs, row-sharded with an all-gather.  ShardedPacketHopKernel is
+# the ONE sharding entry point for packet hops (mesh construction comes
+# from parallel/mesh.device_mesh, shared with the traffic plane); the
+# step builders below are its internals.  (The standalone
+# make_sharded_hop_step / make_2d_sharded_hop_step demo builders were
+# test-only and retired with the mesh plane — the driver dryrun and
+# tests/test_scaleout.py now exercise the kernel class and the mesh
+# plane's own collectives instead.)
 # ---------------------------------------------------------------------------
 
-def make_sharded_hop_step(mesh, batch_axis: str = "pkt"):
-    """Build a pjit-ed round step over ``mesh``: batch sharded on
-    ``batch_axis``, matrices replicated, plus a global min-deliver-time
-    reduction (the next-round-window collective)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    batch_sharded = NamedSharding(mesh, P(batch_axis))
-    replicated = NamedSharding(mesh, P())
-
-    @partial(jax.jit,
-             in_shardings=(replicated, replicated,
-                           batch_sharded, batch_sharded, batch_sharded,
-                           batch_sharded, batch_sharded, batch_sharded,
-                           replicated, replicated, replicated, replicated),
-             out_shardings=(batch_sharded, batch_sharded, replicated))
-    def sharded_step(latency_ns, reliability, src_rows, dst_rows,
-                     uid_lo, uid_hi, send_times, valid,
-                     key_lo, key_hi, bootstrap_end, barrier):
-        deliver, keep = packet_hop_step(
-            latency_ns, reliability, src_rows, dst_rows, uid_lo, uid_hi,
-            send_times, valid, key_lo, key_hi, bootstrap_end, barrier)
-        # Global min over the sharded batch => XLA inserts the cross-device
-        # reduction (the ICI collective replacing the CPU latch barrier).
-        next_time = jnp.min(jnp.where(keep, deliver, jnp.int64(2**62)))
-        return deliver, keep, next_time
-
-    return sharded_step
-
-
-def make_matrix_sharded_hop_step(mesh, axis: str = "pkt"):
+def _make_matrix_sharded_hop_step(mesh, axis: str = "pkt"):
     """Row-sharded variant for graphs whose [A, A] path matrices exceed one
     chip's HBM (SURVEY.md §7 stage 10): each device holds A/D rows of the
     latency/reliability matrices; the packet batch is replicated; every
@@ -336,53 +311,6 @@ def make_matrix_sharded_hop_step(mesh, axis: str = "pkt"):
     return jax.jit(step)
 
 
-def make_2d_sharded_hop_step(mesh, batch_axis: str = "dp",
-                             row_axis: str = "tp"):
-    """Composed layout over a 2-D mesh — the simulator's dp x tp analog:
-    the packet batch is sharded over ``batch_axis`` (data parallel: each
-    group of devices handles a slice of the round's packets) while the
-    [A, A] path matrices are row-sharded over ``row_axis`` (tensor
-    parallel: each device holds A/tp rows).  Every device gathers the
-    entries whose src rows it owns for its own batch shard; one psum over
-    the row axis assembles each shard's full result.  Collectives ride the
-    mesh's ICI links exactly as a dp x tp LLM layout's do.
-    """
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    def step(latency_ns, reliability, src_rows, dst_rows,
-             uid_lo, uid_hi, send_times, valid,
-             key_lo, key_hi, bootstrap_end, barrier):
-
-        def shard_body(lat_shard, rel_shard, src, dst, u_lo, u_hi, st, va,
-                       klo, khi, bse, bar):
-            rows_per = lat_shard.shape[0]
-            shard = jax.lax.axis_index(row_axis)
-            local = src - shard * rows_per
-            mine = (local >= 0) & (local < rows_per)
-            idx = jnp.clip(local, 0, rows_per - 1)
-            lat = jnp.where(mine, lat_shard[idx, dst], jnp.int64(0))
-            rel = jnp.where(mine, rel_shard[idx, dst], jnp.float32(0.0))
-            # each packet's row lives on exactly one tp shard
-            lat = jax.lax.psum(lat, row_axis)
-            rel = jax.lax.psum(rel, row_axis)
-            return _finish_hop(lat, rel, u_lo, u_hi, st, va,
-                               klo, khi, bse, bar)
-
-        return shard_map(
-            shard_body, mesh=mesh,
-            in_specs=(P(row_axis, None), P(row_axis, None),
-                      P(batch_axis), P(batch_axis), P(batch_axis),
-                      P(batch_axis), P(batch_axis), P(batch_axis),
-                      P(), P(), P(), P()),
-            out_specs=(P(batch_axis), P(batch_axis)))(
-                latency_ns, reliability, src_rows, dst_rows,
-                uid_lo, uid_hi, send_times, valid,
-                key_lo, key_hi, bootstrap_end, barrier)
-
-    return jax.jit(step)
-
-
 class ShardedPacketHopKernel(PacketHopKernel):
     """Multi-device kernel: same .step API as PacketHopKernel, over a 1-D
     device mesh (``--tpu-devices N``).
@@ -400,22 +328,11 @@ class ShardedPacketHopKernel(PacketHopKernel):
     def __init__(self, topology, drop_key: int, bootstrap_end_ns: int,
                  n_devices: int, shard_matrix: bool = False):
         super().__init__(topology, drop_key, bootstrap_end_ns)
-        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-        pool = jax.devices()
-        if len(pool) < n_devices:
-            # a TPU plugin may own the default slot with fewer chips than
-            # the virtual CPU mesh offers (tests; dryrun) — fall back
-            try:
-                cpu_pool = jax.devices("cpu")
-            except RuntimeError:
-                cpu_pool = []
-            if len(cpu_pool) >= n_devices:
-                pool = cpu_pool
-        devices = pool[:n_devices]
-        if len(devices) < n_devices:
-            raise RuntimeError(
-                f"--tpu-devices={n_devices} but only {len(devices)} present")
-        self.mesh = Mesh(np.array(devices), axis_names=("pkt",))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        # mesh construction (pool selection incl. the virtual-CPU-mesh
+        # fallback) has ONE definition, shared with the traffic plane
+        from ..parallel.mesh import device_mesh
+        self.mesh = device_mesh(n_devices, axis_names=("pkt",))
         self.n_devices = n_devices
         self.shard_matrix = shard_matrix
         self._batch_sharding = NamedSharding(self.mesh, P("pkt"))
@@ -433,7 +350,8 @@ class ShardedPacketHopKernel(PacketHopKernel):
             row_sharding = NamedSharding(self.mesh, P("pkt", None))
             self.latency = jax.device_put(lat, row_sharding)
             self.reliability = jax.device_put(rel, row_sharding)
-            self._step = make_matrix_sharded_hop_step(self.mesh, axis="pkt")
+            self._step = _make_matrix_sharded_hop_step(self.mesh,
+                                                        axis="pkt")
             self._batch_placement = self._replicated
         else:
             self.latency = jax.device_put(self.latency, self._replicated)
@@ -486,8 +404,7 @@ def _make_batch_sharded_2out(mesh, axis: str):
     """Batch-sharded step WITHOUT the global-min collective: the engine's
     next-window time comes from the host-side event queues, so paying an
     ICI reduction per round for an unused value would be waste.  (The
-    3-output variant with the reduction is make_sharded_hop_step, used
-    where the caller consumes next_time.)"""
+    engine's window times come from the host event queues.)"""
     from jax.sharding import NamedSharding, PartitionSpec as P
     batch = NamedSharding(mesh, P(axis))
     repl = NamedSharding(mesh, P())

@@ -656,398 +656,14 @@ def torcells_step_window_numpy(t0, queued, ring, tokens, delivered, target,
 
 
 # ---------------------------------------------------------------------------
-# Multi-chip execution plane: the flow table sharded over a device mesh.
-#
-# Exactness argument: the per-tick greedy bandwidth allocation is
-# independent ACROSS nodes (each node's segment-cumsum only orders flows
-# within that node), so as long as every node's whole flow segment lives on
-# ONE shard, per-shard cumsums are bit-identical to the global one.  The
-# only cross-shard dataflow is cell forwarding, and every flow has exactly
-# ONE predecessor (circuits are chains), so arrivals can be expressed in
-# successor space: v[j] = cells served at pred(j) this tick, with a STATIC
-# per-successor latency arr_lat[j] = lat[pred(j)].  One psum over the mesh
-# assembles v; each shard then applies the identical update to its REPLICA
-# of the arrival ring.  Collectives ride ICI once per tick — the device
-# analog of the CPU scheduler's cross-thread barrier merge
-# (scheduler.c:359-414), and the sequence-parallel layout SURVEY.md §5
-# calls for (the flow axis is the simulator's sharding dimension).
+# Multi-chip execution plane: the flow table sharded over a device mesh
+# lives in shadow_tpu/parallel/mesh/ (partition.py chain partitioner +
+# padded layout, exchange.py BvN permutation-leg exchange + shard_map
+# superwindow kernel, meshplane.py DeviceTrafficPlane attachment) — the
+# single definition of the shard placement contract.  The PR-7
+# replicated-ring/full-psum kernels that used to live here were retired by
+# the mesh plane; tests/test_meshplane.py is their parity suite.
 # ---------------------------------------------------------------------------
-
-def partition_flows(flow_node: np.ndarray, n_shards: int):
-    """Split the node-sorted flow array into n_shards contiguous,
-    SEGMENT-ALIGNED ranges balanced by flow count, padded to equal length.
-    Returns (pad_len, keep_mask [n_shards*pad_len], src_index
-    [n_shards*pad_len] into the original arrays — padding rows point at
-    flow 0 and are masked off)."""
-    f = len(flow_node)
-    starts = np.flatnonzero(np.r_[True, flow_node[1:] != flow_node[:-1]])
-    bounds = [0]
-    for s in range(1, n_shards):
-        target = round(f * s / n_shards)
-        # nearest segment boundary at or after the target
-        i = int(np.searchsorted(starts, target))
-        b = int(starts[i]) if i < len(starts) else f
-        bounds.append(max(b, bounds[-1]))
-    bounds.append(f)
-    sizes = [bounds[i + 1] - bounds[i] for i in range(n_shards)]
-    pad = max(sizes) if sizes else 1
-    keep = np.zeros(n_shards * pad, dtype=bool)
-    src = np.zeros(n_shards * pad, dtype=np.int64)
-    for s in range(n_shards):
-        n = sizes[s]
-        keep[s * pad:s * pad + n] = True
-        src[s * pad:s * pad + n] = np.arange(bounds[s], bounds[s + 1])
-    return pad, keep, src
-
-
-def build_sharded_layout(flow_node, flow_lat, flow_succ, seg_start,
-                         refill, capacity, n_shards: int) -> dict:
-    """Pad + index-map the (node-sorted) flow tables for the sharded
-    kernel.  Real rows occupy the front of each shard's slice; padding rows
-    are self-segmented with queued always 0, so they serve nothing and
-    perturb nothing.  Returns the padded tables plus src/keep mappings for
-    translating state between the original and padded layouts."""
-    f = len(flow_node)
-    pad, keep, src = partition_flows(np.asarray(flow_node), n_shards)
-    fp_total = n_shards * pad
-    inv = np.full(f, -1, dtype=np.int64)
-    inv[src[keep]] = np.flatnonzero(keep)
-
-    node_p = np.asarray(flow_node)[src]
-    lat_p = np.asarray(flow_lat)[src]
-    lat_p[~keep] = 0        # diagnostic copy only; the kernel reads arr_lat
-    succ_orig = np.asarray(flow_succ)[src]
-    succ_p = np.where((succ_orig >= 0) & keep, inv[np.maximum(succ_orig, 0)],
-                      -1)
-    # per-shard local node renumbering (each node's whole segment lives on
-    # one shard by construction) + local segment starts; uniform local node
-    # count across shards (padded)
-    h_locals = []
-    node_local = np.zeros(fp_total, dtype=np.int64)
-    seg_local = np.zeros(fp_total, dtype=np.int64)
-    for s in range(n_shards):
-        lo, hi = s * pad, (s + 1) * pad
-        k = keep[lo:hi]
-        nodes = node_p[lo:hi][k]
-        uniq, local_ids = np.unique(nodes, return_inverse=True)
-        h_locals.append(len(uniq))
-        node_local[lo:lo + len(nodes)] = local_ids
-        # segment starts in LOCAL row space
-        if len(nodes):
-            starts = np.flatnonzero(np.r_[True, nodes[1:] != nodes[:-1]])
-            seg_id = np.cumsum(np.r_[0, (nodes[1:] != nodes[:-1])
-                                     .astype(np.int64)])
-            seg_local[lo:lo + len(nodes)] = starts[seg_id]
-        # padding rows: own one-row segments on the last local node slot
-        for j in range(lo + int(k.sum()), hi):
-            seg_local[j] = j - lo
-    h_pad = max(h_locals) if h_locals else 1
-    refill_p = np.zeros(n_shards * h_pad, dtype=np.int64)
-    capacity_p = np.zeros(n_shards * h_pad, dtype=np.int64)
-    node_src = np.full(n_shards * h_pad, -1, dtype=np.int64)
-    for s in range(n_shards):
-        lo = s * pad
-        k = keep[lo:lo + pad]
-        nodes = node_p[lo:lo + pad][k]
-        uniq = np.unique(nodes)
-        refill_p[s * h_pad:s * h_pad + len(uniq)] = np.asarray(refill)[uniq]
-        capacity_p[s * h_pad:s * h_pad + len(uniq)] = \
-            np.asarray(capacity)[uniq]
-        node_src[s * h_pad:s * h_pad + len(uniq)] = uniq
-        node_local[lo + int(k.sum()):lo + pad] = h_pad - 1
-    # padding rows point at the shard's last local node; they never serve
-    # (queued stays 0) so sharing a real node's bucket is harmless
-    # successor-space arrival latency: arr_lat[j] = lat of j's predecessor
-    arr_lat = np.zeros(fp_total, dtype=np.int64)
-    senders = np.flatnonzero(succ_p >= 0)
-    arr_lat[succ_p[senders]] = lat_p[senders]
-    return {
-        "pad": pad, "keep": keep, "src": src, "inv": inv,
-        "flow_node_local": node_local, "flow_lat": lat_p,
-        "succ_global": succ_p, "seg_start_local": seg_local,
-        "refill": refill_p, "capacity": capacity_p, "h_pad": h_pad,
-        "node_src": node_src,    # padded local-node slot -> global node
-        "arr_lat": arr_lat,
-        "shard_base": (np.arange(n_shards, dtype=np.int64) * pad),
-    }
-
-
-def pad_state(layout: dict, a, fill: int = 0) -> np.ndarray:
-    """Translate a per-flow array from the original layout into the padded
-    sharded layout (ONE definition of the padding contract — callers must
-    not hand-roll ``out[keep] = a[src[keep]]``)."""
-    src, keep = layout["src"], layout["keep"]
-    out = np.full(len(src), fill, dtype=np.int64)
-    out[keep] = np.asarray(a)[src[keep]]
-    return out
-
-
-def make_torcells_sharded_window_flush(mesh, axis: str, ring_len: int,
-                                       last_flow_pad: np.ndarray,
-                                       node_src: np.ndarray,
-                                       n_nodes: int):
-    """Sharded SUPERWINDOW step + packed flush in ONE dispatch (the sharded
-    analog of torcells_step_window_flush): same arguments as the step built
-    by make_torcells_sharded_window except ``n_ticks`` is replaced by the
-    ``targets`` boundary vector (see _step_span_impl), and the 9-tuple
-    comes back with the packed flush buffer appended as [9].
-    ``last_flow_pad`` [C] holds chain-exit rows in PADDED flow space;
-    ``node_src`` maps padded local-node slots to global nodes (-1 =
-    padding); the flush is expressed in the ORIGINAL chain/node spaces,
-    identical to the single-device layout's."""
-    raw = _make_sharded_span_raw(mesh, axis, ring_len)
-    lf = np.asarray(last_flow_pad, dtype=np.int64)
-    nsrc = np.asarray(node_src, dtype=np.int64)
-
-    def global_sent(ns_padded):
-        # padding slots (node_src < 0) scatter out of range and drop
-        idx = jnp.where(nsrc >= 0, nsrc, jnp.int64(n_nodes))
-        return jnp.zeros(n_nodes, jnp.int64).at[idx].add(ns_padded,
-                                                         mode="drop")
-
-    def step_flush(t0, queued, ring, tokens, delivered, target, done_tick,
-                   node_sent, inject, inject_target, targets, idle_ticks,
-                   flow_node_local, succ_global, seg_start_local,
-                   refill, capacity, arr_lat, shard_base):
-        done_in_last = done_tick[lf]
-        sent_in = global_sent(node_sent)
-        out = raw(t0, queued, ring, tokens, delivered, target, done_tick,
-                  node_sent, inject, inject_target, targets, idle_ticks,
-                  flow_node_local, succ_global, seg_start_local,
-                  refill, capacity, arr_lat, shard_base)
-        done_last = out[6][lf]
-        newly = (done_last >= 0) & (done_in_last < 0)
-        flush = _pack_flush_jnp(out[8], jnp.sum(out[4][lf]), out[0], newly,
-                                done_last, global_sent(out[7]) - sent_in)
-        return (*out, flush)
-
-    return jax.jit(step_flush)
-
-
-def make_torcells_sharded_window(mesh, axis: str, ring_len: int):
-    """Build the shard_map-ed windowed step over ``mesh``.
-
-    Layout: per-flow state (queued/delivered/target/done) and the static
-    tables are sharded on ``axis``; per-node buckets are sharded the same
-    way (a node's flows all live on its shard); the arrival ring and the
-    successor-space tables (arr_slot_lat, has_pred) are REPLICATED so every
-    shard applies the identical ring update after the per-tick psum."""
-    return jax.jit(_make_sharded_window_raw(mesh, axis, ring_len))
-
-
-def _make_sharded_window_raw(mesh, axis: str, ring_len: int):
-    """The un-jitted shard_map step make_torcells_sharded_window wraps —
-    shared with the flush variant so the tick loop exists once."""
-    import jax
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    def step(t0, queued, ring, tokens, delivered, target, done_tick,
-             node_sent, inject, inject_target, n_ticks, idle_ticks,
-             flow_node_local, succ_global, seg_start_local,
-             refill, capacity, arr_lat, shard_base):
-        """All [*] args sharded on ``axis`` except ring/arr_lat (replicated)
-        and scalars.  flow_node_local/seg_start_local are LOCAL indices;
-        succ_global is the successor's GLOBAL padded index (-1 = last)."""
-
-        def shard_body(t0, queued, ring, tokens, delivered, target,
-                       done_tick, node_sent, inject, inject_target,
-                       n_ticks, idle_ticks, flow_node_local,
-                       succ_global, seg_start_local, refill, capacity,
-                       arr_lat, shard_base):
-            # NOTE: the tick body must close over THESE (per-shard) tables —
-            # closing over step's globals would silently mix global-sized
-            # arrays into shard-local math
-            fp = queued.shape[0]
-            h_local = refill.shape[0]
-            queued = queued + inject
-            target = target + inject_target
-            tokens = jnp.minimum(capacity, tokens + refill * idle_ticks)
-            # idle jump: the replicated send history is stale (see the
-            # single-device window kernel) — clear it only when banked
-            ring = jax.lax.cond(idle_ticks > 0,
-                                lambda hh: jnp.zeros_like(hh),
-                                lambda hh: hh, ring)
-            end = t0 + n_ticks
-            size = jnp.int64(CELL_WIRE_BYTES)
-            is_last = succ_global < 0
-            base = shard_base[0]
-            f_total = ring.shape[1]
-            # my columns' arrival latencies (arr_lat is replicated
-            # successor-space; this shard reads its own slice)
-            my_arr_lat = jax.lax.dynamic_slice(arr_lat, (base,), (fp,))
-            my_cols = base + jnp.arange(fp)
-
-            def body(state):
-                t, queued, ring, tokens, delivered, target, done_tick, \
-                    node_sent, forwards = state
-                # arrivals: gather my columns' sends from arr_lat steps ago
-                # out of the replicated history (identical on every shard)
-                arr = ring[jnp.mod(t - my_arr_lat, ring_len), my_cols]
-                queued = queued + arr
-                tokens = jnp.minimum(capacity, tokens + refill)
-                cap_cells = tokens[flow_node_local] // size
-                csum = jnp.cumsum(queued)
-                before = csum - queued - jnp.where(
-                    seg_start_local > 0,
-                    csum[jnp.maximum(seg_start_local - 1, 0)],
-                    jnp.int64(0)) * (seg_start_local > 0)
-                served = jnp.clip(cap_cells - before, 0, queued)
-                queued = queued - served
-                spent = jax.ops.segment_sum(served * size, flow_node_local,
-                                            num_segments=h_local)
-                tokens = tokens - spent
-                node_sent = node_sent + spent
-                delivered = delivered + jnp.where(is_last, served, 0)
-                newly = (is_last & (target > 0) & (done_tick < 0)
-                         & (delivered >= target))
-                done_tick = jnp.where(newly, t, done_tick)
-                # successor-space send vector: my flows' served cells land
-                # at succ_global; ONE psum per tick assembles the full [F]
-                # row, then every shard writes the identical history row
-                fwd = jnp.where(is_last, jnp.int64(0), served)
-                v = jnp.zeros(f_total, jnp.int64).at[
-                    jnp.maximum(succ_global, 0)].add(fwd)
-                v = jax.lax.psum(v, axis)
-                # same RING_DTYPE cast as the single-device kernel
-                ring = ring.at[jnp.mod(t, ring_len)].set(v.astype(ring.dtype))
-                forwards = forwards + jax.lax.psum(jnp.sum(served), axis)
-                return (t + 1, queued, ring, tokens, delivered, target,
-                        done_tick, node_sent, forwards)
-
-            def cond(state):
-                return state[0] < end
-
-            state = (t0, queued, ring, tokens, delivered, target,
-                     done_tick, node_sent, jnp.int64(0))
-            return jax.lax.while_loop(cond, body, state)
-
-        sharded = P(axis)
-        repl = P()
-        return shard_map(
-            shard_body, mesh=mesh,
-            in_specs=(repl, sharded, repl, sharded, sharded, sharded,
-                      sharded, sharded, sharded, sharded, repl, repl,
-                      sharded, sharded, sharded, sharded, sharded,
-                      repl, sharded),
-            out_specs=(repl, sharded, repl, sharded, sharded, sharded,
-                       sharded, sharded, repl),
-            check_rep=False)(
-            t0, queued, ring, tokens, delivered, target, done_tick,
-            node_sent, inject, inject_target, n_ticks, idle_ticks,
-            flow_node_local, succ_global, seg_start_local,
-            refill, capacity, arr_lat, shard_base)
-
-    return step
-
-
-def _make_sharded_span_raw(mesh, axis: str, ring_len: int):
-    """The SUPERWINDOW variant of _make_sharded_window_raw: ``targets``
-    replaces ``n_ticks``, and the loop halts at the end of the first
-    sub-window in which any chain (on ANY shard — one extra psum per tick
-    assembles the global completion flag) newly completed, exactly like the
-    single-device _step_span_impl.  Every shard computes the identical
-    boundary/halt decision, so the collective loop exits in lockstep."""
-    import jax
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    def step(t0, queued, ring, tokens, delivered, target, done_tick,
-             node_sent, inject, inject_target, targets, idle_ticks,
-             flow_node_local, succ_global, seg_start_local,
-             refill, capacity, arr_lat, shard_base):
-        """Same sharding contract as _make_sharded_window_raw's step, with
-        ``targets`` (replicated int64 [P] ascending absolute boundaries,
-        padded by repeating the last) in place of the scalar tick count."""
-
-        def shard_body(t0, queued, ring, tokens, delivered, target,
-                       done_tick, node_sent, inject, inject_target,
-                       targets, idle_ticks, flow_node_local,
-                       succ_global, seg_start_local, refill, capacity,
-                       arr_lat, shard_base):
-            fp = queued.shape[0]
-            h_local = refill.shape[0]
-            p = targets.shape[0]
-            queued = queued + inject
-            target = target + inject_target
-            tokens = jnp.minimum(capacity, tokens + refill * idle_ticks)
-            ring = jax.lax.cond(idle_ticks > 0,
-                                lambda hh: jnp.zeros_like(hh),
-                                lambda hh: hh, ring)
-            end = targets[p - 1]
-            size = jnp.int64(CELL_WIRE_BYTES)
-            is_last = succ_global < 0
-            base = shard_base[0]
-            f_total = ring.shape[1]
-            my_arr_lat = jax.lax.dynamic_slice(arr_lat, (base,), (fp,))
-            my_cols = base + jnp.arange(fp)
-
-            def body(state):
-                (t, idx, halt, span_done, queued, ring, tokens, delivered,
-                 target, done_tick, node_sent, forwards) = state
-                arr = ring[jnp.mod(t - my_arr_lat, ring_len), my_cols]
-                queued = queued + arr
-                tokens = jnp.minimum(capacity, tokens + refill)
-                cap_cells = tokens[flow_node_local] // size
-                csum = jnp.cumsum(queued)
-                before = csum - queued - jnp.where(
-                    seg_start_local > 0,
-                    csum[jnp.maximum(seg_start_local - 1, 0)],
-                    jnp.int64(0)) * (seg_start_local > 0)
-                served = jnp.clip(cap_cells - before, 0, queued)
-                queued = queued - served
-                spent = jax.ops.segment_sum(served * size, flow_node_local,
-                                            num_segments=h_local)
-                tokens = tokens - spent
-                node_sent = node_sent + spent
-                delivered = delivered + jnp.where(is_last, served, 0)
-                newly = (is_last & (target > 0) & (done_tick < 0)
-                         & (delivered >= target))
-                done_tick = jnp.where(newly, t, done_tick)
-                fwd = jnp.where(is_last, jnp.int64(0), served)
-                v = jnp.zeros(f_total, jnp.int64).at[
-                    jnp.maximum(succ_global, 0)].add(fwd)
-                v = jax.lax.psum(v, axis)
-                ring = ring.at[jnp.mod(t, ring_len)].set(v.astype(ring.dtype))
-                forwards = forwards + jax.lax.psum(jnp.sum(served), axis)
-                # global completion flag: any shard's newly-done chain halts
-                # every shard at the same sub-window boundary
-                done_any = jax.lax.psum(
-                    jnp.sum(newly.astype(jnp.int64)), axis) > 0
-                span_done = span_done | done_any
-                boundary = (t + 1) == targets[jnp.minimum(idx, p - 1)]
-                halt = boundary & span_done
-                idx = jnp.where(boundary, idx + 1, idx)
-                span_done = span_done & ~boundary
-                return (t + 1, idx, halt, span_done, queued, ring, tokens,
-                        delivered, target, done_tick, node_sent, forwards)
-
-            def cond(state):
-                return (state[0] < end) & ~state[2]
-
-            state = (t0, jnp.int64(0), jnp.bool_(False), jnp.bool_(False),
-                     queued, ring, tokens, delivered, target,
-                     done_tick, node_sent, jnp.int64(0))
-            out = jax.lax.while_loop(cond, body, state)
-            return (out[0], *out[4:])
-
-        sharded = P(axis)
-        repl = P()
-        return shard_map(
-            shard_body, mesh=mesh,
-            in_specs=(repl, sharded, repl, sharded, sharded, sharded,
-                      sharded, sharded, sharded, sharded, repl, repl,
-                      sharded, sharded, sharded, sharded, sharded,
-                      repl, sharded),
-            out_specs=(repl, sharded, repl, sharded, sharded, sharded,
-                       sharded, sharded, repl),
-            check_rep=False)(
-            t0, queued, ring, tokens, delivered, target, done_tick,
-            node_sent, inject, inject_target, targets, idle_ticks,
-            flow_node_local, succ_global, seg_start_local,
-            refill, capacity, arr_lat, shard_base)
-
-    return step
 
 
 def torcells_run_numpy(queued0, flow_node, flow_lat, flow_succ, seg_start,

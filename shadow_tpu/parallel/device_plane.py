@@ -300,10 +300,11 @@ class DeviceTrafficPlane:
                 f"device plane: host {dup!r} has multiple device-mode tor "
                 "clients; run at most one per host (flows are keyed by "
                 "host name)")
+        self._meshinfo = None        # set by attach_mesh when sharded
         self._build_layout(engine)
         # multi-chip: shard the flow table over a device mesh (same
         # --tpu-devices axis the scheduler policy scales on).  Exact — see
-        # ops/torcells_device.build_sharded_layout; state/API stay in the
+        # parallel/mesh/ (partition + BvN exchange); state/API stay in the
         # ORIGINAL flow space, translated at the dispatch boundary.
         if mode == "device":
             n_dev = int(getattr(engine.options, "tpu_devices", 1) or 0)
@@ -572,36 +573,10 @@ class DeviceTrafficPlane:
         self._chain_done = np.full(self.n_chains, -1, dtype=np.int64)
 
     def _setup_sharding(self, n_dev: int) -> None:
-        import jax
-        from jax.sharding import Mesh
-        from ..ops.torcells_device import (
-            build_sharded_layout, make_torcells_sharded_window_flush)
-        pool = jax.devices()
-        if len(pool) < n_dev:
-            try:
-                cpu_pool = jax.devices("cpu")
-            except RuntimeError:
-                cpu_pool = []
-            if len(cpu_pool) >= n_dev:
-                pool = cpu_pool
-        devices = pool[:n_dev]
-        if len(devices) < n_dev:
-            raise RuntimeError(
-                f"device plane: --tpu-devices={n_dev} but only "
-                f"{len(pool)} present")
-        self._mesh = Mesh(np.array(devices), axis_names=("flows",))
-        self._shard = build_sharded_layout(
-            self.flow_node, self.flow_lat_steps, self.flow_succ,
-            self.seg_start, self.refill_step, self.capacity_step, n_dev)
-        self._sharded_step = make_torcells_sharded_window_flush(
-            self._mesh, "flows", self.ring_len,
-            self._shard["inv"][self.last_flow], self._shard["node_src"],
-            self.n_nodes)
-        get_logger().message(
-            "device-plane",
-            f"flow table sharded over {n_dev} devices "
-            f"(pad {self._shard['pad']} flows/shard, "
-            f"{self._shard['h_pad']} nodes/shard)")
+        """The ONE sharding entry point: the mesh plane (parallel/mesh/)
+        owns partition, exchange schedule, kernel, and metrics."""
+        from .mesh.meshplane import attach_mesh
+        attach_mesh(self, n_dev)
 
     def _read_summaries(self):
         """(delivered, done_tick, node_sent) in the ORIGINAL flow/node
@@ -881,7 +856,7 @@ class DeviceTrafficPlane:
                 self._cells_dispatched += cells
             self._inject_buf.clear()
             if self._shard is not None:
-                from ..ops.torcells_device import pad_state
+                from .mesh.partition import pad_state
                 inject = pad_state(self._shard, inject)
                 inject_target = pad_state(self._shard, inject_target)
             if self.mode == "device":
@@ -1002,6 +977,22 @@ class DeviceTrafficPlane:
         from ..ops.torcells_device import parse_flush
         (forwards, delivered_sum, t_stop, done_chains, done_steps, node_idx,
          node_delta) = parse_flush(flush, self.n_chains, self.n_nodes)
+        if self._meshinfo is not None:
+            # mesh flush: ONE trailing slot carries the window's
+            # cross-shard cell count (zero extra device reads; a
+            # standard-length buffer — the numpy twin after a demotion —
+            # contributes 0)
+            from .mesh.exchange import mesh_flush_extra
+            self._meshinfo.cross_shard_cells += mesh_flush_extra(
+                flush, self.n_chains, self.n_nodes)
+            if self.mode == "numpy" and forwards > 0 \
+                    and self._meshinfo.cross_edges > 0:
+                # demoted sharded plane: this window's cross-shard
+                # forwards executed HOST-side on the twin — counted so
+                # the mesh.host_bounces == 0 steady-state gate is
+                # falsifiable, not a tautology (the fault drill pins it
+                # going nonzero after a demotion)
+                self._meshinfo.host_bounces += 1
         self.total_forwards += forwards
         self._cells_delivered_seen = delivered_sum
         plan, self._active_plan = self._active_plan, None
@@ -1255,6 +1246,9 @@ class DeviceTrafficPlane:
             self._table.flush_device_nodes(self)
 
     def stats(self) -> Dict[str, int]:
+        # mesh introspection is NOT mirrored here: the mesh.* registry
+        # source (mesh/meshplane.py) is the one spelling of those
+        # counters — readers scrape the registry like every other source
         return {
             "circuits": len(self.specs),
             "injected_cells": self.total_injected_cells,

@@ -1,0 +1,402 @@
+"""Cross-shard forward exchange: precomputed BvN permutation legs executed
+as on-device collectives inside the sharded superwindow kernel.
+
+Exactness argument (inherited from the PR-7 sharded kernel): the per-tick
+greedy bandwidth allocation is independent ACROSS nodes, so with every
+node's whole flow segment on one shard, per-shard segment cumsums are
+bit-identical to the global ones.  The only cross-shard dataflow is cell
+forwarding, and every flow has exactly one predecessor (circuits are
+chains), so the per-tick arrival vector in successor space has exactly one
+writer per slot — addition order cannot matter, and any exchange that
+delivers the same (src value -> dst slot) pairs is bitwise-equivalent.
+
+The PREVIOUS sharded kernel exchanged by scattering into a full [F] vector
+and psum-ing it over the mesh every tick, with the whole arrival ring
+REPLICATED on every shard: collective bytes and ring memory were O(F)
+regardless of how little traffic actually crossed shards.  This module
+replaces that with a minimal-round schedule in the all-to-all scheduling
+literature's shape (FAST, arxiv 2505.09764; hierarchical BvN
+decomposition, arxiv 2602.22756):
+
+* at build time the static shard-to-shard cell-EDGE matrix M[s, d] (how
+  many flow->successor hops go from shard s to shard d) is decomposed
+  into <= D-1 rotation permutation legs — offset r covers every (s,
+  (s+r) % D) entry of M's support, so the set of offsets actually present
+  IS a Birkhoff-von-Neumann decomposition of the support into permutation
+  matrices, and only offsets carrying traffic become legs (the FAST
+  minimal-round property: a workload whose partition keeps chains local
+  pays for exactly as many legs as it has distinct cross-shard offsets);
+* at run time the legs execute FUSED: collective LAUNCHES dominate the
+  per-tick wall (~320 us each on the 8-virtual-device CPU mesh, nearly
+  size-independent at these widths), so a multi-leg schedule runs as ONE
+  ``jax.lax.all_to_all`` over the superposed [D, pair_width] slot layout
+  and a single-leg schedule as the bytes-minimal lone ``ppermute``; the
+  sending shard gathers its served cells into its slots, the collective
+  delivers them, the receiving shard scatter-adds them into its
+  SHARD-LOCAL arrival ring.  No host transfer, no [F]-sized collective —
+  ``mesh.host_bounces`` stays 0 by construction and the tick's exchange
+  bytes are the actual cross-shard cell slots.  With the forwards/halt
+  reductions fused into one psum, a tick costs 2 collective launches
+  against the PR-7 replicated-ring kernel's 3 (measured ~20%
+  faster/tick on the virtual mesh).
+
+The kernel below (:func:`make_mesh_span_flush`) is otherwise the
+superwindow step + packed flush of ops/torcells_device.py, byte-for-byte:
+same tick math, same halt-at-completion rule (the per-tick completion
+flag is psum'd so every shard halts at the same sub-window boundary), and
+the packed flush buffer grows ONE trailing slot carrying the window's
+cross-shard cell count so the host learns it with zero extra reads.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.torcells_device import CELL_WIRE_BYTES, _pack_flush_jnp, flush_len
+
+
+class ExchangeSchedule:
+    """The precomputed cross-shard forward schedule.
+
+    ``offsets[k]`` is leg k's rotation (shard s sends to (s+r) % D);
+    ``send_src[k]`` is int64 [D * width_k]: for each sending shard, the
+    shard-LOCAL rows whose served cells ride leg k (slot-padded with -1);
+    ``recv_dst[k]`` is int64 [D * width_k]: for each RECEIVING shard, the
+    shard-local successor rows the same slots scatter into (-1 = padding,
+    dropped).  Slot order is ascending sender local row, so sender and
+    receiver tables line up by construction.
+
+    Execution fuses the legs: with more than one leg the per-tick
+    collective is ONE ``all_to_all`` whose [D, W] slot layout
+    (``pair_width``/``a2a_src``/``a2a_dst``) is the superposition of the
+    rotation legs — same cells, same slots, one launch (collective-launch
+    count is what the per-tick wall buys on any backend); a single-leg
+    schedule keeps the bytes-minimal lone ``ppermute``."""
+
+    __slots__ = ("n_shards", "offsets", "widths", "send_src", "recv_dst",
+                 "cross_edges", "matrix", "pair_width", "a2a_src",
+                 "a2a_dst")
+
+    def __init__(self, n_shards: int, offsets: List[int],
+                 widths: List[int], send_src: List[np.ndarray],
+                 recv_dst: List[np.ndarray], cross_edges: int,
+                 matrix: np.ndarray, pair_width: int,
+                 a2a_src: np.ndarray, a2a_dst: np.ndarray):
+        self.n_shards = n_shards
+        self.offsets = offsets
+        self.widths = widths
+        self.send_src = send_src
+        self.recv_dst = recv_dst
+        self.cross_edges = cross_edges
+        self.matrix = matrix
+        self.pair_width = pair_width
+        self.a2a_src = a2a_src
+        self.a2a_dst = a2a_dst
+
+    @property
+    def legs(self) -> int:
+        return len(self.offsets)
+
+
+def shard_edge_matrix(succ_global: np.ndarray, pad: int,
+                      n_shards: int) -> np.ndarray:
+    """The static shard-to-shard cell-edge matrix M[s, d]: count of flow
+    rows on shard s whose successor lives on shard d != s."""
+    succ_global = np.asarray(succ_global, dtype=np.int64)
+    rows = np.flatnonzero(succ_global >= 0)
+    s_src = rows // pad
+    s_dst = succ_global[rows] // pad
+    m = np.zeros((n_shards, n_shards), dtype=np.int64)
+    cross = s_src != s_dst
+    np.add.at(m, (s_src[cross], s_dst[cross]), 1)
+    return m
+
+
+def build_exchange(succ_global: np.ndarray, pad: int,
+                   n_shards: int) -> ExchangeSchedule:
+    """Decompose the cross-shard successor edges into rotation legs.
+
+    Every entry M[s, d] maps to offset r = (d - s) % D; the used offsets
+    (sorted ascending, deterministic) are the legs, each leg's width the
+    max edge count any shard contributes at that offset."""
+    succ_global = np.asarray(succ_global, dtype=np.int64)
+    m = shard_edge_matrix(succ_global, pad, n_shards)
+    rows = np.flatnonzero(succ_global >= 0)
+    s_src = rows // pad
+    s_dst = succ_global[rows] // pad
+    cross = rows[s_src != s_dst]
+    # per (offset, sending shard): (local src row, receiver local dst row)
+    # pairs in ascending src-row order — the slot order BOTH tables use
+    by_leg: dict = {}
+    for i in cross.tolist():
+        s = i // pad
+        d = int(succ_global[i]) // pad
+        r = (d - s) % n_shards
+        by_leg.setdefault(r, {}).setdefault(s, []).append(
+            (i - s * pad, int(succ_global[i]) - d * pad))
+    offsets = sorted(by_leg)
+    widths, send_src, recv_dst = [], [], []
+    for r in offsets:
+        per_shard = by_leg[r]
+        w = max(len(v) for v in per_shard.values())
+        snd = np.full(n_shards * w, -1, dtype=np.int64)
+        rcv = np.full(n_shards * w, -1, dtype=np.int64)
+        for s, pairs in sorted(per_shard.items()):
+            d = (s + r) % n_shards
+            for k, (src_row, dst_row) in enumerate(pairs):
+                snd[s * w + k] = src_row
+                rcv[d * w + k] = dst_row
+        widths.append(w)
+        send_src.append(snd)
+        recv_dst.append(rcv)
+    # fused all_to_all layout: slot chunk d of sender s carries the
+    # (s -> d) edges; receiver m's chunk s scatters sender s's slots.
+    # pair_width is the max edge count over ordered shard pairs, so the
+    # [D, W] buffer superposes every rotation leg into one collective.
+    pair_width = max(1, int(m.max()) if m.size else 1)
+    a2a_src = np.full((n_shards, n_shards * pair_width), -1, dtype=np.int64)
+    a2a_dst = np.full((n_shards, n_shards * pair_width), -1, dtype=np.int64)
+    for r in offsets:
+        for s, pairs in sorted(by_leg[r].items()):
+            d = (s + r) % n_shards
+            for k, (src_row, dst_row) in enumerate(pairs):
+                a2a_src[s, d * pair_width + k] = src_row
+                a2a_dst[d, s * pair_width + k] = dst_row
+    return ExchangeSchedule(n_shards, offsets, widths, send_src, recv_dst,
+                            int(len(cross)), m, pair_width,
+                            a2a_src.reshape(-1), a2a_dst.reshape(-1))
+
+
+def make_mesh_span_raw(mesh, axis: str, ring_len: int, pad: int,
+                       schedule: ExchangeSchedule):
+    """The shard_map-ed SUPERWINDOW step with device-side cross-shard
+    exchange.  Same argument list as the engine-facing flush kernel minus
+    the flush packing; the arrival ring and arr_lat are SHARD-LOCAL
+    (sharded in_specs), unlike the PR-7 kernel's replicated ring.  Returns
+    the usual 9-tuple plus [9] = cross-shard cells exchanged this window
+    (psum'd, replicated)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n_shards = schedule.n_shards
+    # exchange tables are closed over as constants (the per-shard slice
+    # is taken with dynamic_slice on the shard id).  Execution strategy:
+    # collective LAUNCHES dominate the per-tick wall (on every backend),
+    # so multi-leg schedules run as ONE fused all_to_all over the
+    # superposed [D, pair_width] slot layout; a single-leg schedule
+    # keeps the bytes-minimal lone ppermute; a cross-free table pays no
+    # exchange at all.
+    if schedule.legs > 1:
+        ex_mode = "a2a"
+        pw = schedule.pair_width
+        a2a_src_tbl = jnp.asarray(schedule.a2a_src)
+        a2a_dst_tbl = jnp.asarray(schedule.a2a_dst)
+        chunk = n_shards * pw
+    elif schedule.legs == 1:
+        ex_mode = "ppermute"
+        leg_r = schedule.offsets[0]
+        leg_w = schedule.widths[0]
+        leg_snd_tbl = jnp.asarray(schedule.send_src[0])
+        leg_rcv_tbl = jnp.asarray(schedule.recv_dst[0])
+    else:
+        ex_mode = "none"
+
+    def step(t0, queued, ring, tokens, delivered, target, done_tick,
+             node_sent, inject, inject_target, targets, idle_ticks,
+             flow_node_local, succ_global, seg_start_local,
+             refill, capacity, arr_lat, shard_base):
+        """All [*] args sharded on ``axis`` (including ring columns and
+        arr_lat) except targets/scalars (replicated).  succ_global is the
+        successor's GLOBAL padded index (-1 = chain end); whether it is
+        local is decided against the shard's own row range."""
+
+        def shard_body(t0, queued, ring, tokens, delivered, target,
+                       done_tick, node_sent, inject, inject_target,
+                       targets, idle_ticks, flow_node_local,
+                       succ_global, seg_start_local, refill, capacity,
+                       arr_lat, shard_base):
+            fp = queued.shape[0]
+            h_local = refill.shape[0]
+            p = targets.shape[0]
+            queued = queued + inject
+            target = target + inject_target
+            tokens = jnp.minimum(capacity, tokens + refill * idle_ticks)
+            # idle jump: the local send history is stale — clear only when
+            # ticks were actually banked (same rule as the 1-chip kernel)
+            ring = jax.lax.cond(idle_ticks > 0,
+                                lambda hh: jnp.zeros_like(hh),
+                                lambda hh: hh, ring)
+            end = targets[p - 1]
+            size = jnp.int64(CELL_WIRE_BYTES)
+            is_last = succ_global < 0
+            base = shard_base[0]
+            # intra-shard successor rows (cross-shard rows ride the legs)
+            local_succ = succ_global - base
+            intra = (succ_global >= 0) & (local_succ >= 0) \
+                & (local_succ < fp)
+            oob = jnp.int64(fp)
+            intra_dst = jnp.where(intra, local_succ, oob)
+            cols = jnp.arange(fp)
+            shard = base // pad
+            if ex_mode == "a2a":
+                my_src = jax.lax.dynamic_slice(a2a_src_tbl,
+                                               (shard * chunk,), (chunk,))
+                my_dst = jax.lax.dynamic_slice(a2a_dst_tbl,
+                                               (shard * chunk,), (chunk,))
+                my_dst_slots = jnp.where(my_dst >= 0, my_dst, oob)
+            elif ex_mode == "ppermute":
+                my_src = jax.lax.dynamic_slice(leg_snd_tbl,
+                                               (shard * leg_w,), (leg_w,))
+                my_dst = jax.lax.dynamic_slice(leg_rcv_tbl,
+                                               (shard * leg_w,), (leg_w,))
+                my_dst_slots = jnp.where(my_dst >= 0, my_dst, oob)
+
+            def body(state):
+                (t, idx, halt, span_done, queued, ring, tokens, delivered,
+                 target, done_tick, node_sent, forwards, cross) = state
+                # arrivals: my rows' sends from arr_lat steps ago, out of
+                # MY ring slice (columns with no predecessor gather zeros)
+                arr = ring[jnp.mod(t - arr_lat, ring_len), cols]
+                queued = queued + arr
+                tokens = jnp.minimum(capacity, tokens + refill)
+                cap_cells = tokens[flow_node_local] // size
+                csum = jnp.cumsum(queued)
+                before = csum - queued - jnp.where(
+                    seg_start_local > 0,
+                    csum[jnp.maximum(seg_start_local - 1, 0)],
+                    jnp.int64(0)) * (seg_start_local > 0)
+                served = jnp.clip(cap_cells - before, 0, queued)
+                queued = queued - served
+                spent = jax.ops.segment_sum(served * size, flow_node_local,
+                                            num_segments=h_local)
+                tokens = tokens - spent
+                node_sent = node_sent + spent
+                delivered = delivered + jnp.where(is_last, served, 0)
+                newly = (is_last & (target > 0) & (done_tick < 0)
+                         & (delivered >= target))
+                done_tick = jnp.where(newly, t, done_tick)
+                fwd = jnp.where(is_last, jnp.int64(0), served)
+                # successor-space send vector, SHARD-LOCAL: intra-shard
+                # sends scatter directly; cross-shard sends ride the
+                # precomputed exchange (one collective per tick)
+                v = jnp.zeros(fp, jnp.int64).at[intra_dst].add(
+                    jnp.where(intra, fwd, 0), mode="drop")
+                if ex_mode == "a2a":
+                    vals = jnp.where(my_src >= 0,
+                                     fwd[jnp.clip(my_src, 0, fp - 1)],
+                                     jnp.int64(0))
+                    got = jax.lax.all_to_all(vals, axis, 0, 0, tiled=True)
+                    v = v.at[my_dst_slots].add(got, mode="drop")
+                    cross = cross + jnp.sum(
+                        jnp.where(my_dst >= 0, got, jnp.int64(0)))
+                elif ex_mode == "ppermute":
+                    vals = jnp.where(my_src >= 0,
+                                     fwd[jnp.clip(my_src, 0, fp - 1)],
+                                     jnp.int64(0))
+                    got = jax.lax.ppermute(
+                        vals, axis,
+                        perm=[(s, (s + leg_r) % n_shards)
+                              for s in range(n_shards)])
+                    v = v.at[my_dst_slots].add(got, mode="drop")
+                    cross = cross + jnp.sum(
+                        jnp.where(my_dst >= 0, got, jnp.int64(0)))
+                ring = ring.at[jnp.mod(t, ring_len)].set(
+                    v.astype(ring.dtype))
+                # fused stats reduction: forwards + the global completion
+                # flag (any shard's newly-done chain halts every shard at
+                # the same sub-window boundary) ride ONE psum per tick
+                stats = jax.lax.psum(
+                    jnp.stack([jnp.sum(served),
+                               jnp.sum(newly.astype(jnp.int64))]), axis)
+                forwards = forwards + stats[0]
+                span_done = span_done | (stats[1] > 0)
+                boundary = (t + 1) == targets[jnp.minimum(idx, p - 1)]
+                halt = boundary & span_done
+                idx = jnp.where(boundary, idx + 1, idx)
+                span_done = span_done & ~boundary
+                return (t + 1, idx, halt, span_done, queued, ring, tokens,
+                        delivered, target, done_tick, node_sent, forwards,
+                        cross)
+
+            def cond(state):
+                return (state[0] < end) & ~state[2]
+
+            state = (t0, jnp.int64(0), jnp.bool_(False), jnp.bool_(False),
+                     queued, ring, tokens, delivered, target,
+                     done_tick, node_sent, jnp.int64(0), jnp.int64(0))
+            out = jax.lax.while_loop(cond, body, state)
+            # every exchanged cell was counted once, at its receiver
+            cross_total = jax.lax.psum(out[12], axis)
+            return (out[0], *out[4:12], cross_total)
+
+        sharded = P(axis)
+        repl = P()
+        return shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(repl, sharded, P(None, axis), sharded, sharded,
+                      sharded, sharded, sharded, sharded, sharded, repl,
+                      repl, sharded, sharded, sharded, sharded, sharded,
+                      sharded, sharded),
+            out_specs=(repl, sharded, P(None, axis), sharded, sharded,
+                       sharded, sharded, sharded, repl, repl),
+            check_rep=False)(
+            t0, queued, ring, tokens, delivered, target, done_tick,
+            node_sent, inject, inject_target, targets, idle_ticks,
+            flow_node_local, succ_global, seg_start_local,
+            refill, capacity, arr_lat, shard_base)
+
+    return step
+
+
+def make_mesh_span_flush(mesh, axis: str, ring_len: int, layout: dict,
+                         last_flow_pad: np.ndarray, node_src: np.ndarray,
+                         n_nodes: int):
+    """Mesh superwindow step + packed flush in ONE dispatch: the engine's
+    sharded kernel (DeviceTrafficPlane._sharded_step contract — same
+    argument list as the PR-7 kernel, so advance()/warmup() are layout-
+    agnostic).  The flush buffer is the standard packed layout with ONE
+    trailing slot appended: [flush_len] = cross-shard cells exchanged this
+    window (consume() folds it into the mesh metrics with no extra device
+    read)."""
+    raw = make_mesh_span_raw(mesh, axis, ring_len, layout["pad"],
+                             layout["exchange"])
+    lf = np.asarray(last_flow_pad, dtype=np.int64)
+    nsrc = np.asarray(node_src, dtype=np.int64)
+
+    def global_sent(ns_padded):
+        # padding slots (node_src < 0) scatter out of range and drop
+        idx = jnp.where(nsrc >= 0, nsrc, jnp.int64(n_nodes))
+        return jnp.zeros(n_nodes, jnp.int64).at[idx].add(ns_padded,
+                                                         mode="drop")
+
+    def step_flush(t0, queued, ring, tokens, delivered, target, done_tick,
+                   node_sent, inject, inject_target, targets, idle_ticks,
+                   flow_node_local, succ_global, seg_start_local,
+                   refill, capacity, arr_lat, shard_base):
+        done_in_last = done_tick[lf]
+        sent_in = global_sent(node_sent)
+        out = raw(t0, queued, ring, tokens, delivered, target, done_tick,
+                  node_sent, inject, inject_target, targets, idle_ticks,
+                  flow_node_local, succ_global, seg_start_local,
+                  refill, capacity, arr_lat, shard_base)
+        done_last = out[6][lf]
+        newly = (done_last >= 0) & (done_in_last < 0)
+        flush = _pack_flush_jnp(out[8], jnp.sum(out[4][lf]), out[0], newly,
+                                done_last, global_sent(out[7]) - sent_in)
+        flush = jnp.concatenate([flush, out[9][None]])
+        return (*out[:9], flush)
+
+    return jax.jit(step_flush)
+
+
+def mesh_flush_extra(flush: np.ndarray, n_chains: int,
+                     n_nodes: int) -> int:
+    """The mesh flush buffer's trailing cross-shard cell count, or 0 for a
+    standard-length buffer (the numpy twin after a demotion)."""
+    base = flush_len(n_chains, n_nodes)
+    return int(flush[base]) if len(flush) > base else 0

@@ -1,0 +1,111 @@
+"""meshplane: attach the multi-chip mesh layout to a DeviceTrafficPlane.
+
+``attach_mesh`` is the traffic plane's ONE sharding entry point
+(DeviceTrafficPlane._setup_sharding delegates here for --tpu-devices N):
+it builds the device mesh, runs the chain partitioner, precomputes the
+BvN exchange schedule, installs the sharded superwindow kernel, and
+registers the ``mesh.*`` metrics source.  Everything engine-facing
+(advance/consume/warmup, pipelined dispatch, superwindows, checkpoints,
+the dispatch guard's numpy-twin demotion) is untouched — the mesh kernel
+keeps the exact argument/return contract of the single-device path, so
+the plane composes with all of it by construction and digest parity
+sharded-vs-single-device-vs-serial is pinned by tests/test_meshplane.py.
+
+Metrics (scraped into the same registry the bench reads):
+
+* ``mesh.host_bounces``   — cross-shard forwards that transited the host.
+  The exchange is entirely device-side, so this stays 0 on the
+  steady-state path; the counter exists so the contract is ASSERTED, not
+  assumed (the acceptance gate reads it).
+* ``mesh.cross_shard_cells`` — cells exchanged over the permutation legs
+  (accumulated from the flush buffer's trailing slot, zero extra reads).
+* ``mesh.exchange_legs`` / ``mesh.cross_edges`` — schedule shape: BvN
+  rotation legs in the static schedule and the flow->successor edges that
+  cross shards.
+* ``mesh.occupancy_min`` / ``mesh.occupancy_mean`` — per-device real-flow
+  fraction of the padded slice (partition balance).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.logger import get_logger
+from . import device_mesh
+from .exchange import make_mesh_span_flush
+from .partition import build_mesh_layout, chain_partition
+
+
+class MeshPlaneInfo:
+    """Per-run mesh introspection: schedule shape + runtime counters."""
+
+    __slots__ = ("n_devices", "legs", "cross_edges", "cut_fraction",
+                 "occupancy", "cross_shard_cells", "host_bounces",
+                 "flush_base")
+
+    def __init__(self, n_devices: int, legs: int, cross_edges: int,
+                 cut_fraction: float, occupancy: np.ndarray,
+                 flush_base: int):
+        self.n_devices = n_devices
+        self.legs = legs
+        self.cross_edges = cross_edges
+        self.cut_fraction = cut_fraction
+        self.occupancy = occupancy
+        self.flush_base = flush_base
+        self.cross_shard_cells = 0
+        # dispatch windows whose cross-shard forwards were delivered
+        # HOST-side.  No steady-state path does — the acceptance gate
+        # asserts it stays 0 — and the counter is falsifiable: after a
+        # dispatch failure demotes a sharded plane to the numpy twin,
+        # every busy window's cross forwards run on the host and count
+        # here (device_plane.consume; the fault drill pins it nonzero)
+        self.host_bounces = 0
+
+    def metrics(self, plane) -> dict:
+        return {
+            "mesh.devices": self.n_devices,
+            "mesh.exchange_legs": self.legs,
+            "mesh.cross_edges": self.cross_edges,
+            "mesh.cut_fraction": round(self.cut_fraction, 4),
+            "mesh.cross_shard_cells": self.cross_shard_cells,
+            "mesh.host_bounces": self.host_bounces,
+            "mesh.occupancy_min": round(float(self.occupancy.min()), 4),
+            "mesh.occupancy_mean": round(float(self.occupancy.mean()), 4),
+            "mesh.demoted": int(plane.demoted),
+        }
+
+
+def attach_mesh(plane, n_dev: int) -> None:
+    """Shard ``plane``'s flow table over an ``n_dev``-device mesh: chain
+    partition -> padded layout -> BvN exchange schedule -> sharded
+    superwindow kernel, installed under the plane's standard sharded-step
+    contract."""
+    from ...ops.torcells_device import flush_len
+
+    mesh = device_mesh(n_dev, axis_names=("flows",))
+    shard_of_node, cross_hops = chain_partition(
+        plane.flow_node, plane.flow_succ, n_dev)
+    lay = build_mesh_layout(
+        plane.flow_node, plane.flow_lat_steps, plane.flow_succ,
+        plane.seg_start, plane.refill_step, plane.capacity_step, n_dev,
+        shard_of_node)
+    sched = lay["exchange"]
+    plane._mesh = mesh
+    plane._shard = lay
+    plane._sharded_step = make_mesh_span_flush(
+        mesh, "flows", plane.ring_len, lay,
+        lay["inv"][plane.last_flow], lay["node_src"], plane.n_nodes)
+    edges_total = max(int(np.count_nonzero(plane.flow_succ >= 0)), 1)
+    occupancy = lay["shard_sizes"].astype(np.float64) / max(lay["pad"], 1)
+    plane._meshinfo = MeshPlaneInfo(
+        n_dev, sched.legs, sched.cross_edges,
+        cross_hops / edges_total, occupancy,
+        flush_len(plane.n_chains, plane.n_nodes))
+    plane.engine.metrics.source(
+        "mesh", lambda: plane._meshinfo.metrics(plane))
+    get_logger().message(
+        "device-plane",
+        f"mesh plane: flow table sharded over {n_dev} devices "
+        f"(pad {lay['pad']} flows/shard, {lay['h_pad']} nodes/shard, "
+        f"{sched.cross_edges}/{edges_total} cross-shard hops over "
+        f"{sched.legs} exchange legs)")

@@ -219,73 +219,10 @@ def test_varying_dispatch_sizes_preserve_arrivals():
             == plane._ticks_synced)
 
 
-def test_sharded_windowed_kernel_bit_parity():
-    """Multi-chip execution plane: the flow table sharded over an 8-device
-    mesh (whole node-groups per shard, replicated arrival ring, one psum
-    per tick) produces BIT-IDENTICAL state to the single-device windowed
-    kernel — the exactness argument is that greedy bandwidth allocation is
-    independent across nodes, so shard-local cumsums equal the global one."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import Mesh
-    from shadow_tpu.ops.torcells_device import (
-        build_sharded_layout, make_torcells_sharded_window, pad_state,
-        torcells_step_window)
-
-    inst = _toy_instance()
-    fl = inst.flows
-    f = inst.n_flows
-    h = len(inst.refill)
-    queued0 = np.where(fl["flow_stage"] == 0, 30, 0).astype(np.int64)
-    target0 = np.where(fl["flow_succ"] < 0, 30, 0).astype(np.int64)
-
-    # single-device oracle: two windows (40 + 500 ticks)
-    state = (jnp.int64(0), jnp.zeros(f, jnp.int64),
-             jnp.zeros((inst.ring_len, f), jnp.int64),
-             jnp.asarray(inst.capacity), jnp.zeros(f, jnp.int64),
-             jnp.zeros(f, jnp.int64), jnp.full(f, -1, jnp.int64),
-             jnp.zeros(h, jnp.int64))
-    args = (fl["flow_node"], fl["flow_lat"], fl["flow_succ"],
-            fl["seg_start"], inst.refill, inst.capacity)
-    zeros = np.zeros(f, np.int64)
-    ref = torcells_step_window(*state, queued0, target0, np.int64(40),
-                               np.int64(0), *args, ring_len=inst.ring_len)
-    ref = torcells_step_window(*ref[:8], zeros, zeros, np.int64(500),
-                               np.int64(0), *args, ring_len=inst.ring_len)
-
-    # sharded run, same windows
-    n_dev = 8
-    devices = jax.devices("cpu")[:n_dev]
-    mesh = Mesh(np.array(devices), axis_names=("flows",))
-    lay = build_sharded_layout(fl["flow_node"], fl["flow_lat"],
-                               fl["flow_succ"], fl["seg_start"],
-                               inst.refill, inst.capacity, n_dev)
-    fp = len(lay["src"])
-
-    def to_padded(a, fill=0):
-        return pad_state(lay, a, fill)
-
-    step = make_torcells_sharded_window(mesh, "flows", inst.ring_len)
-    sstate = (np.int64(0), to_padded(np.zeros(f)),
-              np.zeros((inst.ring_len, fp), np.int64),
-              lay["capacity"].copy(), to_padded(np.zeros(f)),
-              to_padded(np.zeros(f)), np.full(fp, -1, np.int64),
-              np.zeros(len(lay["refill"]), np.int64))
-    static = (lay["flow_node_local"], lay["succ_global"],
-              lay["seg_start_local"], lay["refill"], lay["capacity"],
-              lay["arr_lat"], lay["shard_base"])
-    zp = np.zeros(fp, np.int64)
-    out = step(*sstate, to_padded(queued0), to_padded(target0),
-               np.int64(40), np.int64(0), *static)
-    out = step(*out[:8], zp, zp, np.int64(500), np.int64(0), *static)
-
-    inv = lay["inv"]
-    for name, ref_i, out_i in (("queued", 1, 1), ("delivered", 4, 4),
-                               ("target", 5, 5), ("done", 6, 6)):
-        ref_v = np.asarray(ref[ref_i])
-        out_v = np.asarray(out[out_i])[inv]
-        np.testing.assert_array_equal(out_v, ref_v, err_msg=name)
-    assert int(out[8]) == int(ref[8])   # forwards this window
+# (test_sharded_windowed_kernel_bit_parity migrated to
+# tests/test_meshplane.py: the PR-7 replicated-ring sharded kernel was
+# retired by the mesh plane, whose parity suite pins the same contract
+# against the partition/exchange kernels.)
 
 
 def test_auto_consensus_device_clients():

@@ -490,3 +490,37 @@ def test_scale_star10k_end_to_end():
     assert st["completed"] == 10_000
     assert e.host_table.materialized_count == 0
     assert 300 / wall >= 1.0, f"{300 / wall:.2f} sim-sec/wall-sec"
+
+
+@pytest.mark.slow
+def test_scale_tor100k_sharded_end_to_end(tmp_path):
+    """ROADMAP item 2's remaining step, through ISSUE 9's mesh plane:
+    tor100k (the reference's Tor shape — ~10% relays, ~1% fat servers,
+    per-client seeded 3-hop circuits; the generated stand-in for the
+    reference GraphML, which is not present in this container) runs
+    end-to-end through tools/mkscenario.py --run with the flow table
+    SHARDED over the 8-virtual-device mesh.  Every circuit completes,
+    cross-shard forwards ride the device-side exchange (host_bounces 0),
+    and the per-dispatch device-call budget holds.  The 10 ms granule
+    bounds the tick count on the virtual mesh (30k 1 ms ticks of a
+    ~900k-flow table would run minutes for no extra coverage)."""
+    from shadow_tpu.obs.metrics import read_metrics_file
+    from shadow_tpu.tools import mkscenario
+    from shadow_tpu.tools.trace_report import summarize_metrics
+
+    mpath = str(tmp_path / "tor100k-metrics.jsonl")
+    cfg = genscen.tor(100_000, stoptime=30, stagger_waves=2)
+    rc = mkscenario.run_scenario(
+        cfg, ["--stop-time", "30", "--tpu-devices", "8",
+              "--device-plane-granule-ms", "10", "--metrics", mpath,
+              "--log-level", "warning"])
+    assert rc == 0
+    final = summarize_metrics(read_metrics_file(mpath))["final"]
+    assert final["plane.completed"] == final["plane.circuits"] == 89_000
+    assert final["mesh.devices"] == 8
+    assert final["mesh.host_bounces"] == 0
+    assert final["mesh.cross_shard_cells"] > 0
+    assert 1 <= final["mesh.exchange_legs"] <= 7
+    assert final["plane.device_calls"] \
+        <= 3 * max(final["plane.dispatches"], 1)
+    assert final["scale.peak_rss_mb"] < 4096

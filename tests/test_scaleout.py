@@ -1,7 +1,14 @@
 """Multi-chip scale-out (SURVEY.md §7 stage 10): the round batch and the
 path matrices sharded over a device mesh, with bitwise parity against the
 single-device kernel and the serial CPU schedule.  Runs on the 8-virtual-
-device CPU mesh (tests/conftest.py)."""
+device CPU mesh (tests/conftest.py).
+
+ShardedPacketHopKernel is the ONE sharding entry point for packet hops
+(mesh construction shared with the traffic plane via
+parallel/mesh.device_mesh); the standalone make_sharded_hop_step /
+make_2d_sharded_hop_step demo builders were retired with the mesh plane —
+the traffic-plane collectives' parity suite is tests/test_meshplane.py.
+"""
 
 import textwrap
 
@@ -16,12 +23,12 @@ from shadow_tpu.core.controller import Controller
 from shadow_tpu.core.options import Options
 
 
-def _mesh(n):
-    from jax.sharding import Mesh
-    devices = jax.devices("cpu")[:n]
-    if len(devices) < n:
+def _mesh(n, axis="pkt"):
+    from shadow_tpu.parallel.mesh import device_mesh
+    try:
+        return device_mesh(n, axis_names=(axis,))
+    except RuntimeError:
         pytest.skip(f"need {n} devices")
-    return Mesh(np.array(devices), axis_names=("pkt",))
 
 
 def _example(n_rows=16, n_pkts=2048):
@@ -42,9 +49,23 @@ def _example(n_rows=16, n_pkts=2048):
             jnp.int64(1_000_000_000), jnp.int64(0))
 
 
+def test_device_mesh_is_the_one_pool_definition():
+    """parallel/mesh.device_mesh: the shared pool-selection rule — honors
+    the virtual CPU mesh, errors past the pool size, reshapes on demand."""
+    from shadow_tpu.parallel.mesh import device_mesh
+    mesh = device_mesh(8, axis_names=("pkt",))
+    assert mesh.devices.shape == (8,)
+    mesh2 = device_mesh(8, axis_names=("a", "b"), shape=(4, 2))
+    assert mesh2.devices.shape == (4, 2)
+    with pytest.raises(RuntimeError):
+        device_mesh(10_000)
+
+
 def test_batch_sharded_matches_single_device():
+    """The production batch-sharded layout (ShardedPacketHopKernel's
+    default step) is bitwise-identical to the single-device kernel."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from shadow_tpu.ops.round_step import (make_sharded_hop_step,
+    from shadow_tpu.ops.round_step import (_make_batch_sharded_2out,
                                            packet_hop_step)
     mesh = _mesh(8)
     args = _example()
@@ -53,17 +74,17 @@ def test_batch_sharded_matches_single_device():
     placements = (repl, repl, batch, batch, batch, batch, batch, batch,
                   repl, repl, repl, repl)
     placed = tuple(jax.device_put(a, s) for a, s in zip(args, placements))
-    deliver, keep, next_time = make_sharded_hop_step(mesh)(*placed)
+    deliver, keep = _make_batch_sharded_2out(mesh, "pkt")(*placed)
     ref_deliver, ref_keep = packet_hop_step(*args)
     np.testing.assert_array_equal(np.asarray(deliver), np.asarray(ref_deliver))
     np.testing.assert_array_equal(np.asarray(keep), np.asarray(ref_keep))
-    expected_min = np.asarray(ref_deliver)[np.asarray(ref_keep)].min()
-    assert int(next_time) == expected_min
 
 
 def test_matrix_sharded_matches_single_device():
+    """The row-sharded HBM scale-out layout (--tpu-shard-matrix) is
+    bitwise-identical to the single-device kernel."""
     from jax.sharding import NamedSharding, PartitionSpec as P
-    from shadow_tpu.ops.round_step import (make_matrix_sharded_hop_step,
+    from shadow_tpu.ops.round_step import (_make_matrix_sharded_hop_step,
                                            packet_hop_step)
     mesh = _mesh(8)
     args = _example(n_rows=32)  # 32 rows / 8 devices = 4 rows per shard
@@ -72,7 +93,7 @@ def test_matrix_sharded_matches_single_device():
     placed = [jax.device_put(args[0], row_sharded),
               jax.device_put(args[1], row_sharded)]
     placed += [jax.device_put(a, repl) for a in args[2:]]
-    deliver, keep = make_matrix_sharded_hop_step(mesh)(*placed)
+    deliver, keep = _make_matrix_sharded_hop_step(mesh)(*placed)
     ref_deliver, ref_keep = packet_hop_step(*args)
     np.testing.assert_array_equal(np.asarray(deliver), np.asarray(ref_deliver))
     np.testing.assert_array_equal(np.asarray(keep), np.asarray(ref_keep))
@@ -115,30 +136,3 @@ def test_dryrun_multichip_entrypoint():
     """The driver's dryrun entry must pass on the virtual CPU mesh."""
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
-
-
-def test_2d_dp_tp_sharded_matches_single_device():
-    """Composed dp x tp layout: batch sharded over 'dp' AND matrices
-    row-sharded over 'tp' on a (4, 2) mesh — bitwise-identical to the
-    single-device kernel (the LLM-style 2-D mesh composition)."""
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-    from shadow_tpu.ops.round_step import (make_2d_sharded_hop_step,
-                                           packet_hop_step)
-
-    devices = jax.devices("cpu")[:8]
-    if len(devices) < 8:
-        pytest.skip("need 8 devices")
-    mesh = Mesh(np.array(devices).reshape(4, 2), axis_names=("dp", "tp"))
-    args = _example(n_rows=16, n_pkts=2048)  # 16 rows / tp=2 -> 8 per shard
-    batch = NamedSharding(mesh, P("dp"))
-    rows = NamedSharding(mesh, P("tp", None))
-    repl = NamedSharding(mesh, P())
-    placements = (rows, rows, batch, batch, batch, batch, batch, batch,
-                  repl, repl, repl, repl)
-    placed = tuple(jax.device_put(a, s) for a, s in zip(args, placements))
-    deliver, keep = make_2d_sharded_hop_step(mesh)(*placed)
-    ref_deliver, ref_keep = packet_hop_step(
-        *tuple(jax.device_put(a, devices[0]) for a in args))
-    np.testing.assert_array_equal(np.asarray(deliver),
-                                  np.asarray(ref_deliver))
-    np.testing.assert_array_equal(np.asarray(keep), np.asarray(ref_keep))
