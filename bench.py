@@ -29,6 +29,7 @@ import json
 import multiprocessing
 import os
 import time
+from typing import Optional
 
 # Some environments pin JAX_PLATFORMS to a plugin name (e.g. "axon") that
 # does not register in every process — or whose device tunnel is down, in
@@ -325,10 +326,19 @@ def _run_sim(xml, policy: str, workers: int, stop: int, **opt_kw) -> dict:
             disabled_overhead_sec(6 * max(eng.rounds_executed, 1),
                                   eng.events_executed), 4),
     }
+    # compacted-flush dirty tracking (ISSUE 10): quiet rounds skipped and
+    # what they still cost — the bench-smoke gate pins the per-round cost
+    out["flush_quiet_skips"] = scrape.get("engine.flush_quiet_skips")
+    out["flush_quiet_sec"] = scrape.get("engine.flush_quiet_sec")
     if "native.events_executed" in scrape:
         out["native_events"] = scrape["native.events_executed"]
         out["native_event_fraction"] = round(
             out["native_events"] / max(eng.events_executed, 1), 3)
+        if "native.round_windows" in scrape:
+            # C round executor engagement (ISSUE 10): whole windows driven
+            # by one extension call; demoted must be 0 in a healthy run
+            out["native_round_windows"] = scrape["native.round_windows"]
+            out["native_round_demoted"] = scrape["native.round_demoted"]
     if "policy.device_calls" in scrape:
         # device engagement is a tracked metric (VERDICT r3 weak #1/#6):
         # how many round flushes actually dispatched to the device vs took
@@ -529,7 +539,19 @@ def bench_full_sims() -> dict:
 
     # tor10k: workload #4 on the reference's Internet GraphML
     topo_path = "/root/reference/resource/topology.graphml.xml.xz"
-    if os.path.exists(topo_path):
+    if not os.path.exists(topo_path):
+        # the reference GraphML is absent on this box: the FLAGSHIP rows
+        # (device plane + native C control plane, ROADMAP item 3) still
+        # run, on the generated stand-in shape — same hosts, flows, and
+        # control-plane event structure, trivial latency structure — so
+        # control-plane regressions stay measurable; rates are NOT
+        # comparable to real-topology rows and the r05 wall gate is
+        # recorded as not-comparable rather than enforced
+        out.update(_tor10k_flagship_rows(scenario="standin"))
+        out["tor10k"] = ("short rows skipped: reference topology not "
+                         "present (flagship rows ran on the generated "
+                         "stand-in shape)")
+    else:
         xml10k = workloads.tor_network(10000, stoptime=TOR10K_STOPTIME,
                                        topology_path=topo_path)
         out["tor10k_steal_all_cores"] = dict(
@@ -583,19 +605,56 @@ def bench_full_sims() -> dict:
         # near-free); the python-plane engine at this stoptime would take
         # several wall-minutes, so its rate is measured at the shorter
         # stoptime above (favoring IT, since its bootstrap amortizes too)
-        stop_long = TOR10K_STOPTIME * 8
-        xml10kdl = workloads.tor_network(10000, stoptime=stop_long,
-                                         topology_path=topo_path,
-                                         device_data=True)
-        out["tor10k_device_plane_long"] = dict(
-            _run_sim(xml10kdl, "tpu", 0, stop_long), stoptime=stop_long)
-        # the two planes COMPOSED: the C data plane executes the control
-        # plane (10k circuit builds over real TCP — the Amdahl term) while
-        # the bulk cells advance in HBM
-        out["tor10k_device_plane_native_long"] = dict(
-            _run_sim(xml10kdl, "global", 0, stop_long), stoptime=stop_long)
+        out.update(_tor10k_flagship_rows(scenario="reference",
+                                         topo_path=topo_path))
+    return out
+
+
+# the BENCH_r05 flagship row's recorded host-side walls (reference
+# topology, stoptime 64): the regression gate fails the row when the
+# host wall regresses >10% vs these (ISSUE 10 satellite)
+TOR10K_R05 = {"host_exec_sec": 12.19, "flush_sec": 7.18, "wall_sec": 38.52}
+
+
+def _tor10k_flagship_rows(scenario: str,
+                          topo_path: Optional[str] = None) -> dict:
+    """The two steady-state flagship rows (device plane alone, and the
+    device plane + native C control plane composed), with the ISSUE 10
+    columns (native_event_fraction, host_exec split, flush_quiet_skips,
+    native_round_windows) and the r05 host-wall regression gate.
+
+    ``scenario='standin'`` runs the generated shape without the reference
+    GraphML (absent on some boxes): control-plane structure identical,
+    latency structure trivial — the gate is recorded, not enforced."""
+    from shadow_tpu.tools import workloads
+
+    stop_long = TOR10K_STOPTIME * 8
+    kw = dict(topology_path=topo_path) if topo_path else {}
+    xml = workloads.tor_network(10000, stoptime=stop_long,
+                                device_data=True, **kw)
+    out = {}
+    out["tor10k_device_plane_long"] = dict(
+        _run_sim(xml, "tpu", 0, stop_long), stoptime=stop_long,
+        scenario=scenario)
+    # the two planes COMPOSED: the C data plane executes the control
+    # plane (10k circuit builds over real TCP — the Amdahl term) while
+    # the bulk cells advance in HBM
+    flag = dict(_run_sim(xml, "global", 0, stop_long), stoptime=stop_long,
+                scenario=scenario)
+    host_wall = flag["host_exec_sec"] + flag["flush_sec"]
+    r05_wall = TOR10K_R05["host_exec_sec"] + TOR10K_R05["flush_sec"]
+    flag["host_wall_sec"] = round(host_wall, 2)
+    if scenario == "reference" and stop_long == 64:
+        flag["r05_host_wall_sec"] = r05_wall
+        flag["r05_host_wall_gate_pass"] = bool(host_wall
+                                               <= r05_wall * 1.10)
     else:
-        out["tor10k"] = "skipped: reference topology not present"
+        flag["r05_host_wall_gate_pass"] = None
+        flag["r05_note"] = ("r05 gate not comparable: "
+                            + ("stand-in scenario"
+                               if scenario != "reference"
+                               else f"stoptime {stop_long} != 64"))
+    out["tor10k_device_plane_native_long"] = flag
     return out
 
 
@@ -924,8 +983,24 @@ def bench_smoke() -> int:
         import shutil
         shutil.rmtree(os.path.dirname(mc["metrics_path"]),
                       ignore_errors=True)
+    # control-plane gate inputs (ISSUE 10), read back from the same
+    # JSONL/scrape surfaces a production run writes: the C round
+    # executor's engagement on the phold leg, and the compacted flush's
+    # quiet-round accounting + host_exec split on the star leg
+    quiet_skips = final.get("engine.flush_quiet_skips") or 0
+    quiet_sec = final.get("engine.flush_quiet_sec") or 0.0
+    quiet_us = round(quiet_sec * 1e6 / quiet_skips, 1) if quiet_skips \
+        else None
+    ctrl_sec = final.get("engine.host_exec_ctrl_sec")
+    exec_sec = final.get("engine.host_exec_sec")
+    ctrl_fraction = round(ctrl_sec / exec_sec, 3) \
+        if ctrl_sec is not None and exec_sec else None
     out = {
         "phold_events": r_phold["events"],
+        "native_round_windows": r_phold.get("native_round_windows"),
+        "flush_quiet_skips": quiet_skips,
+        "flush_quiet_us_per_round": quiet_us,
+        "host_exec_ctrl_fraction": ctrl_fraction,
         "rounds_per_launch": rpl,
         "superwindows": final.get("plane.superwindows"),
         "overlap_efficiency": final.get("plane.overlap_efficiency"),
@@ -974,6 +1049,28 @@ def bench_smoke() -> int:
                             "single-device <= 3 budget")
     if r_phold["events"] <= 0:
         failures.append("phold executed no events")
+    # control-plane gate (ISSUE 10): the round executor must drive the
+    # native run's windows (and never demote in a healthy pass), quiet
+    # rounds must exist on the device-bound star run and cost microseconds
+    # each, and the host_exec split must stay coherent
+    if "native_events" in r_phold:
+        if not r_phold.get("native_round_windows"):
+            failures.append("native plane engaged but the C round "
+                            "executor drove no windows")
+        if r_phold.get("native_round_demoted"):
+            failures.append("C round executor demoted during the smoke")
+    else:
+        failures.append("native plane never engaged on the phold leg "
+                        "(extension missing?)")
+    if not quiet_skips:
+        failures.append("no quiet flush rounds on the star leg — "
+                        "dirty-tracking is not engaging")
+    elif quiet_us is not None and quiet_us > 1000:
+        failures.append(f"quiet-round flush cost {quiet_us}us/round "
+                        "exceeds the ~zero budget (1ms)")
+    if ctrl_fraction is None or not 0.0 <= ctrl_fraction <= 1.0:
+        failures.append(f"host_exec_ctrl_fraction={ctrl_fraction}: the "
+                        "host_exec split is incoherent")
     if not rpl or rpl <= 1:
         failures.append(f"rounds_per_launch={rpl}: superwindows never "
                         "engaged on the device-bound star run")
@@ -1151,6 +1248,12 @@ def main() -> None:
         "tor10k_flush_sec": t10k_dev.get("flush_sec"),
         "tor10k_wall_sec": t10k_dev.get("wall_sec"),
         # flagship-config pipeline columns (tor10k_device_plane_native_long)
+        "tor10k_native_event_fraction":
+            sims.get("tor10k_device_plane_native_long",
+                     {}).get("native_event_fraction"),
+        "tor10k_host_exec_ctrl_sec":
+            sims.get("tor10k_device_plane_native_long",
+                     {}).get("host_exec_ctrl_sec"),
         "tor10k_native_flush_sec":
             sims.get("tor10k_device_plane_native_long", {}).get("flush_sec"),
         "tor10k_native_overlap_sec":
@@ -1206,6 +1309,19 @@ def main() -> None:
     if dev_vs_serial is not None and dev_vs_serial < 1.0:
         failures.append(
             f"tor200_device_plane ({dev_vs_serial}x) lost to serial")
+    # ISSUE 10: the flagship row fails the bench when its host wall
+    # (host_exec + flush) regresses >10% vs the recorded BENCH_r05 values
+    # (enforced only on the comparable real-topology scenario; the
+    # stand-in records r05_note instead)
+    flag = sims.get("tor10k_device_plane_native_long", {})
+    if flag.get("r05_host_wall_gate_pass") is False:
+        failures.append(
+            f"tor10k flagship host wall {flag.get('host_wall_sec')}s "
+            f"regressed >10% vs BENCH_r05 "
+            f"({flag.get('r05_host_wall_sec')}s)")
+    if flag.get("native_round_demoted"):
+        failures.append("tor10k flagship ran with the C round executor "
+                        "demoted — investigate before publishing rates")
     if failures:
         print("BENCH GATE FAILURES: " + "; ".join(failures),
               file=sys.stderr, flush=True)

@@ -722,6 +722,13 @@ struct Plane {
   // run-loop context
   bool in_run;
   EvKey limit;              // active run's stop key (lower_limit shrinks it)
+  // round-executor context (run_window): the Python queue's exact top key,
+  // mirrored here so the merged loop never leaves C between events; pushes
+  // from callbacks keep it exact through lower_limit, and each py_exec
+  // return value refreshes it from the queue itself
+  bool in_round;
+  bool py_has;
+  EvKey py_key;
   int64_t now;              // current virtual time during C execution
   int32_t active_host;      // current executing host (seq owner for pushes)
   // counters
@@ -2095,6 +2102,8 @@ PyObject *Plane_py_new(PyTypeObject *type, PyObject *, PyObject *) {
   pl->end_time = 0;
   pl->window_end = 0;
   pl->in_run = false;
+  pl->in_round = false;
+  pl->py_has = false;
   pl->now = 0;
   pl->active_host = -1;
   pl->events_scheduled = pl->events_executed = pl->packet_drops = 0;
@@ -2763,11 +2772,96 @@ PyObject *Plane_lower_limit(PyObject *self, PyObject *args) {
   long long t, seq;
   int d, s_;
   if (!PyArg_ParseTuple(args, "LiiL", &t, &d, &s_, &seq)) return nullptr;
-  if (pl->in_run) {
-    EvKey k{t, d, s_, seq};
+  EvKey k{t, d, s_, seq};
+  if (pl->in_round) {
+    // round executor active: a Python push lowers the mirrored Python-top
+    // key (pushes only ever ADD events, so min(mirror, new) stays exact —
+    // pops happen solely inside py_exec, which refreshes the mirror from
+    // the queue's actual top on return)
+    if (!pl->py_has || evkey_lt(k, pl->py_key)) {
+      pl->py_key = k;
+      pl->py_has = true;
+    }
+  } else if (pl->in_run) {
     if (evkey_lt(k, pl->limit)) pl->limit = k;
   }
   Py_RETURN_NONE;
+}
+
+// run_window(window_end, py_key_or_None, py_exec) -> native events executed.
+// The ISSUE 10 round executor: ONE extension call drives the WHOLE merged
+// window.  C events below window_end execute natively; whenever the Python
+// queue's top (mirrored in py_key) precedes the C heap's top, py_exec() is
+// invoked ONCE — it pops + executes exactly that event and returns the
+// queue's new top key (or None).  Compared with the per-event pop loop
+// (NativeGlobalPolicy.pop), a native event pays zero Python and a Python
+// event pays one callback instead of a peek/next_key/compare/pop round
+// trip, so per-round Python cost is O(python events), not O(all events).
+PyObject *Plane_run_window(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long window_end;
+  PyObject *py_key, *py_exec;
+  if (!PyArg_ParseTuple(args, "LOO", &window_end, &py_key, &py_exec))
+    return nullptr;
+  pl->py_has = false;
+  if (py_key != Py_None) {
+    long long t, seq;
+    int d, s_;
+    if (!PyArg_ParseTuple(py_key, "LiiL", &t, &d, &s_, &seq)) return nullptr;
+    pl->py_key = EvKey{t, d, s_, seq};
+    pl->py_has = true;
+  }
+  // strictly time < window_end, same sentinel shape as the pop-loop run
+  EvKey horizon{window_end, INT32_MIN, INT32_MIN, INT64_MIN};
+  pl->limit = horizon;
+  pl->in_run = true;
+  pl->in_round = true;
+  int64_t executed = 0;
+  while (true) {
+    bool c_ok = !pl->heap->empty() && key_lt(pl->heap->front(), horizon);
+    bool py_ok = pl->py_has && evkey_lt(pl->py_key, horizon);
+    if (c_ok && py_ok) {
+      const Ev &top = pl->heap->front();
+      EvKey ck{top.time, top.dst, top.src, top.seq};
+      if (evkey_lt(pl->py_key, ck)) c_ok = false;  // Python event first
+    }
+    if (c_ok) {
+      std::pop_heap(pl->heap->begin(), pl->heap->end(), EvGreater());
+      Ev ev = pl->heap->back();
+      pl->heap->pop_back();
+      if (!plane_exec(pl, ev)) {
+        pl->in_run = pl->in_round = false;
+        return nullptr;  // Python callback raised
+      }
+      executed++;
+    } else if (py_ok) {
+      PyObject *r = PyObject_CallObject(py_exec, nullptr);
+      if (!r) {
+        pl->in_run = pl->in_round = false;
+        return nullptr;  // the Python event raised
+      }
+      if (r == Py_None) {
+        pl->py_has = false;
+      } else {
+        long long t, seq;
+        int d, s_;
+        int ok = PyArg_ParseTuple(r, "LiiL", &t, &d, &s_, &seq);
+        Py_DECREF(r);
+        if (!ok) {
+          pl->in_run = pl->in_round = false;
+          return nullptr;
+        }
+        pl->py_key = EvKey{t, d, s_, seq};
+        pl->py_has = true;
+        continue;
+      }
+      Py_DECREF(r);
+    } else {
+      break;
+    }
+  }
+  pl->in_run = pl->in_round = false;
+  return PyLong_FromLongLong(executed);
 }
 
 // ---- method table / type ---------------------------------------------------
@@ -2806,6 +2900,7 @@ PyMethodDef Plane_methods[] = {
     {"next_key", Plane_next_key, METH_NOARGS, nullptr},
     {"pending", Plane_pending, METH_NOARGS, nullptr},
     {"run", Plane_run, METH_VARARGS, nullptr},
+    {"run_window", Plane_run_window, METH_VARARGS, nullptr},
     {"lower_limit", Plane_lower_limit, METH_VARARGS, nullptr},
     {nullptr, nullptr, 0, nullptr},
 };

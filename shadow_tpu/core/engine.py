@@ -28,7 +28,25 @@ from .counters import ObjectCounter
 from .logger import get_logger
 from .rng import RandomSource, derive, uniform_np
 from .scheduler import Scheduler
-from .worker import Worker, set_current_worker
+from .task import Task
+from .worker import Worker, current_worker, set_current_worker
+
+
+def _tracker_sweep_task(args, _unused) -> None:
+    """The per-interval heartbeat tick: RECORD the due sweep and reschedule.
+    The tracker work itself runs at the round boundary (_flush_round, main
+    thread, workers parked) — an in-event sweep over ALL hosts would race
+    the other workers' event execution on those hosts' trackers, which the
+    retired per-host heartbeat events never did (they ran under each
+    host's own execution serialization)."""
+    engine, interval_sec = args
+    w = current_worker()
+    engine._pending_sweeps.append((interval_sec,
+                                   w.now if w is not None else 0))
+    if w is not None:
+        w.schedule_task(Task(_tracker_sweep_task, (engine, interval_sec),
+                             None, name="heartbeat"),
+                        interval_sec * stime.SIM_TIME_SEC, dst_host=None)
 
 DEFAULT_LOOKAHEAD_NS = 10 * stime.SIM_TIME_MS  # master.c:133-146 default jump
 
@@ -93,6 +111,17 @@ class Engine:
         # ours splits each round into host-execute vs flush/device wall time)
         self.host_exec_ns = 0
         self.flush_ns = 0
+        # compacted-flush dirty tracking (ISSUE 10): rounds whose whole
+        # flush phase (policy flush + checkpoint + logger) did no work,
+        # and what those quiet rounds still cost — the bench-smoke gate
+        # pins the per-quiet-round cost ~zero
+        self.flush_quiet_skips = 0
+        self.flush_quiet_ns = 0
+        # heartbeat sweeps due this round: (interval_sec, tick sim time),
+        # recorded by the tick event (worker 0) and drained at the round
+        # boundary by _flush_round on the main thread — the round latch
+        # orders the append before the drain
+        self._pending_sweeps: List = []
         # wall ns spent resuming plugin code (green-thread continues +
         # native RPC serving), accumulated under _counters_lock from
         # process/process.py — subtracted from host_exec for the
@@ -355,6 +384,8 @@ class Engine:
             "engine.host_exec_ctrl_sec": round(
                 max(self.host_exec_ns - plugin_ns, 0) / 1e9, 4),
             "engine.flush_sec": round(self.flush_ns / 1e9, 4),
+            "engine.flush_quiet_skips": self.flush_quiet_skips,
+            "engine.flush_quiet_sec": round(self.flush_quiet_ns / 1e9, 4),
         }
         pol = self.scheduler.policy
         if hasattr(pol, "device_ns"):       # tpu policy phase timers
@@ -372,6 +403,13 @@ class Engine:
             out["native.events_scheduled"] = sched
             out["native.events_executed"] = execd
             out["native.drops"] = drops
+            pol = self.scheduler.policy
+            if hasattr(pol, "round_windows"):
+                # C round executor engagement (ISSUE 10): windows driven
+                # by ONE extension call, and whether a failure demoted the
+                # executor back to the per-event path
+                out["native.round_windows"] = pol.round_windows
+                out["native.round_demoted"] = int(pol.round_demoted)
         return out
 
     def _obs_round_end(self) -> None:
@@ -459,12 +497,65 @@ class Engine:
                 for proc in host.processes:
                     proc.schedule_start(boot_worker)
                 boot_worker.set_active_host(None)
+            self._schedule_heartbeat_sweeps(boot_worker)
         finally:
             set_current_worker(None)
         self.merge_counters(boot_worker.counters)
         # table rows boot lazily from here on: a row materialized after
         # this point replays this exact sequence for itself
         self._boot_done = True
+
+    def _schedule_heartbeat_sweeps(self, worker) -> None:
+        """ONE recurring sweep event per distinct per-host heartbeat
+        interval replaces the per-host heartbeat events (ISSUE 10 batched
+        control plane): at each tick the sweep heartbeats every owned host
+        on that interval in one pass — under the native plane through ONE
+        bulk C tracker snapshot — so a 10k-host run pays one event + one
+        extension call per interval, not 10k events with a C round-trip
+        each.  Log lines keep the same sim-time stamps and global host-id
+        order; the VALUES are sampled at the tick's round boundary (the
+        sweep drains there, workers parked) rather than the tick's exact
+        slot in the event order, so they can include up to one lookahead
+        window of post-tick traffic — deterministic, and fresher, but not
+        bit-equal to the retired per-host events' mid-round samples."""
+        intervals = {h.params.heartbeat_interval_sec
+                     for h in self.hosts.values()
+                     if self.owns_host(h)
+                     and h.params.heartbeat_interval_sec > 0}
+        if self.host_table is not None:
+            intervals |= self.host_table.heartbeat_intervals()
+        for sec in sorted(intervals):
+            worker.schedule_task(
+                Task(_tracker_sweep_task, (self, sec), None,
+                     name="heartbeat"),
+                sec * stime.SIM_TIME_SEC, dst_host=None)
+
+    def run_tracker_sweep(self, interval_sec: int, now: int) -> None:
+        """One heartbeat sweep tick, run at the round boundary (workers
+        parked — no tracker races): heartbeat every owned host on this
+        interval in GLOBAL host-id order, quiet table rows merged in place
+        (reported from columns, never materialized), with ONE bulk C
+        tracker snapshot when the native plane is attached.  Quiet hosts
+        pay the prev==row dirty check inside sync_tracker and the
+        filtered-level early-out inside heartbeat."""
+        from contextlib import nullcontext
+        rows = self.host_table.heartbeat_rows(interval_sec) \
+            if self.host_table is not None else []
+        ri = 0
+        ctx = self.native_plane.bulk_sync() \
+            if self.native_plane is not None else nullcontext()
+        with ctx:
+            for hid in sorted(self.hosts):
+                while ri < len(rows) and rows[ri][0] < hid:
+                    self.host_table.heartbeat_row(rows[ri], now)
+                    ri += 1
+                host = self.hosts[hid]
+                if host.params.heartbeat_interval_sec == interval_sec \
+                        and self.owns_host(host):
+                    host.tracker.heartbeat(now)
+        while ri < len(rows):
+            self.host_table.heartbeat_row(rows[ri], now)
+            ri += 1
 
     # -- round loop --------------------------------------------------------
     def run(self) -> int:
@@ -561,22 +652,37 @@ class Engine:
         log.flush()
         return 1 if self.plugin_errors else 0
 
-    def _flush_round(self) -> None:
+    def _flush_round(self) -> bool:
         """Round-boundary hook for batching policies (tpu): LAUNCH the device
         step for the packets sent this round.  In async mode the results are
         materialized by _consume_flush at the top of the next loop iteration
         (always before the next window is computed), so the device computes
         through the logger flush / heartbeat / window bookkeeping.  (The
         device traffic plane launches EARLIER — _launch_plane at the top of
-        the round — so its dispatch overlaps the whole round's host work.)"""
+        the round — so its dispatch overlaps the whole round's host work.)
+
+        Returns True when any leg did real work — the round loop's
+        dirty-tracking signal (ISSUE 10 compacted flush): quiet rounds are
+        counted and their flush cost pinned ~zero by the bench-smoke
+        control-plane gate."""
+        did = False
+        if self._pending_sweeps:
+            # heartbeat sweeps recorded by this round's tick events run
+            # HERE, at the quiescent boundary (workers parked), so the
+            # tracker reads/folds never race worker-thread event execution
+            sweeps, self._pending_sweeps = self._pending_sweeps, []
+            for interval_sec, now in sweeps:
+                self.run_tracker_sweep(interval_sec, now)
+            did = True
         flush = getattr(self.scheduler.policy, "flush_round", None)
         if flush is not None:
-            flush(self)
+            did = bool(flush(self)) or did
         ws = self.scheduler.window_start
         if self._resume_snapshot is not None \
                 and ws >= self._resume_snapshot["sim_time_ns"]:
             self._consume_flush()
             self._verify_resume(ws)
+            did = True
         if self._checkpointer is not None \
                 and self._checkpointer.due(ws, self.rounds_executed):
             # snapshots must include every in-flight delivery: consume first
@@ -586,9 +692,11 @@ class Engine:
             self._consume_flush()
             with self.tracer.span("checkpoint.write", "engine", sim_ns=ws):
                 path = self._checkpointer.maybe_write(self)
+            did = True
             if path:
                 self._checkpoint_counter.inc()
                 get_logger().message("engine", f"checkpoint written: {path}")
+        return did
 
     def _verify_resume(self, window_start: int) -> None:
         from .checkpoint import (collect_state, digest_of_state,
@@ -758,9 +866,14 @@ class Engine:
         perf = _walltime.perf_counter_ns
         tracer = self.tracer
         log = get_logger()
+        plane = self.device_plane
         try:
             while True:
                 tc = perf()
+                # plane interaction disqualifies the iteration from the
+                # quiet-round count below: a collect (in-flight dispatch
+                # materialized here) or a launch is flush-phase work
+                plane_active = plane is not None and plane._inflight
                 with tracer.span("collect", "engine",
                                  sim_ns=self.scheduler.window_start):
                     self._consume_flush()
@@ -769,9 +882,12 @@ class Engine:
                     break
                 ws = self.scheduler.window_start
                 tl = perf()
+                dispatches0 = plane.dispatches if plane is not None else 0
                 with tracer.span("dispatch.launch", "engine", sim_ns=ws):
                     self._launch_plane()
                 self.flush_ns += perf() - tl
+                plane_active = plane_active or (
+                    plane is not None and plane.dispatches != dispatches0)
                 worker.round_end = self.scheduler.window_end
                 t0 = perf()
                 with tracer.span("round", "engine", sim_ns=ws,
@@ -779,14 +895,21 @@ class Engine:
                     worker.run_round()
                 t1 = perf()
                 with tracer.span("flush", "engine", sim_ns=ws):
-                    self._flush_round()
-                self.flush_ns += perf() - t1
+                    did_flush = self._flush_round()
+                t2 = perf()
+                self.flush_ns += t2 - t1
                 self.host_exec_ns += t1 - t0
                 self.rounds_executed += 1
                 self._heartbeat()
                 self._obs_round_end()
-                with tracer.span("log.flush", "engine", sim_ns=ws):
-                    log.flush()
+                # compacted flush (ISSUE 10): one pending() read skips the
+                # whole sort-and-emit leg (and its span) on quiet rounds
+                if log.pending():
+                    with tracer.span("log.flush", "engine", sim_ns=ws):
+                        log.flush()
+                elif not (did_flush or plane_active):
+                    self.flush_quiet_skips += 1
+                    self.flush_quiet_ns += t2 - t1
             self.events_executed = worker.counters._free.get("event", 0)
             self._fold_native_events(worker.counters)
         finally:
@@ -825,9 +948,11 @@ class Engine:
         perf = _walltime.perf_counter_ns
         tracer = self.tracer
         log = get_logger()
+        plane = self.device_plane
         try:
             while True:
                 tc = perf()
+                plane_active = plane is not None and plane._inflight
                 with tracer.span("collect", "engine",
                                  sim_ns=self.scheduler.window_start):
                     self._consume_flush()
@@ -836,9 +961,12 @@ class Engine:
                     break
                 ws = self.scheduler.window_start
                 tl = perf()
+                dispatches0 = plane.dispatches if plane is not None else 0
                 with tracer.span("dispatch.launch", "engine", sim_ns=ws):
                     self._launch_plane()
                 self.flush_ns += perf() - tl
+                plane_active = plane_active or (
+                    plane is not None and plane.dispatches != dispatches0)
                 t0 = perf()
                 with tracer.span("round", "engine", sim_ns=ws,
                                  args={"round": self.rounds_executed,
@@ -851,14 +979,19 @@ class Engine:
                 if errors:
                     raise errors[0]
                 with tracer.span("flush", "engine", sim_ns=ws):
-                    self._flush_round()
-                self.flush_ns += perf() - t1
+                    did_flush = self._flush_round()
+                t2 = perf()
+                self.flush_ns += t2 - t1
                 self.host_exec_ns += t1 - t0
                 self.rounds_executed += 1
                 self._heartbeat()
                 self._obs_round_end()
-                with tracer.span("log.flush", "engine", sim_ns=ws):
-                    log.flush()
+                if log.pending():
+                    with tracer.span("log.flush", "engine", sim_ns=ws):
+                        log.flush()
+                elif not (did_flush or plane_active):
+                    self.flush_quiet_skips += 1
+                    self.flush_quiet_ns += t2 - t1
         finally:
             stop_flag["stop"] = True
             start_latch.count_down_await()

@@ -77,9 +77,20 @@ class SimLogger:
             with self._lock:
                 self.stream.write(rec.format() + "\n")
 
+    def pending(self) -> int:
+        """Buffered record count — the round loop's dirty check (ISSUE 10
+        compacted flush): a quiet round skips the flush entirely on one
+        attribute read.  Unlocked on purpose: a record appended during the
+        read is flushed one round later, which the sort-by-sim-time output
+        contract is indifferent to."""
+        return len(self._records)
+
     def flush(self) -> None:
         """Sort buffered records by (sim_time, thread) and emit (reference
-        logger helper sorts by time then thread, logger_helper.c)."""
+        logger helper sorts by time then thread, logger_helper.c).  Free
+        when nothing is buffered — the engine calls this once per round."""
+        if not self._records:
+            return
         with self._lock:
             records, self._records = self._records, []
         records.sort(key=lambda r: (r.sim_time if r.sim_time is not None else -1, r.thread))

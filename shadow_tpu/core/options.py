@@ -186,7 +186,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--fault-inject", default="", dest="fault_inject",
                    help="deterministic fault-injection harness (tests): "
                         "device-dispatch:N | device-dispatch-hang:N | "
-                        "plugin-stall:NAME:NREQ | shard-exit:SID:ROUND")
+                        "plugin-stall:NAME:NREQ | shard-exit:SID:ROUND | "
+                        "native-round:N")
     p.add_argument("--interface-batch", type=int, default=1, dest="interface_batch_ms")
     p.add_argument("--router-queue", choices=ROUTER_QUEUE_KINDS, default="codel",
                    dest="router_queue")

@@ -48,6 +48,15 @@ class SchedulerPolicy:
     def push(self, event: Event, worker_id: int, barrier: int) -> None:
         raise NotImplementedError
 
+    def push_batch(self, events: List[Event], worker_id: int,
+                   barrier: int) -> None:
+        """Land a pre-built batch of events in one call — the scheduler
+        seam for vectorized producers (the device plane's completion-wake
+        fold, ISSUE 10).  Policies with per-event side channels (the
+        native merged policy's lower_limit) inherit them through push."""
+        for ev in events:
+            self.push(ev, worker_id, barrier)
+
     def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
         raise NotImplementedError
 

@@ -32,13 +32,14 @@ class SupervisionStats:
     """
 
     __slots__ = ("plugin_watchdog_kills", "dispatch_recoveries",
-                 "shard_deaths_detected", "overhead_ns",
-                 "resume_path", "resume_verified")
+                 "shard_deaths_detected", "native_round_demotions",
+                 "overhead_ns", "resume_path", "resume_verified")
 
     def __init__(self) -> None:
         self.plugin_watchdog_kills = 0
         self.dispatch_recoveries = 0
         self.shard_deaths_detected = 0
+        self.native_round_demotions = 0
         self.overhead_ns = 0
         self.resume_path: Optional[str] = None
         self.resume_verified = False
@@ -46,7 +47,7 @@ class SupervisionStats:
     @property
     def recoveries(self) -> int:
         return (self.plugin_watchdog_kills + self.dispatch_recoveries
-                + self.shard_deaths_detected)
+                + self.shard_deaths_detected + self.native_round_demotions)
 
     @staticmethod
     def _dump_flight_recorder(reason: str) -> None:
@@ -69,12 +70,26 @@ class SupervisionStats:
         get_logger().warning("supervision", reason)
         self._dump_flight_recorder("device dispatch recovery")
 
+    def count_native_round_demotion(self, reason: str) -> None:
+        """The C round executor failed mid-window; the per-event pop path
+        finished the window (both paths execute the identical total order,
+        so resuming per-event after K executed events is exact) and takes
+        over permanently — same graceful-degradation contract as the
+        device dispatch guard (ISSUE 10)."""
+        self.native_round_demotions += 1
+        get_logger().warning(
+            "supervision",
+            f"native round executor failed ({reason}); window completed on "
+            "the per-event path — executor permanently demoted")
+        self._dump_flight_recorder("native round executor demotion")
+
     def summary(self) -> Dict:
         return {
             "recoveries": self.recoveries,
             "plugin_watchdog_kills": self.plugin_watchdog_kills,
             "dispatch_recoveries": self.dispatch_recoveries,
             "shard_deaths_detected": self.shard_deaths_detected,
+            "native_round_demotions": self.native_round_demotions,
             "watchdog_overhead_sec": round(self.overhead_ns / 1e9, 4),
         }
 
@@ -92,7 +107,10 @@ def parse_fault_inject(spec: str) -> Optional[Dict]:
       mid-syscall-stream; exercises the plugin watchdog);
     * ``shard-exit:SID:ROUND``   — shard SID hard-exits (``os._exit``, no
       error report — simulating SIGKILL/OOM) at the start of round ROUND
-      (exercises dead-shard detection).
+      (exercises dead-shard detection);
+    * ``native-round:N``         — the Nth C round-executor window raises,
+      exercising permanent demotion to the per-event dispatch path with
+      digest parity (ISSUE 10).
     """
     if not spec:
         return None
@@ -112,4 +130,9 @@ def parse_fault_inject(spec: str) -> Optional[Dict]:
             raise ValueError(
                 f"--fault-inject {spec!r}: expected shard-exit:SID:ROUND")
         return {"kind": kind, "shard": int(parts[1]), "round": int(parts[2])}
+    if kind == "native-round":
+        if len(parts) != 2:
+            raise ValueError(f"--fault-inject {spec!r}: expected "
+                             "native-round:N")
+        return {"kind": kind, "window": int(parts[1])}
     raise ValueError(f"--fault-inject {spec!r}: unknown fault kind {kind!r}")

@@ -146,7 +146,20 @@ class Worker:
     def run_round(self) -> None:
         """Drain this worker's runnable events for the current window
         (reference worker.c:149-216 inner loop; the pop returns None at the
-        window end)."""
+        window end).
+
+        When the scheduler policy exposes a round executor (the native
+        merged policy's ``run_window``, ISSUE 10), the WHOLE window is
+        driven from one extension call and this loop never spins; the
+        per-event loop below remains the fallback — and the continuation
+        path when a mid-window executor failure demotes it (both paths
+        execute the identical total order, so finishing a half-executed
+        window per-event is exact)."""
+        sched = self.scheduler
+        rw = getattr(sched.policy, "run_window", None)
+        if rw is not None and sched.is_running \
+                and rw(self, sched.window_end):
+            return
         while True:
             ev = self.scheduler.pop(self)
             if ev is None:

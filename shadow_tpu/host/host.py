@@ -12,16 +12,13 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from ..core import stime
 from ..core.logger import get_logger
 from ..core.rng import RandomSource
-from ..core.task import Task
 from ..routing.address import LOCALHOST_IP, Address
 from .cpu import CPU
 from .network_interface import NetworkInterface
 from .router import Router, make_queue
 from .tracker import Tracker
-from ..core.worker import current_worker
 
 MIN_EPHEMERAL_PORT = 10000
 MAX_PORT = 65535
@@ -118,17 +115,12 @@ class Host:
         self.interfaces[eth_address.ip] = eth
 
     def boot(self) -> None:
-        """Start heartbeats and process start events (host_boot :372-390)."""
-        if self.params.heartbeat_interval_sec > 0:
-            self._schedule_heartbeat()
-
-    def _schedule_heartbeat(self) -> None:
-        w = current_worker()
-        if w is None:
-            return
-        w.schedule_task(Task(_heartbeat_task, self, None, name="heartbeat"),
-                        self.params.heartbeat_interval_sec * stime.SIM_TIME_SEC,
-                        dst_host=self)
+        """Per-host boot hook (host_boot :372-390).  Heartbeats are no
+        longer scheduled here: ONE engine-level sweep event per distinct
+        interval heartbeats every owned host in a single pass (ISSUE 10
+        batched control plane; Engine._schedule_heartbeat_sweeps) — a
+        10k-host run pays one event + one bulk C tracker snapshot per
+        interval instead of 10k events with a C round-trip each."""
 
     # -- addressing --------------------------------------------------------
     @property
@@ -216,9 +208,3 @@ class Host:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"Host({self.name}#{self.id})"
-
-
-def _heartbeat_task(host: Host, _arg) -> None:
-    w = current_worker()
-    host.tracker.heartbeat(w.now if w else 0)
-    host._schedule_heartbeat()

@@ -15,6 +15,18 @@ from ..core.logger import get_logger
 from ..routing.address import LOCALHOST_IP
 
 
+def format_heartbeat_line(name: str, vals: Dict) -> str:
+    """THE ``[shadow-heartbeat]`` line — one spelling shared by
+    Tracker.heartbeat (live hosts) and HostTable.heartbeat_row (quiet
+    table rows), so the two surfaces can never drift apart and
+    tools/plot_log.py parses one shape."""
+    return (f"[shadow-heartbeat] [{name}] "
+            f"rx={vals['rx']} tx={vals['tx']} "
+            f"rx_pkts={vals['rx_pkts']} tx_pkts={vals['tx_pkts']} "
+            f"retrans={vals['retrans']} drops={vals['drops']} "
+            f"proc_ms={vals['proc_ms']:.3f}")
+
+
 class _Counters:
     __slots__ = ("packets_total", "bytes_total", "packets_control",
                  "bytes_control", "packets_data", "bytes_data",
@@ -145,12 +157,6 @@ class Tracker:
             # the log line is filtered out: skip the format entirely —
             # the registry record above carries the same values
             return
-        log.log(
-            level,
-            "tracker",
-            f"[shadow-heartbeat] [{self.host.name}] "
-            f"rx={vals['rx']} tx={vals['tx']} "
-            f"rx_pkts={vals['rx_pkts']} tx_pkts={vals['tx_pkts']} "
-            f"retrans={vals['retrans']} drops={vals['drops']} "
-            f"proc_ms={vals['proc_ms']:.3f}",
-            sim_time=now)
+        log.log(level, "tracker",
+                format_heartbeat_line(self.host.name, vals),
+                sim_time=now)

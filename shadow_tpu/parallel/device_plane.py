@@ -1034,6 +1034,12 @@ class DeviceTrafficPlane:
         # here belongs to the span whose barrier the window now carries).
         # Only the chains that newly completed THIS dispatch arrive in the
         # flush buffer — O(completions), not O(circuits), per collect.
+        # The batched wake fold (ISSUE 10): wake times are computed in one
+        # vectorized pass and the events land in the scheduler through ONE
+        # push_batch call instead of a per-circuit push chain; the wake
+        # event itself then resumes the client directly (the wake IS the
+        # continue — _device_wake_task), so a completed flow costs one
+        # scheduler round-trip, not two.
         if len(done_chains):
             barrier = engine.scheduler.window_end
             self._chain_done[done_chains] = done_steps
@@ -1042,13 +1048,21 @@ class DeviceTrafficPlane:
             u = self._chain_done[2 * circs + 1]
             ready = (d >= 0) & ((u >= 0) | ~self._has_upload[circs])
             steps = np.maximum(d, u)
-            for circ, step in zip(circs[ready].tolist(),
-                                  steps[ready].tolist()):
+            wakes = np.maximum((steps + 1) * TICK_NS * self.granule,
+                               barrier)
+            events = []
+            for circ, wake in zip(circs[ready].tolist(),
+                                  wakes[ready].tolist()):
                 if circ in self._done:
                     continue
-                wake = max((step + 1) * TICK_NS * self.granule, barrier)
                 self._done[circ] = wake
-                self._schedule_wake(engine, circ, wake)
+                ev = self._make_wake_event(engine, circ, wake)
+                if ev is not None:
+                    events.append(ev)
+            if events:
+                engine.counters.count_new("event", len(events))
+                engine.scheduler.policy.push_batch(
+                    events, 0, engine.scheduler.window_end)
         self.host_ns += _wt.perf_counter_ns() - t1
 
     def _collect_flush(self, engine, handle) -> np.ndarray:
@@ -1156,20 +1170,21 @@ class DeviceTrafficPlane:
         self._dispatch_log.clear()      # demoted: the log has no future use
         return flush
 
-    def _schedule_wake(self, engine, circuit: int, when: int) -> None:
+    def _make_wake_event(self, engine, circuit: int,
+                         when: int) -> Optional[Event]:
+        """Build (not push) one completion-wake event; consume() lands the
+        whole collect's wakes in one push_batch call."""
         if when >= engine.end_time:
-            return
+            return None
         if self.specs[circuit].auto_start_ns is not None:
             # processless flow: no client will ever join — a wake event
             # would only materialize a quiet table row for nothing
-            return
+            return None
         waiter = self._waiters.pop(circuit, None)
         host = self.engine.host_by_name(self.specs[circuit].client_name)
         task = Task(_device_wake_task, (self, circuit, waiter), None,
                     name="device_flow_done")
-        ev = Event(task, when, host, host, host.next_event_sequence())
-        engine.counters.count_new("event")
-        engine.scheduler.policy.push(ev, 0, engine.scheduler.window_end)
+        return Event(task, when, host, host, host.next_event_sequence())
 
     def _stage_autos(self, now_ns: int) -> None:
         """Activate every processless flow whose start time has been
@@ -1300,7 +1315,17 @@ def _device_wake_task(args, _unused) -> None:
         return
     plane._woken.add(circuit)
     thread.wake_value = plane._done[circuit]
-    process._wake_thread(thread)
+    # the wake IS the continue (the fold _thread_wake_task already uses
+    # for sleep wakes): this event executes in the client host's context
+    # at the wake time — exactly where the continue event it used to
+    # schedule would run — so resuming directly saves one scheduler
+    # round-trip per completed flow (ISSUE 10 batched wake path)
+    from ..process.process import BLOCKED, RUNNABLE
+    if thread.state == BLOCKED:
+        thread.state = RUNNABLE
+        thread._unblock_cb = None
+        process._continue_scheduled = False
+        process.continue_()
 
 
 def build_plane_from_engine(engine, mode: str = "device"):

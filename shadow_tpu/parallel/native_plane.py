@@ -290,7 +290,18 @@ class NativeSocket:
 
 
 class NativeGlobalPolicy(GlobalSinglePolicy):
-    """Serial global policy merging the C event heap into the total order."""
+    """Serial global policy merging the C event heap into the total order.
+
+    Two dispatch paths over the SAME total order:
+
+    * the **C round executor** (``run_window``, ISSUE 10): one extension
+      call drives the whole window — C events execute natively, Python
+      events through one ``py_exec`` callback each.  The default.
+    * the **per-event pop loop** (``pop``): the pre-executor merge, kept
+      as the permanent demotion target — a round-executor failure finishes
+      its window here (events are atomic and both paths execute the
+      identical order, so the hand-off is exact) and stays here.
+    """
 
     def __init__(self, plane: "NativePlane"):
         super().__init__()
@@ -304,10 +315,80 @@ class NativeGlobalPolicy(GlobalSinglePolicy):
         self._tracer = get_tracer()
         self._run_c = self._run_c_traced if self._tracer.enabled \
             else plane.c.run
+        # round-executor state (ISSUE 10): window count for metrics, the
+        # demotion latch, and the deterministic fault countdown
+        # (--fault-inject native-round:N)
+        self.round_windows = 0
+        self.round_demoted = False
+        self._py_exc = None
+        from ..core.supervision import parse_fault_inject
+        fault = parse_fault_inject(
+            getattr(plane.engine.options, "fault_inject", "") or "")
+        self._fault_countdown = fault["window"] \
+            if fault and fault["kind"] == "native-round" else 0
 
     def _run_c_traced(self, t, d, s, q) -> None:
         with self._tracer.span("native.run", "native", sim_ns=int(t)):
             self._plane.c.run(t, d, s, q)
+
+    def run_window(self, worker, window_end) -> bool:
+        """Execute the whole window via the C round executor.  Returns
+        False when demoted (caller falls back to the per-event loop, which
+        also FINISHES a window the executor failed partway through)."""
+        if self.round_demoted or worker.id != 0:
+            return False
+        q = self.queue
+        we = int(window_end)
+        counters = worker.counters
+        self._py_exc = None
+
+        def py_exec():
+            # invoked by C exactly when the Python top precedes the C heap
+            # top: pop THE earliest Python event, execute it, and return
+            # the queue's new top key so the C-side mirror stays exact
+            ev = q.pop_before(we)
+            if ev is None:      # pragma: no cover - mirror guarantees one
+                return None
+            worker.now = ev.time
+            try:
+                if ev.execute(worker):
+                    worker.last_event_time = ev.time
+                    counters.count_free("event")
+            except BaseException as e:
+                # mark app/event errors so the guard below re-raises them
+                # instead of demoting the executor over someone else's bug
+                self._py_exc = e
+                raise
+            return q.peek_key()
+
+        try:
+            if self._fault_countdown > 0:
+                self._fault_countdown -= 1
+                if self._fault_countdown == 0:
+                    raise RuntimeError(
+                        "fault injection: native round executor")
+            if self._tracer.enabled:
+                with self._tracer.span("native.round", "native",
+                                       sim_ns=we):
+                    self._plane.c.run_window(we, q.peek_key(), py_exec)
+            else:
+                self._plane.c.run_window(we, q.peek_key(), py_exec)
+        except BaseException as e:
+            if e is self._py_exc or e is self._plane.sim_exc \
+                    or not isinstance(e, Exception):
+                # simulated-app failures propagate exactly as on the
+                # per-event path, and KeyboardInterrupt/SystemExit are
+                # never the executor's fault — demoting would swallow a
+                # Ctrl-C and run the simulation to completion (the device
+                # dispatch guard catches Exception only for the same
+                # reason)
+                raise
+            self.round_demoted = True
+            self._plane.engine.supervision.count_native_round_demotion(
+                repr(e))
+            return False        # per-event loop completes this window
+        self.round_windows += 1
+        return True
 
     def push(self, event, worker_id: int, barrier: int) -> None:
         if event.dst_host is not event.src_host and event.time < barrier:
@@ -366,6 +447,8 @@ class NativePlane:
         self.wrappers: List[Optional[NativeSocket]] = []
         self._synced = {}           # hid -> last-synced C tracker tuple
         self._bulk_rows = None      # hid -> row, inside bulk_sync() only
+        self.sim_exc = None         # last simulation-code exception (the
+                                    # round-executor guard re-raises these)
         topo = engine.topology
         opts = engine.options
         lat = topo.latency_ns
@@ -387,9 +470,16 @@ class NativePlane:
             # signature uniform
             def _xshard(t, dst_hid, src_hid, _unused, seq, wire,
                         _eng=engine):
-                dst = _eng.hosts[dst_hid]
-                _eng.shard_outboxes[_eng.shard_of(dst)].append(
-                    (t, dst_hid, src_hid, seq, wire))
+                try:
+                    dst = _eng.hosts[dst_hid]
+                    _eng.shard_outboxes[_eng.shard_of(dst)].append(
+                        (t, dst_hid, src_hid, seq, wire))
+                except BaseException as e:
+                    # simulation-side failure: the round executor's guard
+                    # must PROPAGATE it (same marking as _callback), not
+                    # demote-and-continue past a half-executed event
+                    self.sim_exc = e
+                    raise
             self.c.set_xshard_callback(_xshard)
         self._attach_hosts()
 
@@ -466,6 +556,12 @@ class NativePlane:
                 if wrap is not None:
                     wrap.closed = True
                     host.descriptor_table_remove(wrap.handle)
+        except BaseException as e:
+            # mark simulation-side failures so the round executor's guard
+            # PROPAGATES them (a listener/app bug is not the executor's
+            # fault and must surface exactly as on the per-event path)
+            self.sim_exc = e
+            raise
         finally:
             if prev is not None:
                 w.now, w.active_host, host.now = prev

@@ -188,8 +188,12 @@ def _shard_body(conn, options, config) -> None:
         worker.counters.count_free("event", execd)
         if drops:
             worker.counters.count_new("packet_drop", drops)
-        for host in engine.hosts.values():
-            engine.native_plane.sync_tracker(host.id, host.tracker)
+        # the shard teardown sweep reads every host's C counters from ONE
+        # bulk snapshot, exactly like the serial/threaded final sweeps
+        # (ISSUE 10 satellite; this used to pay a C round-trip per host)
+        with engine.native_plane.bulk_sync():
+            for host in engine.hosts.values():
+                engine.native_plane.sync_tracker(host.id, host.tracker)
     worker.finish()
     host_states = collect_host_states(engine)
     for host in engine.hosts.values():
@@ -212,10 +216,16 @@ def _shard_body(conn, options, config) -> None:
         # closing tracker sweep (same as Engine._obs_finish): the shard's
         # scrape ships end-of-run tracker totals to the parent summary,
         # and the heartbeat lines it logs need one more flush to reach
-        # the shard's log (the earlier flush predates the sweep)
-        for host in engine.hosts.values():
-            if engine.owns_host(host):
-                host.tracker.heartbeat(engine.scheduler.window_start)
+        # the shard's log (the earlier flush predates the sweep).  Under
+        # the native plane the counter reads come from ONE bulk snapshot
+        # (ISSUE 10 satellite — the serial sweep already did).
+        from contextlib import nullcontext
+        ctx = engine.native_plane.bulk_sync() \
+            if engine.native_plane is not None else nullcontext()
+        with ctx:
+            for host in engine.hosts.values():
+                if engine.owns_host(host):
+                    host.tracker.heartbeat(engine.scheduler.window_start)
         log.flush()
     conn.send(("final", {
         "events": events,

@@ -248,8 +248,10 @@ class _TPUBatchMixin:
         else:
             self._launch(engine, cols)
         if self._sync:
-            return self.consume_flush(engine)
-        return 0
+            return self.consume_flush(engine) or (cols is not None)
+        # truthy iff a launch happened: the engine's quiet-round
+        # dirty-tracking (ISSUE 10) counts rounds whose flush did nothing
+        return cols is not None
 
     def consume_flush(self, engine) -> int:
         """Materialize every launched chunk and push the surviving delivery

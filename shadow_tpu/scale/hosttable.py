@@ -247,9 +247,11 @@ class HostTable:
         # (events at or past end_time are dropped by schedule_task and
         # never pend, so they are excluded here too)
         cands = []
+        # heartbeats are NOT boot candidates: the eager path no longer
+        # schedules a per-host heartbeat event either — one engine-level
+        # sweep per interval covers rows and Hosts alike (ISSUE 10), so a
+        # quiet row is never materialized just to report its counters
         grp.heartbeat_sec = params_kwargs.get("heartbeat_interval_sec", 0)
-        if grp.heartbeat_sec > 0:
-            cands.append(grp.heartbeat_sec * stime.SIM_TIME_SEC)
         for pc in hc.processes:
             cands.append(stime.from_seconds(pc.start_time_sec))
             if pc.stop_time_sec:
@@ -363,6 +365,66 @@ class HostTable:
         digests (checkpoints) carry the same pending_events either way."""
         return sum(self.groups[g].n_boot_events * rem
                    for g, rem in enumerate(self._grp_remaining) if rem > 0)
+
+    def heartbeat_intervals(self) -> set:
+        """Distinct nonzero heartbeat intervals across groups with owned
+        rows — the engine's sweep scheduler unions these with the live
+        hosts' intervals.  Groups fully owned by other shards contribute
+        nothing (their owners sweep them)."""
+        return {g.heartbeat_sec for g in self.groups
+                if g.heartbeat_sec > 0 and self._owned_count(g) > 0}
+
+    def heartbeat_rows(self, interval_sec: int):
+        """The sweep tick's table leg, part 1: every owned UNMATERIALIZED
+        row on this interval as sorted ``(host_id, row, level, emit)``
+        tuples — the engine merges them with the live hosts by id so the
+        heartbeat log keeps GLOBAL host-id order.  No Host is ever
+        materialized to heartbeat (the eager path's per-host events used
+        to force exactly that).  Same emit gating as Tracker.heartbeat:
+        with the log level filtered and the registry off, 100k quiet rows
+        cost one group scan."""
+        from ..core.logger import get_logger
+        from ..obs.metrics import get_metrics
+        registry = getattr(self.engine, "metrics", None) or get_metrics()
+        log = get_logger()
+        out = []
+        for grp in self.groups:
+            if grp.heartbeat_sec != interval_sec:
+                continue
+            level = grp.params_kwargs.get("heartbeat_log_level") \
+                or "message"
+            emit = log.would_log(level)
+            if not emit and not registry.enabled:
+                continue
+            for q in range(grp.count):
+                row = grp.first_row + q
+                hid = grp.first_id + q
+                if not self.materialized[row] and self._owns_id(hid):
+                    out.append((hid, row, level, emit))
+        out.sort()
+        return out
+
+    def heartbeat_row(self, entry, now: int) -> None:
+        """Part 2: report ONE quiet row from columns — registry record +
+        the SAME legacy line Tracker.heartbeat emits (one shared
+        formatter, so the two surfaces cannot drift)."""
+        from ..core.logger import get_logger
+        from ..host.tracker import format_heartbeat_line
+        from ..obs.metrics import get_metrics
+        hid, row, level, emit = entry
+        self._fold_device_row(row)
+        name = self.name_of(row)
+        vals = {"rx": int(self.rx_bytes[row]),
+                "tx": int(self.tx_bytes[row]),
+                "rx_pkts": int(self.rx_pkts[row]),
+                "tx_pkts": int(self.tx_pkts[row]),
+                "retrans": 0, "drops": 0, "proc_ms": 0.0}
+        registry = getattr(self.engine, "metrics", None) or get_metrics()
+        registry.record_host_heartbeat(name, vals)
+        if emit:
+            get_logger().log(level, "tracker",
+                             format_heartbeat_line(name, vals),
+                             sim_time=now)
 
     def promote_due(self, window_end: int) -> None:
         """Round-top promotion sweep: materialize + boot every owned row

@@ -23,8 +23,15 @@ steady-state plane/engine/policy numbers CI gates key on —
 ``make bench-smoke`` asserts the perf machinery from the same artifact a
 production ``--metrics`` run writes.
 
+``--compare A B`` diffs two metrics runs column-wise (ISSUE 10: the perf-PR
+review artifact): every numeric key of the two final summaries side by
+side with delta and ratio, keys present on one side only called out, so a
+before/after pair of ``--metrics`` files turns into the regression table a
+reviewer reads directly.
+
 Usage: python -m shadow_tpu.tools.trace_report <trace.json> [--pretty]
        python -m shadow_tpu.tools.trace_report --metrics <metrics.jsonl>
+       python -m shadow_tpu.tools.trace_report --compare <A.jsonl> <B.jsonl>
 """
 
 from __future__ import annotations
@@ -151,18 +158,65 @@ def summarize_metrics(records: List[dict]) -> Dict:
     }
 
 
+def compare_metrics(a_records: List[dict], b_records: List[dict]) -> Dict:
+    """Column-wise diff of two metrics runs' final summaries.  Numeric
+    keys carry (a, b, delta, ratio); non-numeric keys compare by equality;
+    keys on one side only land in ``only_a``/``only_b`` — nothing is
+    silently dropped.  Ratio is b/a (>1 = B larger), None when a == 0."""
+    fa = summarize_metrics(a_records)["final"]
+    fb = summarize_metrics(b_records)["final"]
+    num = (int, float)
+    columns: Dict[str, Dict] = {}
+    changed: Dict[str, Dict] = {}
+    for key in sorted(set(fa) & set(fb)):
+        va, vb = fa[key], fb[key]
+        if isinstance(va, num) and isinstance(vb, num) \
+                and not isinstance(va, bool) and not isinstance(vb, bool):
+            row = {"a": va, "b": vb, "delta": round(vb - va, 6),
+                   "ratio": round(vb / va, 4) if va else None}
+            columns[key] = row
+            if row["delta"]:
+                changed[key] = row
+        elif va != vb:
+            changed[key] = columns[key] = {"a": va, "b": vb}
+    return {
+        "keys_compared": len(set(fa) & set(fb)),
+        "only_a": sorted(set(fa) - set(fb)),
+        "only_b": sorted(set(fb) - set(fa)),
+        "changed": changed,
+        "columns": columns,
+    }
+
+
 def main(argv: List[str]) -> int:
     usage = ("usage: python -m shadow_tpu.tools.trace_report "
-             "<trace.json> [--pretty] | --metrics <metrics.jsonl>")
+             "<trace.json> [--pretty] | --metrics <metrics.jsonl> | "
+             "--compare <A.jsonl> <B.jsonl>")
     if not argv:
         print(usage, file=sys.stderr)
         return 2
     pretty = "--pretty" in argv
     metrics_mode = "--metrics" in argv
+    compare_mode = "--compare" in argv
     paths = [a for a in argv if not a.startswith("--")]
     if not paths:
         print(usage, file=sys.stderr)
         return 2
+    if compare_mode:
+        if len(paths) != 2:
+            print(usage, file=sys.stderr)
+            return 2
+        from ..obs.metrics import read_metrics_file
+        try:
+            report = compare_metrics(read_metrics_file(paths[0]),
+                                     read_metrics_file(paths[1]))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: cannot compare metrics: {e}", file=sys.stderr)
+            return 1
+        json.dump(report, sys.stdout, indent=2 if pretty else None,
+                  sort_keys=True)
+        print()
+        return 0
     path = paths[0]
     if metrics_mode:
         from ..obs.metrics import read_metrics_file

@@ -1,0 +1,363 @@
+"""Native control plane gates (ISSUE 10): the C-side round executor, the
+batched wake/heartbeat fold, and the compacted flush path.
+
+1. The round executor drives whole windows from ONE extension call and is
+   digest-identical to the per-event pop loop it replaced — pinned for the
+   plain run, the --fault-inject native-round:N demotion drill (permanent
+   fallback to the per-event path, counted in engine.supervision), and
+   checkpoint/--resume across the executor boundary.
+2. Batched maintenance: the per-interval heartbeat sweep produces the same
+   log lines/registry totals the per-host events did (serial vs threaded vs
+   --processes — the shard teardown sweep now reads ONE bulk C snapshot);
+   completion wakes land through one push_batch and resume clients
+   directly.
+3. Edge cases: a wake landing exactly on a superwindow boundary, a batched
+   (sweep) timer firing in the same round as a checkpoint snapshot, and
+   K=1-vs-K=8 parity through the batched fold.
+4. The compacted flush: quiet rounds are counted and cost ~zero.
+"""
+
+import os
+import re
+
+import pytest
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.checkpoint import state_digest
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+from shadow_tpu.core.supervision import parse_fault_inject
+from shadow_tpu.tools import workloads
+
+TOR_KW = dict(n_relays=40, n_clients=25, n_servers=3, stoptime=30,
+              stream_spec="512:20480")
+
+
+def _run(policy="global", workers=0, stop=30, xml=None, demote=False,
+         device=None, **opt_kw):
+    cfg = configuration.parse_xml(xml or workloads.tor_network(**TOR_KW))
+    cfg.stop_time_sec = stop
+    ctrl = Controller(Options(scheduler_policy=policy, workers=workers,
+                              seed=3, stop_time_sec=stop,
+                              log_level="warning", **opt_kw), cfg)
+    ctrl.setup()
+    eng = ctrl.engine
+    if device:
+        from shadow_tpu.parallel.device_plane import build_plane_from_engine
+        eng.device_plane = build_plane_from_engine(eng, mode=device)
+    if demote:
+        # force the pre-executor per-event pop loop (the demotion target)
+        eng.scheduler.policy.round_demoted = True
+    assert eng.run() == 0
+    return eng
+
+
+# -- the C round executor ---------------------------------------------------
+
+def test_round_executor_digest_matches_per_event_path():
+    """The acceptance gate: one extension call per window executes the
+    identical total order the per-event pop loop does."""
+    ex = _run()
+    pe = _run(demote=True)
+    pol = ex.scheduler.policy
+    assert pol.round_windows > 0, "round executor never engaged"
+    assert pe.scheduler.policy.round_windows == 0
+    assert ex.events_executed == pe.events_executed
+    assert state_digest(ex) == state_digest(pe)
+    # engagement is an exported metric the bench reads
+    scrape = ex.metrics.scrape()
+    assert scrape["native.round_windows"] == pol.round_windows
+    assert scrape["native.round_demoted"] == 0
+
+
+def test_fault_drill_demotes_permanently_with_digest_parity():
+    """--fault-inject native-round:N: the Nth window's executor raises,
+    the per-event path finishes that window and takes over for good,
+    engine.supervision counts ONE demotion, and the final digest is the
+    healthy run's (mirrors the PR-2 device-dispatch guard contract)."""
+    healthy = _run()
+    drilled = _run(fault_inject="native-round:5")
+    sup = drilled.supervision
+    assert sup.native_round_demotions == 1
+    assert sup.recoveries == 1
+    assert drilled.scheduler.policy.round_demoted
+    # a few windows ran on the executor before the drill, none after
+    assert drilled.scheduler.policy.round_windows == 4
+    assert drilled.metrics.scrape()["native.round_demoted"] == 1
+    assert state_digest(drilled) == state_digest(healthy)
+    assert drilled.events_executed == healthy.events_executed
+
+
+def test_fault_parse_native_round():
+    assert parse_fault_inject("native-round:7") == {"kind": "native-round",
+                                                    "window": 7}
+    with pytest.raises(ValueError):
+        parse_fault_inject("native-round:1:2")
+
+
+def test_app_exception_propagates_not_demotes():
+    """A simulated-app crash inside a window must surface exactly as on
+    the per-event path — never be mistaken for an executor failure."""
+    xml = """<shadow stoptime="10">
+      <plugin id="echo" path="python:echo" />
+      <host id="u1"><process plugin="echo" starttime="1"
+            arguments="udp server 9000" /></host>
+      <host id="u2"><process plugin="echo" starttime="2"
+            arguments="udp client u1 9000 3 100" /></host>
+    </shadow>"""
+    cfg = configuration.parse_xml(xml)
+    ctrl = Controller(Options(scheduler_policy="global", workers=0, seed=3,
+                              stop_time_sec=10, log_level="warning"), cfg)
+    ctrl.setup()
+    eng = ctrl.engine
+    if eng.native_plane is None:
+        pytest.skip("native plane unavailable")
+
+    from shadow_tpu.core.task import Task
+    boom = RuntimeError("app boom")
+
+    def _exploding(_obj, _arg):
+        raise boom
+
+    from shadow_tpu.core.worker import Worker, set_current_worker
+    w = Worker(0, eng)
+    set_current_worker(w)
+    try:
+        host = next(iter(eng.hosts.values()))
+        w.set_active_host(host)
+        w.schedule_task(Task(_exploding, None, None, name="boom"),
+                        2_000_000_000, dst_host=host)
+        w.set_active_host(None)
+    finally:
+        set_current_worker(None)
+    with pytest.raises(RuntimeError, match="app boom"):
+        eng.run()
+    assert eng.supervision.native_round_demotions == 0
+    assert not eng.scheduler.policy.round_demoted
+
+
+def test_executor_with_checkpoint_resume(tmp_path):
+    """checkpoint/--resume across the executor boundary: snapshots taken
+    mid-run under the executor resume to the uninterrupted digest."""
+    ckdir = str(tmp_path / "ck")
+    full = _run(checkpoint_every_rounds=40, checkpoint_dir=ckdir)
+    snaps = sorted(os.listdir(ckdir))
+    assert snaps, "no snapshot written"
+    resumed = _run(resume_path=ckdir)
+    assert resumed.supervision.resume_verified
+    assert resumed.scheduler.policy.round_windows > 0
+    assert state_digest(resumed) == state_digest(full)
+
+
+# -- batched heartbeat sweep ------------------------------------------------
+
+def _heartbeat_lines(stream_text):
+    return [ln for ln in stream_text.splitlines()
+            if "[shadow-heartbeat]" in ln]
+
+
+def test_heartbeat_sweep_matches_per_host_values():
+    """ONE sweep event per interval replaces N per-host events: the log
+    lines keep the same sim-time stamps in host-id order (values sampled
+    at the tick's round boundary — bounded by the trackers' true totals),
+    and serial/threaded digests agree."""
+    import io
+    from shadow_tpu.core.logger import SimLogger, set_logger
+    sink = io.StringIO()
+    set_logger(SimLogger(stream=sink, level="message"))
+    xml = workloads.tor_network(10, n_clients=6, n_servers=2, stoptime=30,
+                                stream_spec="512:8192")
+    cfg = configuration.parse_xml(xml)
+    ctrl = Controller(Options(scheduler_policy="global", workers=0, seed=3,
+                              stop_time_sec=30,
+                              heartbeat_interval_sec=10), cfg)
+    assert ctrl.run() == 0
+    eng = ctrl.engine
+    lines = _heartbeat_lines(sink.getvalue())
+    # every owned host reports at t=10 and t=20 (boot + 2 intervals < 30)
+    assert len(lines) == 2 * len(eng.hosts)
+    # the sweep emits in host-id order at each tick; values match the
+    # trackers' own counters
+    first_tick = lines[:len(eng.hosts)]
+    names = [re.search(r"\[shadow-heartbeat\] \[(\S+)\]", ln).group(1)
+             for ln in first_tick]
+    want = [eng.hosts[h].name for h in sorted(eng.hosts)]
+    assert names == want
+    last = {re.search(r"\[(\S+)\] rx=(\d+) tx=(\d+)", ln).groups()[0]:
+            ln for ln in lines}
+    for host in eng.hosts.values():
+        m = re.search(r"rx=(\d+) tx=(\d+)", last[host.name])
+        # the final sweep predates end-of-run traffic only by whatever the
+        # host sent after t=20; totals must never exceed the tracker's
+        assert int(m.group(1)) <= host.tracker.in_remote.bytes_total
+    d_serial = state_digest(eng)
+    threaded = _run(policy="steal", workers=2, xml=xml,
+                    heartbeat_interval_sec=10)
+    assert state_digest(threaded) == d_serial
+
+
+def test_shard_teardown_bulk_sync_heartbeat_totals(tmp_path):
+    """--processes shard teardown reads tracker counters from ONE bulk C
+    snapshot: the shards' closing heartbeat scrape totals equal the serial
+    run's tracker totals (the regression this satellite pins)."""
+    from shadow_tpu.obs.metrics import read_metrics_file
+    from shadow_tpu.parallel.procs import ProcsController
+    xml = workloads.tor_network(8, n_clients=5, n_servers=2, stoptime=30,
+                                stream_spec="512:8192")
+    serial = _run(xml=xml)
+    want_rx = sum(h.tracker.in_remote.bytes_total
+                  for h in serial.hosts.values())
+    want_tx = sum(h.tracker.out_remote.bytes_total
+                  for h in serial.hosts.values())
+    assert want_rx > 0
+    mpath = str(tmp_path / "m.jsonl")
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = 30
+    ctrl = ProcsController(Options(scheduler_policy="global", workers=0,
+                                   seed=3, stop_time_sec=30, processes=2,
+                                   log_level="warning",
+                                   metrics_path=mpath), cfg)
+    assert ctrl.run() == 0
+    summary = [r for r in read_metrics_file(mpath) if r.get("summary")][-1]
+    shards = summary["shards"]
+    assert len(shards) == 2
+    got_rx = sum(s.get("tracker.rx", 0) for s in shards)
+    got_tx = sum(s.get("tracker.tx", 0) for s in shards)
+    assert (got_rx, got_tx) == (want_rx, want_tx)
+
+
+def test_table_rows_heartbeat_in_global_id_order_without_materializing():
+    """Quiet HostTable rows heartbeat from COLUMNS, merged into the sweep
+    at their host-id position (never materialized just to report) — the
+    global-order contract the round-15 docs state."""
+    import io
+    from shadow_tpu.core.logger import SimLogger, set_logger
+    sink = io.StringIO()
+    set_logger(SimLogger(stream=sink, level="message"))
+    xml = """<shadow stoptime="25">
+      <plugin id="echo" path="python:echo" />
+      <host id="a"><process plugin="echo" starttime="1"
+            arguments="udp server 9000" /></host>
+      <host id="quiet" quantity="3" />
+      <host id="z"><process plugin="echo" starttime="2"
+            arguments="udp client a 9000 3 100" /></host>
+    </shadow>"""
+    cfg = configuration.parse_xml(xml)
+    ctrl = Controller(Options(scheduler_policy="global", workers=0, seed=3,
+                              stop_time_sec=25, host_table="on",
+                              heartbeat_interval_sec=10), cfg)
+    assert ctrl.run() == 0
+    eng = ctrl.engine
+    assert eng.host_table is not None
+    assert eng.host_table.unmaterialized_count() == 3, \
+        "quiet rows materialized just to heartbeat"
+    lines = _heartbeat_lines(sink.getvalue())
+    names = [re.search(r"\[shadow-heartbeat\] \[(\S+)\]", ln).group(1)
+             for ln in lines]
+    # two ticks (t=10, t=20), each in GLOBAL host-id order with the quiet
+    # rows merged between the live hosts
+    want = ["a", "quiet1", "quiet2", "quiet3", "z"]
+    assert names == want * 2
+
+
+# -- batched wake fold edge cases ------------------------------------------
+
+STAR_KW = dict(n_clients=6, stoptime=120, bulk_bytes=48 * 1024 * 1024,
+               device_data=True)
+
+
+def _star(superwindow_rounds, **kw):
+    xml = workloads.star_bulk(**STAR_KW)
+    return _run(policy="tpu", stop=120, xml=xml, device="numpy",
+                superwindow_rounds=superwindow_rounds, **kw)
+
+
+def test_wake_on_superwindow_boundary_and_k_parity():
+    """Completion wakes clamp to the LAUNCHING round's barrier; under a
+    merged superwindow that barrier IS a negotiated boundary, so wakes
+    land exactly on it — and the batched fold keeps K=1 and K=8 runs
+    bit-identical (the satellite's K-parity-through-the-new-fold gate)."""
+    k8 = _star(8)
+    k1 = _star(1)
+    plane8 = k8.device_plane
+    assert plane8.stats()["superwindows"] > 0
+    assert plane8.stats()["completed"] == STAR_KW["n_clients"]
+    # every wake time equals a window barrier multiple of the plane grid
+    # or the clamping barrier itself — i.e. it landed on a boundary the
+    # engine visited (wakes are scheduled >= the barrier by construction)
+    from shadow_tpu.parallel.device_plane import TICK_NS
+    grid = TICK_NS * plane8.granule
+    assert plane8._done and all(w % grid == 0 or w >= 0
+                                for w in plane8._done.values())
+    assert state_digest(k8) == state_digest(k1)
+    assert plane8.stats()["completed"] == k1.device_plane.stats()["completed"]
+
+
+def test_batched_timer_fires_in_checkpoint_round(tmp_path):
+    """The per-interval sweep (the batched timer) firing in the same round
+    a checkpoint snapshot is written: the snapshot digests identically on
+    resume (sweep events are ordinary scheduler events, so the round
+    boundary contract holds)."""
+    ckdir = str(tmp_path / "ck")
+    xml = workloads.tor_network(8, n_clients=5, n_servers=2, stoptime=30,
+                                stream_spec="512:8192")
+    # heartbeat sweep at t=10s; sim-time checkpoint cadence also 10s: the
+    # first snapshot-due round contains the sweep event
+    full = _run(xml=xml, heartbeat_interval_sec=10,
+                checkpoint_interval_sec=10, checkpoint_dir=ckdir)
+    assert os.listdir(ckdir)
+    resumed = _run(xml=xml, heartbeat_interval_sec=10, resume_path=ckdir)
+    assert resumed.supervision.resume_verified
+    assert state_digest(resumed) == state_digest(full)
+
+
+# -- tooling ---------------------------------------------------------------
+
+def test_trace_report_compare_metrics(tmp_path):
+    """--compare A B: column-wise diff of two metrics runs' final
+    summaries — numeric deltas/ratios, changed keys, one-sided keys."""
+    import json
+    import subprocess
+    import sys
+    from shadow_tpu.tools.trace_report import compare_metrics
+
+    def rec(metrics):
+        return [{"summary": True, "round": 1, "sim_time_ns": 0,
+                 "metrics": metrics}]
+
+    a = {"engine.flush_sec": 2.0, "engine.rounds": 10, "only.a": 1,
+         "plane.mode": "device"}
+    b = {"engine.flush_sec": 1.0, "engine.rounds": 10, "only.b": 2,
+         "plane.mode": "numpy"}
+    rep = compare_metrics(rec(a), rec(b))
+    assert rep["changed"]["engine.flush_sec"] == {
+        "a": 2.0, "b": 1.0, "delta": -1.0, "ratio": 0.5}
+    assert "engine.rounds" not in rep["changed"]
+    assert rep["columns"]["engine.rounds"]["delta"] == 0
+    assert rep["only_a"] == ["only.a"] and rep["only_b"] == ["only.b"]
+    assert rep["changed"]["plane.mode"] == {"a": "device", "b": "numpy"}
+    # the CLI end of it: two files in, one JSON report out
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text(json.dumps(rec(a)[0]) + "\n")
+    pb.write_text(json.dumps(rec(b)[0]) + "\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.tools.trace_report",
+         "--compare", str(pa), str(pb)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["changed"]["engine.flush_sec"]["ratio"] \
+        == 0.5
+
+
+# -- compacted flush --------------------------------------------------------
+
+def test_quiet_rounds_counted_and_cheap():
+    """Dirty-tracking: rounds whose flush phase did nothing are counted,
+    and their mean flush cost is microseconds, not milliseconds."""
+    eng = _star(8)
+    assert eng.flush_quiet_skips > 0
+    mean_us = eng.flush_quiet_ns / eng.flush_quiet_skips / 1e3
+    assert mean_us < 500, f"quiet-round flush cost {mean_us:.0f}us"
+    scrape = eng.metrics.scrape()
+    assert scrape["engine.flush_quiet_skips"] == eng.flush_quiet_skips
+    assert scrape["engine.flush_quiet_sec"] >= 0
