@@ -8,10 +8,10 @@ PY ?= python
 # package-wide either way).
 BASE ?= HEAD
 
-.PHONY: lint lint-diff spec test bench-smoke bench-multichip native \
-	sanitize sanitize-thread
+.PHONY: lint lint-diff gen gen-check spec test bench-smoke bench-multichip \
+	native sanitize sanitize-thread
 
-lint:
+lint: gen-check
 	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu
 	$(PY) -m shadow_tpu.analysis.simrace shadow_tpu
 	$(PY) -m shadow_tpu.analysis.simtwin shadow_tpu native
@@ -21,9 +21,28 @@ lint-diff:
 	$(PY) -m shadow_tpu.analysis.simrace shadow_tpu --diff $(BASE)
 	$(PY) -m shadow_tpu.analysis.simtwin shadow_tpu native --diff $(BASE)
 
-# regenerate the checked-in cross-plane protocol IR (byte-stable)
+# ISSUE 11: spec/protocol_spec.json is AUTHORITATIVE.  `make gen`
+# materializes its surfaces into the fenced regions of all three planes
+# (simgen --write) and refreshes the extracted read-back IR
+# (spec/protocol.json, still byte-stable).  `make gen-check` fails on a
+# stale or hand-edited region and on any read-back IR drift; it runs
+# inside `make lint` so the gate is part of every lint pass.  (The
+# read-back and the simtwin step each build the cross-plane TwinModel —
+# a deliberate ~1-2s duplication: separate processes, independently
+# trustworthy gates.)
+gen:
+	$(PY) -m shadow_tpu.analysis.simgen --write
+	$(PY) -m shadow_tpu.analysis.simtwin --emit-spec spec/protocol.json --force
+
+gen-check:
+	$(PY) -m shadow_tpu.analysis.simgen --check
+
+# retired: the extracted IR is no longer the thing you regenerate by hand
 spec:
-	$(PY) -m shadow_tpu.analysis.simtwin --emit-spec spec/protocol.json
+	@echo "make spec is retired: spec/protocol_spec.json is authoritative."
+	@echo "Edit the spec, then run \`make gen\` (simgen --write +"
+	@echo "simtwin --emit-spec); \`make gen-check\` verifies."
+	@exit 1
 
 test:
 	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'

@@ -35,17 +35,18 @@
 
 namespace {
 
-// ---- constants (mirror core/defs.py / descriptor/tcp.py) -------------------
+// >>> simgen:begin region=c-protocol-constants spec=4b732374c3c9 body=79a2955fdd12
+// ---- constants (mirror core/defs.py / descriptor/tcp.py) ------------------
 constexpr int64_t SIM_MS = 1000000LL;
 constexpr int64_t SIM_SEC = 1000000000LL;
 constexpr int HDR_UDP = 42;
 constexpr int HDR_TCP = 66;
 constexpr int64_t MTU = 1500;
 constexpr int64_t MSS = 1500 - (66 - 14);          // 1448
-constexpr int64_t RTO_INIT = 1000 * SIM_MS;
-constexpr int64_t RTO_MIN = 200 * SIM_MS;
-constexpr int64_t RTO_MAX = 120000 * SIM_MS;
-constexpr int64_t TIME_WAIT_NS = 60 * SIM_SEC;
+constexpr int64_t RTO_INIT = 1000000000LL;
+constexpr int64_t RTO_MIN = 200000000LL;
+constexpr int64_t RTO_MAX = 120000000000LL;
+constexpr int64_t TIME_WAIT_NS = 60000000000LL;
 constexpr int MAX_SYN_RETRIES = 6;
 constexpr int MAX_RETRIES = 15;                    // Linux tcp_retries2
 constexpr int MAX_SACK_BLOCKS = 4;
@@ -54,8 +55,8 @@ constexpr int64_t WMEM_MAX = 4194304;
 constexpr int64_t REFILL_INTERVAL = 1000000LL;     // 1 ms
 constexpr int64_t CAPACITY_FACTOR = 1;
 constexpr int64_t DGRAM_MAX = 65507;
-constexpr int64_t CODEL_TARGET = 10 * SIM_MS;
-constexpr int64_t CODEL_INTERVAL = 100 * SIM_MS;
+constexpr int64_t CODEL_TARGET = 10000000LL;
+constexpr int64_t CODEL_INTERVAL = 100000000LL;
 constexpr int CODEL_HARD_LIMIT = 1000;
 constexpr int STATIC_CAPACITY = 1024;
 
@@ -63,7 +64,9 @@ constexpr int STATIC_CAPACITY = 1024;
 enum { S_ACTIVE = 1, S_READABLE = 2, S_WRITABLE = 4, S_CLOSED = 8 };
 // TCP header flags (routing/packet.py)
 enum { F_RST = 2, F_SYN = 4, F_ACK = 8, F_FIN = 16 };
+// <<< simgen:end region=c-protocol-constants
 
+// >>> simgen:begin region=c-tcp-states spec=4b732374c3c9 body=bd57e0fc733c
 enum TcpState {
   ST_CLOSED = 0, ST_LISTEN, ST_SYN_SENT, ST_SYN_RECEIVED, ST_ESTABLISHED,
   ST_FIN_WAIT_1, ST_FIN_WAIT_2, ST_CLOSING, ST_TIME_WAIT, ST_CLOSE_WAIT,
@@ -74,6 +77,27 @@ const char *const STATE_NAMES[] = {
   "fin_wait_1", "fin_wait_2", "closing", "time_wait", "close_wait",
   "last_ack",
 };
+// the spec's legal transition table; 255 = any state ('?')
+struct TcpTransition { unsigned char from, to; };
+constexpr TcpTransition TCP_TRANSITIONS[] = {
+  {255, ST_CLOSED},
+  {255, ST_ESTABLISHED},
+  {255, ST_LISTEN},
+  {255, ST_SYN_RECEIVED},
+  {255, ST_SYN_SENT},
+  {255, ST_TIME_WAIT},
+  {ST_CLOSE_WAIT, ST_LAST_ACK},
+  {ST_ESTABLISHED, ST_CLOSE_WAIT},
+  {ST_ESTABLISHED, ST_FIN_WAIT_1},
+  {ST_FIN_WAIT_1, ST_CLOSING},
+  {ST_FIN_WAIT_1, ST_FIN_WAIT_2},
+  {ST_FIN_WAIT_1, ST_TIME_WAIT},
+  {ST_SYN_RECEIVED, ST_ESTABLISHED},
+  {ST_SYN_RECEIVED, ST_FIN_WAIT_1},
+};
+constexpr int TCP_TRANSITION_COUNT =
+    (int)(sizeof(TCP_TRANSITIONS) / sizeof(TCP_TRANSITIONS[0]));
+// <<< simgen:end region=c-tcp-states
 
 enum Err {
   E_NONE = 0, E_CONNREFUSED, E_CONNRESET, E_TIMEDOUT, E_CONNABORTED,
@@ -217,7 +241,17 @@ struct Tally {
 };
 
 // ---- congestion control (descriptor/tcp_cong.py) ---------------------------
-enum CcKind { CC_RENO = 0, CC_AIMD = 1, CC_CUBIC = 2 };
+// >>> simgen:begin region=c-congestion-params spec=4b732374c3c9 body=8264260e3de1
+enum CcKind { CC_RENO = 0, CC_AIMD = 1, CC_CUBIC = 2, CC_CUBICX = 3 };
+// CUBIC coefficient families (RFC 9438 §4.1 / §4.6)
+constexpr double CUBIC_C = 0.4;
+constexpr double CUBIC_BETA = 0.7;
+constexpr double CUBICX_C = 0.6;
+constexpr double CUBICX_BETA = 0.85;
+inline bool cc_is_cubic(int kind) { return kind == CC_CUBIC || kind == CC_CUBICX; }
+inline double cc_c(int kind) { return kind == CC_CUBICX ? CUBICX_C : CUBIC_C; }
+inline double cc_beta(int kind) { return kind == CC_CUBICX ? CUBICX_BETA : CUBIC_BETA; }
+// <<< simgen:end region=c-congestion-params
 
 struct Cong {
   int kind = CC_RENO;
@@ -246,9 +280,10 @@ struct Cong {
   }
 
   void enter_recovery(int64_t snd_nxt) {
-    if (kind == CC_CUBIC) {
+    if (cc_is_cubic(kind)) {
       w_max = (double)cwnd;
-      ssthresh = std::max<int64_t>((int64_t)((double)cwnd * 0.7), 2 * mss);
+      ssthresh =
+          std::max<int64_t>((int64_t)((double)cwnd * cc_beta(kind)), 2 * mss);
       cwnd = ssthresh;
       in_fast_recovery = true;
       recovery_point = snd_nxt;
@@ -268,16 +303,17 @@ struct Cong {
   }
 
   void congestion_avoidance(int64_t acked_bytes, int64_t now_ns) {
-    if (kind == CC_CUBIC) {
+    if (cc_is_cubic(kind)) {
       if (epoch_start_ns == 0) {
         epoch_start_ns = now_ns;
         double wm = std::max(w_max, (double)cwnd);
         k = (wm > (double)cwnd)
-                ? pow((wm - (double)cwnd) / (0.4 * (double)mss), 1.0 / 3.0)
+                ? pow((wm - (double)cwnd) / (cc_c(kind) * (double)mss),
+                      1.0 / 3.0)
                 : 0.0;
       }
       double t = (double)(now_ns - epoch_start_ns) / 1e9;
-      double target = w_max + 0.4 * (double)mss * pow(t - k, 3.0);
+      double target = w_max + cc_c(kind) * (double)mss * pow(t - k, 3.0);
       if (target > (double)cwnd) {
         cwnd += std::max<int64_t>(mss / 8,
                                   (int64_t)((target - (double)cwnd) / 8.0));
@@ -319,12 +355,12 @@ struct Cong {
   }
 
   void on_timeout() {
-    if (kind == CC_CUBIC) w_max = (double)cwnd;
+    if (cc_is_cubic(kind)) w_max = (double)cwnd;
     ssthresh = std::max<int64_t>(cwnd / 2, 2 * mss);
     cwnd = mss;
     in_fast_recovery = false;
     avoid_acc = 0;
-    if (kind == CC_CUBIC) epoch_start_ns = 0;
+    if (cc_is_cubic(kind)) epoch_start_ns = 0;
   }
 };
 
@@ -637,6 +673,7 @@ struct HostS {
   // params
   int64_t recv_buf_size = 0, send_buf_size = 0;
   bool autotune_recv = true, autotune_send = true;
+  int cc_kind = -1;    // per-host congestion-control override; -1 = plane
   // tracker
   TrackCtr in_local, in_remote, out_local, out_remote;
   int64_t drops = 0;
@@ -740,6 +777,11 @@ struct Plane {
 
   HostS *H(int32_t hid) { return (*hosts)[hid]; }
   Sock *S(int32_t sid) { return (*socks)[sid]; }
+  // per-host CC selection (<host tcpcc="...">) beats the plane default
+  int cc_for(int32_t hid) {
+    HostS *h = H(hid);
+    return (h != nullptr && h->cc_kind >= 0) ? h->cc_kind : cc_kind;
+  }
 };
 
 // pushed events MUST claim their seq at push time from the src host
@@ -1219,7 +1261,8 @@ int tcp_connect(Plane *pl, Sock *s, int64_t dst_ip, int64_t dst_port,
     iface_disassociate(pl, f, K_TCP, s->bound_port, 0, 0);
     iface_associate(f, s, s->bound_port, dst_ip, dst_port);
   }
-  s->cong.init(pl->cc_kind, MSS, pl->cc_ssthresh, pl->cc_init_segments);
+  s->cong.init(pl->cc_for(s->hid), MSS, pl->cc_ssthresh,
+               pl->cc_init_segments);
   s->has_cong = true;
   s->snd_wnd = std::max<int64_t>(1, pl->cc_init_segments) * MSS;
   s->iss = 0;
@@ -1574,7 +1617,8 @@ bool tcp_listen_process(Plane *pl, Sock *s, Pkt *p) {
   c->bound_port = s->bound_port;
   c->peer_ip = p->src_ip;
   c->peer_port = p->src_port;
-  c->cong.init(pl->cc_kind, MSS, pl->cc_ssthresh, pl->cc_init_segments);
+  c->cong.init(pl->cc_for(c->hid), MSS, pl->cc_ssthresh,
+               pl->cc_init_segments);
   c->has_cong = true;
   c->snd_wnd = std::max<int64_t>(1, pl->cc_init_segments) * MSS;
   s->children[key] = c->id;
@@ -2263,18 +2307,18 @@ PyObject *Plane_set_window(PyObject *self, PyObject *arg) {
 // add_host(hid, ip, lo_ip, topo_row, bw_down, bw_up, qdisc_rr, router_kind,
 //          recv_buf, send_buf, autotune_recv, autotune_send,
 //          next_handle, next_port, event_seq, packet_counter,
-//          packet_priority, owned)
+//          packet_priority, owned, cc_kind)
 PyObject *Plane_add_host(PyObject *self, PyObject *args) {
   Plane *pl = SELF;
   long long hid, ip, lo_ip, topo_row, bw_down, bw_up, recv_buf, send_buf;
   long long next_handle, next_port, event_seq, packet_counter,
       packet_priority;
-  int qdisc_rr, router_kind, at_recv, at_send, owned = 1;
-  if (!PyArg_ParseTuple(args, "LLLLLLiiLLiiLLLLL|i", &hid, &ip, &lo_ip,
+  int qdisc_rr, router_kind, at_recv, at_send, owned = 1, cc_kind = -1;
+  if (!PyArg_ParseTuple(args, "LLLLLLiiLLiiLLLLL|ii", &hid, &ip, &lo_ip,
                         &topo_row, &bw_down, &bw_up, &qdisc_rr, &router_kind,
                         &recv_buf, &send_buf, &at_recv, &at_send,
                         &next_handle, &next_port, &event_seq,
-                        &packet_counter, &packet_priority, &owned))
+                        &packet_counter, &packet_priority, &owned, &cc_kind))
     return nullptr;
   if ((size_t)hid >= pl->hosts->size()) pl->hosts->resize(hid + 1, nullptr);
   HostS *h = new HostS();
@@ -2288,6 +2332,7 @@ PyObject *Plane_add_host(PyObject *self, PyObject *args) {
   h->send_buf_size = send_buf;
   h->autotune_recv = at_recv != 0;
   h->autotune_send = at_send != 0;
+  h->cc_kind = cc_kind;
   h->next_handle = next_handle;
   h->next_port = next_port;
   h->event_seq = event_seq;

@@ -245,9 +245,11 @@ _CONSTEXPR_RE = re.compile(
     r"^\s*(?:static\s+)?constexpr\s+[\w:<>\s]+?\b([A-Za-z_]\w*\s*=\s*"
     r"[^;{]+);", re.M)
 _DEFINE_RE = re.compile(r"^\s*#\s*define\s+([A-Za-z_]\w*)\s+(.+?)\s*$", re.M)
+# arrays: `const int X[8] = {...};` and the constexpr spelling the
+# simgen-generated regions use; bodies may span lines ([^}]* crosses \n)
 _ARRAY_RE = re.compile(
-    r"^\s*(?:static\s+)?const\s+[\w\s]+?\b([A-Za-z_]\w*)\s*\[\s*\d*\s*\]"
-    r"\s*=\s*\{([^}]*)\}\s*;", re.M)
+    r"^\s*(?:static\s+)?const(?:expr)?\s+[\w\s]+?\b([A-Za-z_]\w*)"
+    r"\s*\[\s*\d*\s*\]\s*=\s*\{([^}]*)\}\s*;", re.M)
 _ENUM_RE = re.compile(r"\benum\s+([A-Za-z_]\w*)?\s*\{([^}]*)\}", re.S)
 _FUNC_RE = re.compile(
     r"^[ \t]*(?:[A-Za-z_][\w:<>,*&\s]*?[\s*&])?([A-Za-z_]\w*)\s*"
@@ -283,38 +285,54 @@ def extract(path: str, text: str,
     out = CExtract(path)
     env: Dict[str, object] = {}
 
+    # declarations are folded in TEXTUAL order (a constexpr may reference
+    # an earlier #define and vice versa), and line attribution uses the
+    # NAME group's position — the leading ``^\s*`` can swallow preceding
+    # blank/blanked-comment lines across newlines, which made constants
+    # drift to the line of whatever sat above them (e.g. a generated
+    # fenced region's marker) whenever that region changed length
+    decls: List[Tuple[int, str, "re.Match"]] = []
     for m in _CONSTEXPR_RE.finditer(stripped):
-        # one declaration may bind several names: `constexpr int A = 1, B = 2;`
-        line = _line_of(stripped, m.start())
-        for decl in _split_toplevel_commas(m.group(1)):
-            name, _, expr = decl.partition("=")
-            name = name.strip()
-            if not name or not expr:
-                continue
+        decls.append((m.start(1), "constexpr", m))
+    for m in _DEFINE_RE.finditer(stripped):
+        decls.append((m.start(1), "define", m))
+    for m in _ARRAY_RE.finditer(stripped):
+        decls.append((m.start(1), "array", m))
+    for pos, kind, m in sorted(decls, key=lambda d: d[0]):
+        line = _line_of(stripped, pos)
+        if kind == "constexpr":
+            # one declaration may bind several names:
+            # `constexpr int A = 1, B = 2;`
+            for decl in _split_toplevel_commas(m.group(1)):
+                name, _, expr = decl.partition("=")
+                name = name.strip()
+                if not name or not expr:
+                    continue
+                val = eval_c_expr(expr, env)
+                if val is not None:
+                    env[name] = val
+                    out.constants[name] = (val, line)
+        elif kind == "define":
+            name, expr = m.group(1), m.group(2)
             val = eval_c_expr(expr, env)
             if val is not None:
                 env[name] = val
                 out.constants[name] = (val, line)
-    for m in _DEFINE_RE.finditer(stripped):
-        name, expr = m.group(1), m.group(2)
-        val = eval_c_expr(expr, env)
-        if val is not None:
-            env[name] = val
-            out.constants[name] = (val, _line_of(stripped, m.start()))
-    for m in _ARRAY_RE.finditer(stripped):
-        name, body = m.group(1), m.group(2)
-        vals = []
-        for item in body.split(","):
-            item = item.strip()
-            if not item:
-                continue               # trailing comma / blank item
-            v = eval_c_expr(item, env)
-            if v is None:
-                vals = None
-                break
-            vals.append(v)
-        if vals:
-            out.constants[name] = (vals, _line_of(stripped, m.start()))
+        else:
+            name, body = m.group(1), m.group(2)
+            vals = []
+            for item in body.split(","):
+                item = item.strip()
+                if not item:
+                    continue           # trailing comma / blank item
+                v = eval_c_expr(item, env)
+                if v is None:
+                    vals = None
+                    break
+                vals.append(v)
+            if vals:
+                env[name] = vals
+                out.constants[name] = (vals, line)
 
     for m in _ENUM_RE.finditer(stripped):
         ename = m.group(1) or ""

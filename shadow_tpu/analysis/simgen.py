@@ -1,0 +1,705 @@
+"""simgen: spec-authoritative protocol codegen for the three planes.
+
+PR 6 (simtwin) extracted ONE table-driven IR from the three hand-synced
+protocol planes and diffed them at lint time; ``spec/protocol.json`` was
+the *extracted* seed artifact.  simgen inverts the direction (ROADMAP
+item 3): ``spec/protocol_spec.json`` is now AUTHORITATIVE, and the
+protocol surfaces it names — the canonical constants, the TCP
+state-transition table, the token-bucket/CoDel hop-math coefficients,
+and the congestion-control coefficient families — are *emitted* into
+fenced, checksummed regions of the Python plane, the native C plane and
+the JAX/numpy kernel modules.  A protocol change is now one spec edit +
+``make gen``, not three hand-synced transcriptions.
+
+The verification stack, outermost first:
+
+* ``make gen-check`` (== ``simgen --check``, wired into ``make lint``):
+  every declared region byte-matches what the generator would emit
+  today (stale spec or hand edit both fail), and the *read-back* gate
+  re-extracts the planes with simtwin's extractors and diffs the IR
+  against the spec — the generated code must mean what the spec says,
+  not merely look generated.
+* SIM205 (twin_rules): lint-time detection of hand edits inside a
+  fenced region (``body=`` digest drift) and of regions older than the
+  spec (``spec=`` digest drift), with the shared pragma vocabulary.
+* SIM201-204 keep diffing the planes against each other, and
+  ``spec/protocol.json`` (the extracted IR) stays checked in and
+  byte-stable — regeneration after ``make gen`` is part of the flow.
+
+Usage::
+
+    python -m shadow_tpu.analysis.simgen [--check | --write | --list]
+        [--spec PATH] [--root PATH] [--no-readback]
+
+Exit status: 0 = clean, 1 = stale/hand-edited/IR-drift, 2 = usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .genmark import (SPEC_RELPATH, begin_marker, end_marker, scan_regions,
+                      sha12)
+
+PY, C = "#", "//"
+
+
+# ---------------------------------------------------------------------------
+# spec loading
+
+def load_spec(path: str) -> Tuple[Dict, str]:
+    """(spec dict, sha12 of the exact file bytes)."""
+    with open(path, "rb") as f:
+        blob = f.read()
+    return json.loads(blob.decode("utf-8")), sha12(blob)
+
+
+def canonical_spec_bytes(spec: Dict) -> bytes:
+    return (json.dumps(spec, indent=2, sort_keys=True) + "\n").encode()
+
+
+# ---------------------------------------------------------------------------
+# renderers: spec -> region body lines (indent included where non-zero)
+
+def _pairs(spec: Dict) -> List[Tuple[str, str]]:
+    out = []
+    for p in spec["transitions"]["pairs"]:
+        frm, _, to = p.partition(" -> ")
+        out.append((frm, to))
+    return out
+
+
+def _variant_class_name(name: str, base: str) -> str:
+    # "cubicx" extending "cubic" -> CubicX
+    return base.capitalize() + name[len(base):].upper()
+
+
+def _r_wire_defs(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    assert c["MSS"] == c["MTU"] - (c["HDR_TCP"] - 14), \
+        "spec MSS must equal MTU - (HDR_TCP - 14)"
+    ms = 1000000
+    return [
+        "# Ethernet/IP framing (reference definitions.h:169-193).",
+        f"CONFIG_HEADER_SIZE_UDPIPETH = {c['HDR_UDP']}    "
+        "# UDP+IP+ETH header bytes",
+        f"CONFIG_HEADER_SIZE_TCPIPETH = {c['HDR_TCP']}    "
+        "# TCP+IP+ETH header bytes (with options)",
+        f"CONFIG_MTU = {c['MTU']}",
+        f"CONFIG_DATAGRAM_MAX_SIZE = {c['DGRAM_MAX']}",
+        "CONFIG_TCP_MAX_SEGMENT_SIZE = CONFIG_MTU - "
+        f"(CONFIG_HEADER_SIZE_TCPIPETH - 14)  # {c['MSS']}",
+        "",
+        "# Interface token bucket "
+        "(reference network_interface.c:93-95, 207-214).",
+        f"INTERFACE_REFILL_INTERVAL_NS = {c['REFILL_INTERVAL_NS']}"
+        "        # 1 ms token refill",
+        f"INTERFACE_CAPACITY_FACTOR = {c['CAPACITY_FACTOR']}"
+        "                   # capacity = refill*factor + MTU",
+        "",
+        "# TCP buffer caps (reference definitions.h:109-114).",
+        f"CONFIG_TCP_WMEM_MAX = {c['WMEM_MAX']}",
+        f"CONFIG_TCP_RMEM_MAX = {c['RMEM_MAX']}",
+        "",
+        "# TCP retransmit-timer bounds, ms "
+        "(reference definitions.h:115-131).",
+        f"CONFIG_TCP_RTO_INIT_MS = {c['RTO_INIT_NS'] // ms}",
+        f"CONFIG_TCP_RTO_MIN_MS = {c['RTO_MIN_NS'] // ms}",
+        f"CONFIG_TCP_RTO_MAX_MS = {c['RTO_MAX_NS'] // ms}",
+    ]
+
+
+def _r_clock(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    return [
+        "# One simulated nanosecond is the base unit.",
+        "SIM_TIME_NS = 1",
+        f"SIM_TIME_US = {c['SIM_TIME_MS'] // 1000}",
+        f"SIM_TIME_MS = {c['SIM_TIME_MS']}",
+        f"SIM_TIME_SEC = {c['SIM_TIME_SEC']}",
+    ]
+
+
+def _r_tcp_flags(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    return [
+        "# TCP header flag bits (reference tcp.c enum ProtocolTCPFlags).",
+        "TCP_NONE = 0",
+        f"TCP_RST = {c['FLAG_RST']}",
+        f"TCP_SYN = {c['FLAG_SYN']}",
+        f"TCP_ACK = {c['FLAG_ACK']}",
+        f"TCP_FIN = {c['FLAG_FIN']}",
+    ]
+
+
+def _r_status_bits(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    return [
+        "# Status bits (reference descriptor.h DS_*).",
+        "S_NONE = 0",
+        f"S_ACTIVE = {c['S_ACTIVE']}",
+        f"S_READABLE = {c['S_READABLE']}",
+        f"S_WRITABLE = {c['S_WRITABLE']}",
+        f"S_CLOSED = {c['S_CLOSED']}",
+    ]
+
+
+def _r_port_alloc(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    return [
+        f"MIN_EPHEMERAL_PORT = {c['MIN_EPHEMERAL_PORT']}",
+        f"MAX_PORT = {c['MAX_PORT']}",
+    ]
+
+
+def _r_threefry(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    rots = ", ".join(str(r) for r in c["THREEFRY_ROTATIONS"])
+    return [
+        "# Threefry-2x32 rotation constants (Salmon et al., Table 2).",
+        f"_ROTATIONS = ({rots})",
+        f"_PARITY = 0x{c['THREEFRY_PARITY']:X}  # SKEIN_KS_PARITY32",
+    ]
+
+
+def _r_tcp_states(spec: Dict) -> List[str]:
+    lines = ["# states (reference tcp.c enum TCPState :42-47)"]
+    for st in spec["transitions"]["states"]:
+        lines.append(f"{st.upper()} = \"{st}\"")
+    lines += [
+        "",
+        "# The spec's legal (from, to) transition pairs; \"?\" = an",
+        "# assignment no state guard encloses.",
+        "TCP_TRANSITIONS = (",
+    ]
+    for frm, to in _pairs(spec):
+        lines.append(f"    (\"{frm}\", \"{to}\"),")
+    lines.append(")")
+    return lines
+
+
+def _r_tcp_timers(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    return [
+        f"RTO_INIT_NS = {c['RTO_INIT_NS']}",
+        f"RTO_MIN_NS = {c['RTO_MIN_NS']}",
+        f"RTO_MAX_NS = {c['RTO_MAX_NS']}",
+        f"TIME_WAIT_NS = {c['TIME_WAIT_NS']}"
+        "        # 2*MSL teardown hold",
+        f"MAX_SYN_RETRIES = {c['MAX_SYN_RETRIES']}"
+        "                           # Linux tcp_syn_retries default",
+        f"MAX_RETRIES = {c['MAX_RETRIES']}"
+        "                              # Linux tcp_retries2",
+        f"MAX_SACK_BLOCKS = {c['MAX_SACK_BLOCKS']}",
+    ]
+
+
+def _r_codel_params(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    return [
+        f"    TARGET_NS = {c['CODEL_TARGET_NS']}",
+        f"    INTERVAL_NS = {c['CODEL_INTERVAL_NS']}",
+        f"    HARD_LIMIT = {c['CODEL_HARD_LIMIT']}  # packets",
+    ]
+
+
+def _r_router_static(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    return [
+        f"STATIC_CAPACITY = {c['STATIC_CAPACITY']}"
+        "  # packets (reference router_queue_static.c)",
+    ]
+
+
+def _r_congestion_params(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    lines = ["# CUBIC coefficient families (RFC 9438 §4.1 / §4.6)."]
+    for name, var in sorted(spec["congestion"]["variants"].items()):
+        lines.append(f"{var['c_const']} = {c[var['c_const']]!r}"
+                     f"      # {name}: scaling constant")
+        lines.append(f"{var['beta_const']} = {c[var['beta_const']]!r}"
+                     f"   # {name}: multiplicative decrease")
+    return lines
+
+
+def _r_congestion_variants(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    lines: List[str] = []
+    generated: List[Tuple[str, str]] = []
+    for name, var in sorted(spec["congestion"]["variants"].items()):
+        base = var.get("base")
+        if base is None:
+            continue              # the base algorithm is hand-written
+        cls = _variant_class_name(name, base)
+        generated.append((name, cls))
+        lines += [
+            f"class {cls}({base.capitalize()}):",
+            f"    \"\"\"Spec-defined CUBIC variant {name!r}: "
+            f"(C, beta) = ({c[var['c_const']]!r}, "
+            f"{c[var['beta_const']]!r}).",
+            "",
+            f"    Same window-growth machinery as {base.capitalize()} "
+            "(the base class reads",
+            "    ``self.C``/``self.BETA``); only the coefficients "
+            "differ.",
+            "    \"\"\"",
+            "",
+            f"    name = \"{name}\"",
+            f"    C = {var['c_const']}",
+            f"    BETA = {var['beta_const']}",
+            "",
+            "",
+        ]
+    lines.append("# config token -> generated class "
+                 "(make_congestion_control consults this)")
+    lines.append("CC_GENERATED = {")
+    for name, cls in generated:
+        lines.append(f"    \"{name}\": {cls},")
+    lines.append("}")
+    return lines
+
+
+def _r_token_bucket_kernel(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    return [
+        f"REFILL_NS = {c['REFILL_INTERVAL_NS']}"
+        "   # == defs.INTERFACE_REFILL_INTERVAL_NS (1 ms)",
+    ]
+
+
+def _r_protocol_tables(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    states = spec["transitions"]["states"]
+    lines = [
+        "# TCP state universe, reference-enum order; the tuple index IS",
+        "# the C-plane TcpState id.",
+        "TCP_STATES = (",
+    ]
+    for st in states:
+        lines.append(f"    \"{st}\",")
+    lines += [
+        ")",
+        "",
+        "# Legal (from, to) transition pairs; \"?\" = unguarded.",
+        "TCP_TRANSITIONS = (",
+    ]
+    for frm, to in _pairs(spec):
+        lines.append(f"    (\"{frm}\", \"{to}\"),")
+    lines += [")", "", "# Congestion-control coefficient families "
+              "+ config-token kind ids."]
+    variants = sorted(spec["congestion"]["variants"].items())
+    for name, var in variants:
+        lines.append(f"{var['c_const']} = {c[var['c_const']]!r}")
+        lines.append(f"{var['beta_const']} = {c[var['beta_const']]!r}")
+    kinds = sorted(spec["congestion"]["kinds"].items())
+    lines.append("CC_KIND_IDS = {"
+                 + ", ".join(f"\"{k}\": {v}" for k, v in kinds) + "}")
+    by_kind = {var["kind"]: var for _, var in variants}
+    lines.append("# (C, beta) per kind id; non-cubic kinds carry the "
+                 "cubic defaults (unused)")
+    lines.append("CC_COEFFS = {")
+    for k, kid in kinds:
+        var = by_kind.get(kid, dict(spec["congestion"]["variants"]["cubic"]))
+        lines.append(f"    {kid}: ({var['c_const']}, "
+                     f"{var['beta_const']}),  # {k}")
+    lines.append("}")
+    return lines
+
+
+def _r_c_constants(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    return [
+        "// ---- constants (mirror core/defs.py / descriptor/tcp.py) "
+        "------------------",
+        f"constexpr int64_t SIM_MS = {c['SIM_TIME_MS']}LL;",
+        f"constexpr int64_t SIM_SEC = {c['SIM_TIME_SEC']}LL;",
+        f"constexpr int HDR_UDP = {c['HDR_UDP']};",
+        f"constexpr int HDR_TCP = {c['HDR_TCP']};",
+        f"constexpr int64_t MTU = {c['MTU']};",
+        f"constexpr int64_t MSS = {c['MTU']} - ({c['HDR_TCP']} - 14);"
+        f"          // {c['MSS']}",
+        f"constexpr int64_t RTO_INIT = {c['RTO_INIT_NS']}LL;",
+        f"constexpr int64_t RTO_MIN = {c['RTO_MIN_NS']}LL;",
+        f"constexpr int64_t RTO_MAX = {c['RTO_MAX_NS']}LL;",
+        f"constexpr int64_t TIME_WAIT_NS = {c['TIME_WAIT_NS']}LL;",
+        f"constexpr int MAX_SYN_RETRIES = {c['MAX_SYN_RETRIES']};",
+        f"constexpr int MAX_RETRIES = {c['MAX_RETRIES']};"
+        "                    // Linux tcp_retries2",
+        f"constexpr int MAX_SACK_BLOCKS = {c['MAX_SACK_BLOCKS']};",
+        f"constexpr int64_t RMEM_MAX = {c['RMEM_MAX']};",
+        f"constexpr int64_t WMEM_MAX = {c['WMEM_MAX']};",
+        f"constexpr int64_t REFILL_INTERVAL = {c['REFILL_INTERVAL_NS']}LL;"
+        "     // 1 ms",
+        f"constexpr int64_t CAPACITY_FACTOR = {c['CAPACITY_FACTOR']};",
+        f"constexpr int64_t DGRAM_MAX = {c['DGRAM_MAX']};",
+        f"constexpr int64_t CODEL_TARGET = {c['CODEL_TARGET_NS']}LL;",
+        f"constexpr int64_t CODEL_INTERVAL = {c['CODEL_INTERVAL_NS']}LL;",
+        f"constexpr int CODEL_HARD_LIMIT = {c['CODEL_HARD_LIMIT']};",
+        f"constexpr int STATIC_CAPACITY = {c['STATIC_CAPACITY']};",
+        "",
+        "// descriptor status bits (descriptor/base.py)",
+        f"enum {{ S_ACTIVE = {c['S_ACTIVE']}, "
+        f"S_READABLE = {c['S_READABLE']}, "
+        f"S_WRITABLE = {c['S_WRITABLE']}, S_CLOSED = {c['S_CLOSED']} }};",
+        "// TCP header flags (routing/packet.py)",
+        f"enum {{ F_RST = {c['FLAG_RST']}, F_SYN = {c['FLAG_SYN']}, "
+        f"F_ACK = {c['FLAG_ACK']}, F_FIN = {c['FLAG_FIN']} }};",
+    ]
+
+
+def _chunked(tokens: List[str], per_line: int = 5) -> List[str]:
+    return ["  " + ", ".join(tokens[i:i + per_line]) + ","
+            for i in range(0, len(tokens), per_line)]
+
+
+def _r_c_tcp_states(spec: Dict) -> List[str]:
+    states = spec["transitions"]["states"]
+    lines = ["enum TcpState {"]
+    lines += _chunked([f"ST_{s.upper()}" + (" = 0" if i == 0 else "")
+                       for i, s in enumerate(states)])
+    lines += ["};", "const char *const STATE_NAMES[] = {"]
+    lines += _chunked([f"\"{s}\"" for s in states])
+    lines += [
+        "};",
+        "// the spec's legal transition table; 255 = any state ('?')",
+        "struct TcpTransition { unsigned char from, to; };",
+        "constexpr TcpTransition TCP_TRANSITIONS[] = {",
+    ]
+    for frm, to in _pairs(spec):
+        f_tok = "255" if frm == "?" else f"ST_{frm.upper()}"
+        lines.append(f"  {{{f_tok}, ST_{to.upper()}}},")
+    lines += [
+        "};",
+        "constexpr int TCP_TRANSITION_COUNT =",
+        "    (int)(sizeof(TCP_TRANSITIONS) / sizeof(TCP_TRANSITIONS[0]));",
+    ]
+    return lines
+
+
+def _r_c_congestion_params(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    kinds = sorted(spec["congestion"]["kinds"].items(), key=lambda kv: kv[1])
+    enum_body = ", ".join(f"CC_{k.upper()} = {v}" for k, v in kinds)
+    lines = [f"enum CcKind {{ {enum_body} }};",
+             "// CUBIC coefficient families (RFC 9438 §4.1 / §4.6)"]
+    cubics = [(n, v) for n, v in sorted(spec["congestion"]["variants"]
+                                        .items())]
+    for name, var in cubics:
+        lines.append(f"constexpr double {var['c_const']} = "
+                     f"{c[var['c_const']]!r};")
+        lines.append(f"constexpr double {var['beta_const']} = "
+                     f"{c[var['beta_const']]!r};")
+    is_cubic = " || ".join(f"kind == CC_{n.upper()}" for n, _ in cubics)
+    lines += [f"inline bool cc_is_cubic(int kind) {{ return {is_cubic}; }}"]
+    for field in ("c", "beta"):
+        expr = f"CUBIC_{field.upper()}"
+        for name, var in cubics:
+            if var.get("base") is None:
+                continue
+            expr = (f"kind == CC_{name.upper()} ? "
+                    f"{var[field + '_const']} : " + expr)
+        lines.append(f"inline double cc_{field}(int kind) "
+                     f"{{ return {expr}; }}")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# the emission table: every declared region, in file order
+
+RegionDef = Tuple[str, str, str, Callable[[Dict], List[str]]]
+#             (relpath, region name, comment lead, renderer)
+
+REGIONS: List[RegionDef] = [
+    ("shadow_tpu/core/defs.py", "wire-defs", PY, _r_wire_defs),
+    ("shadow_tpu/core/stime.py", "clock", PY, _r_clock),
+    ("shadow_tpu/routing/packet.py", "tcp-flags", PY, _r_tcp_flags),
+    ("shadow_tpu/descriptor/base.py", "status-bits", PY, _r_status_bits),
+    ("shadow_tpu/host/host.py", "port-alloc", PY, _r_port_alloc),
+    ("shadow_tpu/core/rng.py", "threefry", PY, _r_threefry),
+    ("shadow_tpu/descriptor/tcp.py", "tcp-states", PY, _r_tcp_states),
+    ("shadow_tpu/descriptor/tcp.py", "tcp-timers", PY, _r_tcp_timers),
+    ("shadow_tpu/host/router.py", "router-static", PY, _r_router_static),
+    ("shadow_tpu/host/router.py", "codel-params", PY, _r_codel_params),
+    ("shadow_tpu/descriptor/tcp_cong.py", "congestion-params", PY,
+     _r_congestion_params),
+    ("shadow_tpu/descriptor/tcp_cong.py", "congestion-variants", PY,
+     _r_congestion_variants),
+    ("shadow_tpu/ops/bandwidth.py", "token-bucket-kernel", PY,
+     _r_token_bucket_kernel),
+    ("shadow_tpu/ops/protocol_tables.py", "protocol-tables", PY,
+     _r_protocol_tables),
+    ("native/dataplane.cc", "c-protocol-constants", C, _r_c_constants),
+    ("native/dataplane.cc", "c-tcp-states", C, _r_c_tcp_states),
+    ("native/dataplane.cc", "c-congestion-params", C,
+     _r_c_congestion_params),
+]
+
+SURFACE_OF_REGION: Dict[str, str] = {
+    "wire-defs": "constants", "clock": "constants",
+    "tcp-flags": "constants", "status-bits": "constants",
+    "port-alloc": "constants", "threefry": "constants",
+    "tcp-timers": "constants", "c-protocol-constants": "constants",
+    "token-bucket-kernel": "hop-math", "router-static": "hop-math",
+    "codel-params": "hop-math",
+    "tcp-states": "transitions", "c-tcp-states": "transitions",
+    "protocol-tables": "transitions",
+    "congestion-params": "congestion", "congestion-variants": "congestion",
+    "c-congestion-params": "congestion",
+}
+
+
+def render_body(name: str, spec: Dict) -> str:
+    for _, rname, _, renderer in REGIONS:
+        if rname == name:
+            return "".join(ln + "\n" for ln in renderer(spec))
+    raise KeyError(f"no renderer for region {name!r}")
+
+
+# ---------------------------------------------------------------------------
+# apply / check
+
+def _regions_by_file() -> Dict[str, List[RegionDef]]:
+    out: Dict[str, List[RegionDef]] = {}
+    for rd in REGIONS:
+        out.setdefault(rd[0], []).append(rd)
+    return out
+
+
+def rewrite_text(text: str, defs: List[RegionDef], spec: Dict,
+                 spec_hash: str) -> Tuple[str, List[str], List[str]]:
+    """Replace every declared region of one file's text.
+
+    Returns (new_text, changed region names, problems)."""
+    regions, scan_problems = scan_regions(text)
+    problems = [f"line {ln}: {msg}" for ln, msg in scan_problems]
+    by_name = {r.name: r for r in regions}
+    lines = text.splitlines()
+    changed: List[str] = []
+    # replace bottom-up so earlier line numbers stay valid
+    def _key(d):
+        reg = by_name.get(d[1])
+        return -reg.begin_line if reg is not None else 0
+
+    for _, name, lead, renderer in sorted(defs, key=_key):
+        reg = by_name.get(name)
+        if reg is None:
+            problems.append(f"region {name!r}: markers not found")
+            continue
+        body = "".join(ln + "\n" for ln in renderer(spec))
+        bh = sha12(body)
+        if reg.body == body and reg.body_hash == bh \
+                and reg.spec_hash == spec_hash:
+            continue
+        changed.append(name)
+        new_block = [begin_marker(name, lead, spec_hash, bh, reg.indent)]
+        new_block += body.splitlines()
+        new_block.append(end_marker(name, lead, reg.indent))
+        lines[reg.begin_line - 1:reg.end_line] = new_block
+    return "".join(ln + "\n" for ln in lines), changed, problems
+
+
+def check_text(path: str, text: str, defs: List[RegionDef], spec: Dict,
+               spec_hash: str) -> List[str]:
+    """Diagnostics for one file (empty = clean)."""
+    out: List[str] = []
+    regions, scan_problems = scan_regions(text)
+    for ln, msg in scan_problems:
+        out.append(f"{path}:{ln}: {msg}")
+    by_name = {r.name: r for r in regions}
+    declared = {d[1] for d in defs}
+    for name in sorted(set(by_name) - declared):
+        out.append(f"{path}:{by_name[name].begin_line}: region {name!r} "
+                   f"is not declared in simgen's emission table")
+    for _, name, _, renderer in defs:
+        reg = by_name.get(name)
+        if reg is None:
+            out.append(f"{path}: region {name!r} markers not found — "
+                       f"add the fence and run `make gen`")
+            continue
+        body = "".join(ln + "\n" for ln in renderer(spec))
+        if sha12(reg.body) != reg.body_hash:
+            out.append(f"{path}:{reg.begin_line}: region {name!r} was "
+                       f"edited by hand (body digest drift) — edit "
+                       f"{SPEC_RELPATH} instead and run `make gen`")
+        elif reg.body != body:
+            out.append(f"{path}:{reg.begin_line}: region {name!r} is "
+                       f"stale — the spec or the generator changed; "
+                       f"run `make gen`")
+        elif reg.spec_hash != spec_hash:
+            out.append(f"{path}:{reg.begin_line}: region {name!r} was "
+                       f"emitted from an older spec "
+                       f"(spec={reg.spec_hash}, current={spec_hash}) — "
+                       f"run `make gen`")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# read-back: the generated planes must extract to the spec's IR
+
+def readback_diffs(root: str, spec: Dict) -> List[str]:
+    """Re-extract the planes with simtwin's extractors and diff the IR
+    against the authoritative spec (values, transition tables, and the
+    congestion coefficient families)."""
+    from .simlint import load_config
+    from .simtwin import _load_mapped_sources, load_map
+    from .twin_rules import TwinModel
+    config = load_config(os.path.join(root, "pyproject.toml"))
+    surface_map = load_map(None, config)
+    sources = _load_mapped_sources(config, surface_map)
+    twin = TwinModel(sources, surface_map)
+    out: List[str] = []
+    want = spec["constants"]
+    got = twin.constants_by_canonical()
+    for canon in sorted(want):
+        sites = got.get(canon)
+        if not sites:
+            out.append(f"readback: constant {canon} is in the spec but "
+                       f"no plane spells it")
+            continue
+        for path, val, _line, anchor in sites:
+            if not _values_equal(val, want[canon]):
+                out.append(f"readback: {canon} = {val!r} at "
+                           f"{path}#{anchor} but the spec says "
+                           f"{want[canon]!r}")
+    for canon in sorted(set(got) - set(want)):
+        out.append(f"readback: extracted constant {canon} has no spec "
+                   f"entry — add it to {SPEC_RELPATH}")
+    want_pairs = set(spec["transitions"]["pairs"])
+    want_states = set(spec["transitions"]["states"])
+    tables = twin.transition_tables()
+    if not tables:
+        out.append("readback: no transition tables extracted")
+    for path, table in sorted(tables.items()):
+        have = {f"{f} -> {t}" for f, t in table["pairs"]}
+        for p in sorted(want_pairs - have):
+            out.append(f"readback: transition `{p}` is in the spec but "
+                       f"not in {path}")
+        for p in sorted(have - want_pairs):
+            out.append(f"readback: {path} makes transition `{p}` which "
+                       f"the spec does not allow")
+        if set(table["states"]) != want_states:
+            out.append(f"readback: state universe of {path} differs "
+                       f"from the spec")
+    return out
+
+
+def _values_equal(a, b) -> bool:
+    if isinstance(a, list) and isinstance(b, list):
+        return len(a) == len(b) and all(
+            _values_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+        return float(a) == float(b)
+    return a == b
+
+
+# ---------------------------------------------------------------------------
+# tree-level entry points (the API tests/bench use)
+
+def check_tree(root: str, spec: Dict, spec_hash: str,
+               readback: bool = True) -> List[str]:
+    out: List[str] = []
+    for path, defs in sorted(_regions_by_file().items()):
+        abspath = os.path.join(root, path)
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            out.append(f"{path}: unreadable: {e}")
+            continue
+        out.extend(check_text(path, text, defs, spec, spec_hash))
+    if readback and not out:
+        out.extend(readback_diffs(root, spec))
+    return out
+
+
+def write_tree(root: str, spec: Dict, spec_hash: str
+               ) -> Tuple[List[str], List[str]]:
+    """Returns (list of 'path:region' written, problems)."""
+    written: List[str] = []
+    problems: List[str] = []
+    for path, defs in sorted(_regions_by_file().items()):
+        abspath = os.path.join(root, path)
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as e:
+            problems.append(f"{path}: unreadable: {e}")
+            continue
+        new_text, changed, probs = rewrite_text(text, defs, spec, spec_hash)
+        problems.extend(f"{path}: {p}" for p in probs)
+        if changed:
+            with open(abspath, "w", encoding="utf-8") as f:
+                f.write(new_text)
+            written.extend(f"{path}:{name}" for name in changed)
+    return written, problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="simgen",
+        description="spec-authoritative protocol codegen (shadow-tpu): "
+                    "emit the protocol surfaces of spec/protocol_spec.json "
+                    "into fenced regions of the Python/C/kernel planes")
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument("--write", action="store_true",
+                      help="materialize every declared region (make gen)")
+    mode.add_argument("--check", action="store_true",
+                      help="verify regions are current + hand-edit-free "
+                           "and the planes read back to the spec's IR "
+                           "(make gen-check; the default)")
+    mode.add_argument("--list", action="store_true",
+                      help="print the emission table and exit")
+    ap.add_argument("--spec", default=None,
+                    help=f"authoritative spec path (default: "
+                         f"{SPEC_RELPATH} under the config root)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: walk up to pyproject.toml)")
+    ap.add_argument("--no-readback", action="store_true",
+                    help="skip the IR read-back diff (marker checks only)")
+    args = ap.parse_args(argv)
+
+    if args.root is None:
+        from .simlint import load_config
+        args.root = load_config(None, start=".").root
+    spec_path = args.spec or os.path.join(args.root, SPEC_RELPATH)
+    if not os.path.isfile(spec_path):
+        print(f"simgen: no spec at {spec_path}", file=sys.stderr)
+        return 2
+    try:
+        spec, spec_hash = load_spec(spec_path)
+    except (ValueError, OSError) as e:
+        print(f"simgen: unreadable spec {spec_path}: {e}", file=sys.stderr)
+        return 2
+
+    if args.list:
+        for path, name, _, _renderer in REGIONS:
+            surface = SURFACE_OF_REGION.get(name, "?")
+            print(f"{surface:<12} {name:<22} {path}")
+        return 0
+
+    if args.write:
+        written, problems = write_tree(args.root, spec, spec_hash)
+        for p in problems:
+            print(f"simgen: {p}", file=sys.stderr)
+        for w in written:
+            print(f"simgen: wrote {w}")
+        print(f"simgen: {len(written)} region(s) updated, "
+              f"{len(REGIONS) - len(written)} already current")
+        return 1 if problems else 0
+
+    diags = check_tree(args.root, spec, spec_hash,
+                       readback=not args.no_readback)
+    for d in diags:
+        print(d)
+    n_surfaces = len({SURFACE_OF_REGION[n] for _, n, _, _ in REGIONS})
+    print(f"simgen: {len(diags)} problem(s), {len(REGIONS)} region(s), "
+          f"{n_surfaces} surface(s)")
+    return 1 if diags else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

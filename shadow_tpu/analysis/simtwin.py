@@ -13,6 +13,8 @@ SIM202   error     TCP state-transition table drift
 SIM203   error     twin missing a mapped counterpart surface
                    ([tool.simtwin.map] in pyproject.toml)
 SIM204   error     dtype/overflow hazard in a device kernel
+SIM205   error     simgen-generated region hand-edited or stale
+                   (vs spec/protocol_spec.json; see analysis/simgen.py)
 =======  ========  ====================================================
 
 Usage::
@@ -35,10 +37,12 @@ one) and filters only the report, exactly like simrace.
 
 ``--emit-spec`` serializes the extracted IR to ``spec/protocol.json`` —
 checked in, byte-stable across regeneration and PYTHONHASHSEED values
-(everything sorted, no ids, no timestamps).  That file is the seed
-artifact for ROADMAP item 4's single-source protocol spec: the planes are
-diffed against ONE table today so they can be *generated* from one table
-tomorrow.
+(everything sorted, no ids, no timestamps).  Since the simgen cut-over
+the AUTHORITATIVE table is ``spec/protocol_spec.json`` (the planes are
+generated from it; `make gen`); the extracted IR is the read-back
+artifact that proves the generated planes still mean what the spec says.
+``--emit-spec`` refuses to clobber uncommitted hand edits to the target
+(they belong in the authoritative spec) unless ``--force``.
 """
 
 from __future__ import annotations
@@ -170,6 +174,16 @@ def twin_paths(paths: List[str], config: Optional[Config] = None,
     if surface_map is None:
         surface_map = load_map(None, config)
     sources = _load_mapped_sources(config, surface_map)
+    # the authoritative spec rides along (not a mapped plane): SIM205
+    # judges generated-region staleness against its digest.  Read BINARY
+    # and decode: a text-mode read would normalize \r\n and make this
+    # digest disagree with simgen's raw-bytes spec= markers.
+    from .genmark import SPEC_RELPATH
+    try:
+        with open(os.path.join(config.root, SPEC_RELPATH), "rb") as f:
+            sources.setdefault(SPEC_RELPATH, f.read().decode("utf-8"))
+    except (OSError, UnicodeDecodeError):
+        pass
     findings = twin_sources(sources, config, surface_map, rules)
 
     scoped: Set[str] = set()
@@ -195,17 +209,44 @@ def twin_paths(paths: List[str], config: Optional[Config] = None,
     return LintResult(findings, n_files, tool="simtwin")
 
 
-def emit_spec(out_path: str, config: Config,
+def spec_blob(config: Config,
               surface_map: Dict[str, List[MapEntry]]) -> bytes:
-    """Serialize the IR; returns the exact bytes written."""
+    """The exact bytes --emit-spec would write, without writing them."""
     sources = _load_mapped_sources(config, surface_map)
     twin = TwinModel(sources, surface_map)
     spec = build_spec(twin)
-    blob = (json.dumps(spec, indent=2, sort_keys=True) + "\n").encode()
+    return (json.dumps(spec, indent=2, sort_keys=True) + "\n").encode()
+
+
+def emit_spec(out_path: str, config: Config,
+              surface_map: Dict[str, List[MapEntry]],
+              blob: Optional[bytes] = None) -> bytes:
+    """Serialize the IR; returns the exact bytes written.  ``blob``
+    lets a caller that already ran spec_blob (the overwrite guard)
+    skip a second full extraction."""
+    if blob is None:
+        blob = spec_blob(config, surface_map)
     os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
     with open(out_path, "wb") as f:
         f.write(blob)
     return blob
+
+
+def _uncommitted_edits(path: str, root: str) -> bool:
+    """True when git sees uncommitted working-tree changes to ``path``.
+    Not-a-repo / no-git / untracked-file all report False — the guard
+    only protects edits that would be silently destroyed."""
+    import subprocess
+    try:
+        run = subprocess.run(
+            ["git", "-C", root, "status", "--porcelain", "--", path],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return False
+    if run.returncode != 0:
+        return False
+    status = run.stdout.strip()[:2] if run.stdout.strip() else ""
+    return bool(status) and status != "??"
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -231,6 +272,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="write the extracted protocol IR to PATH "
                          "(default: spec/protocol.json under the config "
                          "root) and exit")
+    ap.add_argument("--force", action="store_true",
+                    help="with --emit-spec: overwrite the target even if "
+                         "it carries uncommitted hand edits (the spec is "
+                         "authoritative; refused otherwise)")
     args = ap.parse_args(argv)
     rules = default_rules()
     if args.list_rules:
@@ -248,7 +293,24 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.emit_spec is not None:
         out_path = args.emit_spec or os.path.join(config.root, "spec",
                                                   "protocol.json")
-        blob = emit_spec(out_path, config, surface_map)
+        blob = None
+        if not args.force and os.path.exists(out_path):
+            blob = spec_blob(config, surface_map)
+            try:
+                with open(out_path, "rb") as f:
+                    existing = f.read()
+            except OSError:
+                existing = None
+            if existing is not None and existing != blob \
+                    and _uncommitted_edits(out_path, config.root):
+                print(f"simtwin: refusing to overwrite {out_path}: it has "
+                      f"uncommitted edits that differ from the "
+                      f"regenerated IR.  The extracted spec is derived — "
+                      f"hand edits belong in spec/protocol_spec.json "
+                      f"(then `make gen`).  Commit or discard the edits, "
+                      f"or rerun with --force.", file=sys.stderr)
+                return 1
+        blob = emit_spec(out_path, config, surface_map, blob=blob)
         print(f"simtwin: wrote {out_path} ({len(blob)} bytes)")
         return 0
     only = None

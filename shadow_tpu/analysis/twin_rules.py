@@ -15,12 +15,18 @@ SIM203   error     a twin is missing a mapped counterpart surface
                    ([tool.simtwin.map] in pyproject.toml)
 SIM204   error     dtype/overflow hazard in a device kernel (sim-ns
                    value narrowed to a 32-bit lane)
+SIM205   error     simgen-generated region hand-edited (body digest
+                   drift) or stale vs spec/protocol_spec.json
 =======  ========  ====================================================
 
 The extracted IR serializes to ``spec/protocol.json`` (``simtwin
---emit-spec``): byte-stable, sorted, hash-seed independent — the concrete
-seed artifact for the single-source-spec refactor (ROADMAP item 4), from
-which future code-gen can emit all three planes.
+--emit-spec``): byte-stable, sorted, hash-seed independent.  Since the
+simgen cut-over (ROADMAP item 3) the direction is INVERTED:
+``spec/protocol_spec.json`` is authoritative, the planes carry generated
+fenced regions (``make gen``), and this extracted IR is the read-back
+verification artifact.  Constant sources are anchored to SYMBOL names
+(``path#symbol``), never raw line offsets, so generated regions growing
+or shrinking cannot churn the spec.
 
 The surface map (``[tool.simtwin.map]``) is the comparator's scope: each
 key names a protocol surface, each value lists ``plane:path[:symbol]``
@@ -91,8 +97,13 @@ CANON: Dict[str, str] = {
     "S_WRITABLE": "S_WRITABLE", "S_CLOSED": "S_CLOSED",
     # port allocation (host/host.py <-> dataplane.cc)
     "MIN_EPHEMERAL_PORT": "MIN_EPHEMERAL_PORT", "MAX_PORT": "MAX_PORT",
-    # congestion control
+    # congestion control: the coefficient families are NAMED constants on
+    # all three planes since the simgen cut-over (generated regions in
+    # tcp_cong.py / dataplane.cc / ops/protocol_tables.py)
     "Cubic.C": "CUBIC_C", "Cubic.BETA": "CUBIC_BETA",
+    "CUBIC_C": "CUBIC_C", "CUBIC_BETA": "CUBIC_BETA",
+    "CubicX.C": "CUBICX_C", "CubicX.BETA": "CUBICX_BETA",
+    "CUBICX_C": "CUBICX_C", "CUBICX_BETA": "CUBICX_BETA",
 }
 
 # C-side regex probes for coefficients spelled inline (see cspec._run_probe)
@@ -108,8 +119,9 @@ C_PROBES: Dict[str, Tuple[str, str]] = {
     "SRTT_GAIN": (r"srtt_ns\s*=\s*\(\s*(\d+)\s*\*\s*[\w>.-]*srtt_ns"
                   r"\s*\+\s*\w+\s*\)\s*/\s*(\d+)", "pair"),
     "RTO_VAR_MULT": (r"srtt_ns\s*\+\s*(\d+)\s*\*\s*[\w>.-]*rttvar_ns", "one"),
-    "CUBIC_C": (r"/\s*\(\s*([0-9.]+)\s*\*\s*(?:\([a-z ]+\)\s*)?mss", "one"),
-    "CUBIC_BETA": (r"cwnd\s*\*\s*([0-9.]+)\s*\)\s*,\s*2\s*\*\s*mss", "one"),
+    # CUBIC_C / CUBIC_BETA left the probe set at the simgen cut-over: the
+    # C plane now spells them as named constexpr constants (generated
+    # region c-congestion-params), extracted like any other constant.
 }
 
 # sim-time-ish identifiers for the SIM204 dtype pass
@@ -568,13 +580,34 @@ def parse_map(raw: Dict[str, List[str]]) -> Dict[str, List[MapEntry]]:
     return out
 
 
+def _nearest_symbol(symbols: Dict[str, int], line: int) -> Optional[str]:
+    """The defined symbol whose start line is nearest above ``line`` —
+    the stable anchor for a value spelled inside a function body.
+    Deterministic: ties (same start line) break alphabetically."""
+    best: Optional[str] = None
+    best_line = -1
+    for name in sorted(symbols):
+        ln = symbols[name]
+        if ln <= line and ln > best_line:
+            best, best_line = name, ln
+    return best
+
+
 class TwinModel:
     """All three planes extracted from one source set, per the map."""
 
     def __init__(self, sources: Dict[str, str],
-                 surface_map: Dict[str, List[MapEntry]]):
+                 surface_map: Dict[str, List[MapEntry]],
+                 spec_text: Optional[str] = None):
         self.sources = sources
         self.map = surface_map
+        # authoritative-spec digest for the SIM205 staleness check; the
+        # fixture path passes spec_text (or puts the spec file in
+        # ``sources``), twin_paths loads it from the config root
+        from .genmark import SPEC_RELPATH, sha12
+        if spec_text is None:
+            spec_text = sources.get(SPEC_RELPATH)
+        self.spec_digest = sha12(spec_text) if spec_text is not None else None
         self.parse_errors: List[Finding] = []
         self.py_ctx: Dict[str, ModuleContext] = {}
         self.py_extracts: Dict[str, PyExtract] = {}
@@ -627,14 +660,20 @@ class TwinModel:
             return "kernel"
         return "python"
 
-    def constants_by_canonical(self
-                               ) -> Dict[str, List[Tuple[str, object, int]]]:
-        """canonical -> [(path, value, line)], python plane first, then
-        kernel, then C — sorted within a plane by path."""
-        merged: Dict[str, List[Tuple[str, object, int]]] = {}
+    def constants_by_canonical(
+            self) -> Dict[str, List[Tuple[str, object, int, str]]]:
+        """canonical -> [(path, value, line, anchor)], python plane first,
+        then kernel, then C — sorted within a plane by path.  ``anchor``
+        is the SYMBOL the value is attributed to (its own name for a
+        named constant/enum member, the enclosing function for an inline
+        coefficient probe): spec sources cite anchors, never raw line
+        offsets, so a generated region growing or shrinking above a value
+        cannot churn the emitted spec."""
+        merged: Dict[str, List[Tuple[str, object, int, str]]] = {}
 
-        def add(canon: str, path: str, value: object, line: int) -> None:
-            merged.setdefault(canon, []).append((path, value, line))
+        def add(canon: str, path: str, value: object, line: int,
+                anchor: str) -> None:
+            merged.setdefault(canon, []).append((path, value, line, anchor))
 
         order = ([(rel, ext) for rel, ext in sorted(self.py_extracts.items())
                   if rel not in self.kernel_paths]
@@ -644,21 +683,23 @@ class TwinModel:
             for name, (val, line) in sorted(ext.constants.items()):
                 canon = CANON.get(name)
                 if canon:
-                    add(canon, rel, val, line)
+                    add(canon, rel, val, line, name)
             for canon, (val, line) in sorted(ext.probes.items()):
-                add(canon, rel, val, line)
+                add(canon, rel, val, line,
+                    _nearest_symbol(ext.symbols, line) or "module")
         for rel, ext in sorted(self.c_extracts.items()):
             for name, (val, line) in sorted(ext.constants.items()):
                 canon = CANON.get(name)
                 if canon:
-                    add(canon, rel, val, line)
+                    add(canon, rel, val, line, name)
             for members in ext.enums.values():
                 for name, val, line in members:
                     canon = CANON.get(name)
                     if canon:
-                        add(canon, rel, val, line)
+                        add(canon, rel, val, line, name)
             for canon, (val, line) in sorted(ext.probes.items()):
-                add(canon, rel, val, line)
+                add(canon, rel, val, line,
+                    _nearest_symbol(ext.symbols, line) or "unit")
         return merged
 
     def transition_tables(self) -> Dict[str, Dict]:
@@ -703,15 +744,15 @@ class ConstantDriftRule(TwinRule):
         for canon, sites in sorted(twin.constants_by_canonical().items()):
             if len(sites) < 2:
                 continue
-            ref_path, ref_val, ref_line = sites[0]
-            for path, val, line in sites[1:]:
+            ref_path, ref_val, _ref_line, ref_anchor = sites[0]
+            for path, val, line, _anchor in sites[1:]:
                 if _values_equal(val, ref_val):
                     continue
                 findings.append(Finding(
                     self.id, self.severity, path, line, 0,
                     f"protocol constant {canon} = {_fmt(val)} here but the "
                     f"{twin.plane_of(ref_path)} plane has {_fmt(ref_val)} "
-                    f"({ref_path}:{ref_line}) — twins must agree or carry "
+                    f"({ref_path}#{ref_anchor}) — twins must agree or carry "
                     f"a reasoned pragma"))
         return findings
 
@@ -812,11 +853,45 @@ class KernelDtypeRule(TwinRule):
         return findings
 
 
+class GeneratedRegionRule(TwinRule):
+    id = "SIM205"
+    severity = "error"
+    short = "hand-edited or stale simgen-generated region"
+
+    def run(self, twin: TwinModel) -> List[Finding]:
+        from .genmark import scan_regions, sha12
+        findings: List[Finding] = []
+        for rel in sorted(twin.sources):
+            if not rel.endswith((".py", ".cc", ".cpp", ".h")):
+                continue
+            regions, problems = scan_regions(twin.sources[rel])
+            for line, msg in problems:
+                findings.append(Finding(
+                    self.id, self.severity, rel, line, 0, msg))
+            for reg in regions:
+                if sha12(reg.body) != reg.body_hash:
+                    findings.append(Finding(
+                        self.id, self.severity, rel, reg.begin_line, 0,
+                        f"generated region {reg.name!r} was edited by "
+                        f"hand (body digest drift) — the spec is "
+                        f"authoritative: edit spec/protocol_spec.json "
+                        f"and run `make gen`"))
+                elif twin.spec_digest is not None \
+                        and reg.spec_hash != twin.spec_digest:
+                    findings.append(Finding(
+                        self.id, self.severity, rel, reg.begin_line, 0,
+                        f"generated region {reg.name!r} is stale: emitted "
+                        f"from spec {reg.spec_hash}, current spec is "
+                        f"{twin.spec_digest} — run `make gen`"))
+        return findings
+
+
 CATALOG: List[TwinRule] = [
     ConstantDriftRule(),
     TransitionDriftRule(),
     SurfaceMapRule(),
     KernelDtypeRule(),
+    GeneratedRegionRule(),
 ]
 
 
@@ -833,10 +908,12 @@ def build_spec(twin: TwinModel) -> Dict:
     constants: Dict[str, Dict] = {}
     for canon, sites in sorted(twin.constants_by_canonical().items()):
         per_plane: Dict[str, Dict] = {}
-        for path, val, line in sites:
+        for path, val, _line, anchor in sites:
             plane = twin.plane_of(path)
+            # symbol-anchored source attribution: a generated region
+            # changing the file's length must not churn the spec
             per_plane.setdefault(plane, {
-                "value": val, "source": f"{path}:{line}"})
+                "value": val, "source": f"{path}#{anchor}"})
         constants[canon] = per_plane
     transitions: Dict[str, Dict] = {}
     for path, table in sorted(twin.transition_tables().items()):

@@ -96,6 +96,7 @@ class HostConfig:
     socket_send_buffer: int = 0
     interface_buffer: int = 0
     qdisc: Optional[str] = None
+    tcp_cc: Optional[str] = None     # per-host congestion control
     log_level: Optional[str] = None
     log_pcap: bool = False
     pcap_dir: Optional[str] = None
@@ -187,6 +188,7 @@ def parse_xml(text: str) -> Configuration:
                 socket_send_buffer=_to_int(el.get("socketsendbuffer")),
                 interface_buffer=_to_int(el.get("interfacebuffer")),
                 qdisc=el.get("qdisc"),
+                tcp_cc=el.get("tcpcc"),
                 log_level=el.get("loglevel"),
                 log_pcap=(el.get("logpcap", "").lower() in ("1", "true", "yes")),
                 pcap_dir=el.get("pcapdir"),
@@ -259,6 +261,7 @@ def parse_dict(d: dict) -> Configuration:
             socket_send_buffer=_to_int(h.get("socket_send_buffer")),
             interface_buffer=_to_int(h.get("interface_buffer")),
             qdisc=h.get("qdisc"),
+            tcp_cc=h.get("tcpcc") or h.get("tcp_cc"),
             log_level=h.get("log_level"),
             log_pcap=bool(h.get("pcap", False)),
             pcap_dir=h.get("pcap_dir"),

@@ -44,6 +44,17 @@ class Controller:
             return Topology.from_file(path)
         return single_vertex_topology()
 
+    def _validated_tcp_cc(self, hc):
+        """Per-host <host tcpcc="..."> must name a known CC kind at
+        CONFIG time — not crash as a KeyError in the native plane or a
+        mid-run ValueError at first socket creation."""
+        from .options import TCP_CC_KINDS
+        if hc.tcp_cc and hc.tcp_cc not in TCP_CC_KINDS:
+            raise ValueError(
+                f"host {hc.id!r}: unknown tcpcc={hc.tcp_cc!r} "
+                f"(choices: {', '.join(TCP_CC_KINDS)})")
+        return hc.tcp_cc
+
     def _host_params_kwargs(self, hc) -> dict:
         """The HostParams keyword set shared by a whole config entry —
         everything but the per-host name and the topology-resolved
@@ -53,6 +64,7 @@ class Controller:
         opts = self.options
         return dict(
             qdisc=hc.qdisc or opts.interface_qdisc,
+            tcp_cc=self._validated_tcp_cc(hc),
             router_queue=opts.router_queue,
             # 0 means "default start size + autotune", never a
             # zero-byte buffer (a 0 advertised window would

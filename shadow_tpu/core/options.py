@@ -16,7 +16,7 @@ SCHEDULER_POLICIES = ("global", "host", "steal", "thread", "threadXthread",
                       "threadXhost", "tpu")
 QDISC_KINDS = ("fifo", "rr")
 ROUTER_QUEUE_KINDS = ("codel", "single", "static")
-TCP_CC_KINDS = ("reno", "aimd", "cubic")
+TCP_CC_KINDS = ("reno", "aimd", "cubic", "cubicx")
 
 
 @dataclasses.dataclass

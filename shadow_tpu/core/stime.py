@@ -14,11 +14,13 @@ code uses int64 and the simulator asserts times stay below 2**63.
 
 from __future__ import annotations
 
+# >>> simgen:begin region=clock spec=4b732374c3c9 body=0992823276f8
 # One simulated nanosecond is the base unit.
 SIM_TIME_NS = 1
-SIM_TIME_US = 1_000
-SIM_TIME_MS = 1_000_000
-SIM_TIME_SEC = 1_000_000_000
+SIM_TIME_US = 1000
+SIM_TIME_MS = 1000000
+SIM_TIME_SEC = 1000000000
+# <<< simgen:end region=clock
 SIM_TIME_MIN = 60 * SIM_TIME_SEC
 SIM_TIME_HOUR = 3600 * SIM_TIME_SEC
 

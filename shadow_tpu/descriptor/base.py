@@ -18,12 +18,14 @@ from __future__ import annotations
 from collections import deque
 from typing import Callable, List, Optional, Set
 
-# Status bits (descriptor.h DS_*)
+# >>> simgen:begin region=status-bits spec=4b732374c3c9 body=dab61b8b2aea
+# Status bits (reference descriptor.h DS_*).
 S_NONE = 0
-S_ACTIVE = 1 << 0
-S_READABLE = 1 << 1
-S_WRITABLE = 1 << 2
-S_CLOSED = 1 << 3
+S_ACTIVE = 1
+S_READABLE = 2
+S_WRITABLE = 4
+S_CLOSED = 8
+# <<< simgen:end region=status-bits
 
 
 class Descriptor:

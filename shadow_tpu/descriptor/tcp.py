@@ -48,7 +48,8 @@ from .retransmit_tally import make_tally
 from .tcp_cong import make_congestion_control
 from ..core.worker import current_worker
 
-# states (tcp.c enum TCPState :42-47)
+# >>> simgen:begin region=tcp-states spec=4b732374c3c9 body=c91ef6656a5d
+# states (reference tcp.c enum TCPState :42-47)
 CLOSED = "closed"
 LISTEN = "listen"
 SYN_SENT = "syn_sent"
@@ -61,13 +62,37 @@ TIME_WAIT = "time_wait"
 CLOSE_WAIT = "close_wait"
 LAST_ACK = "last_ack"
 
+# The spec's legal (from, to) transition pairs; "?" = an
+# assignment no state guard encloses.
+TCP_TRANSITIONS = (
+    ("?", "closed"),
+    ("?", "established"),
+    ("?", "listen"),
+    ("?", "syn_received"),
+    ("?", "syn_sent"),
+    ("?", "time_wait"),
+    ("close_wait", "last_ack"),
+    ("established", "close_wait"),
+    ("established", "fin_wait_1"),
+    ("fin_wait_1", "closing"),
+    ("fin_wait_1", "fin_wait_2"),
+    ("fin_wait_1", "time_wait"),
+    ("syn_received", "established"),
+    ("syn_received", "fin_wait_1"),
+)
+# <<< simgen:end region=tcp-states
+
 MSS = defs.CONFIG_TCP_MAX_SEGMENT_SIZE
-RTO_INIT_NS = defs.CONFIG_TCP_RTO_INIT_MS * stime.SIM_TIME_MS
-RTO_MIN_NS = defs.CONFIG_TCP_RTO_MIN_MS * stime.SIM_TIME_MS
-RTO_MAX_NS = defs.CONFIG_TCP_RTO_MAX_MS * stime.SIM_TIME_MS
-TIME_WAIT_NS = 60 * stime.SIM_TIME_SEC        # 2*MSL teardown hold
+
+# >>> simgen:begin region=tcp-timers spec=4b732374c3c9 body=21bb9e099dc9
+RTO_INIT_NS = 1000000000
+RTO_MIN_NS = 200000000
+RTO_MAX_NS = 120000000000
+TIME_WAIT_NS = 60000000000        # 2*MSL teardown hold
 MAX_SYN_RETRIES = 6                           # Linux tcp_syn_retries default
+MAX_RETRIES = 15                              # Linux tcp_retries2
 MAX_SACK_BLOCKS = 4
+# <<< simgen:end region=tcp-timers
 
 
 class _Segment:
@@ -161,6 +186,11 @@ class TCPSocket(Socket):
         from .tcp_cong import INIT_CWND_SEGMENTS
         opts = self._engine_options()
         kind = getattr(opts, "tcp_congestion_control", "reno") if opts else "reno"
+        # per-host override (<host tcpcc="...">) beats the engine-wide flag
+        host_kind = getattr(getattr(self.host, "params", None),
+                            "tcp_cc", None)
+        if host_kind:
+            kind = host_kind
         ssthresh = getattr(opts, "tcp_ssthresh", 0) if opts else 0
         init_segments = getattr(opts, "tcp_windows", INIT_CWND_SEGMENTS) \
             if opts else INIT_CWND_SEGMENTS
@@ -537,7 +567,7 @@ class TCPSocket(Socket):
         if self.state == SYN_SENT and seg.rtx_count >= MAX_SYN_RETRIES:
             self._fail_connection("ETIMEDOUT")
             return
-        if seg.rtx_count >= 15:  # Linux tcp_retries2 default
+        if seg.rtx_count >= MAX_RETRIES:
             self._fail_connection("ETIMEDOUT")
             return
         if self.cong is not None:

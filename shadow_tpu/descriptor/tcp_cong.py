@@ -13,6 +13,14 @@ from __future__ import annotations
 
 INIT_CWND_SEGMENTS = 10       # Linux default initial window (RFC 6928)
 
+# >>> simgen:begin region=congestion-params spec=4b732374c3c9 body=6a36d8b1dbdf
+# CUBIC coefficient families (RFC 9438 §4.1 / §4.6).
+CUBIC_C = 0.4      # cubic: scaling constant
+CUBIC_BETA = 0.7   # cubic: multiplicative decrease
+CUBICX_C = 0.6      # cubicx: scaling constant
+CUBICX_BETA = 0.85   # cubicx: multiplicative decrease
+# <<< simgen:end region=congestion-params
+
 
 class CongestionControl:
     """Base vtable: slow start + congestion avoidance scaffolding."""
@@ -102,8 +110,8 @@ class Cubic(CongestionControl):
     the last congestion event, independent of RTT."""
 
     name = "cubic"
-    C = 0.4          # scaling constant (RFC 9438 §4.1)
-    BETA = 0.7       # multiplicative decrease factor
+    C = CUBIC_C          # scaling constant (RFC 9438 §4.1)
+    BETA = CUBIC_BETA    # multiplicative decrease factor
 
     def __init__(self, mss: int, ssthresh: int = 0,
                  init_segments: int = INIT_CWND_SEGMENTS):
@@ -141,6 +149,26 @@ class Cubic(CongestionControl):
             super()._congestion_avoidance(acked_bytes, now_ns)
 
 
+# >>> simgen:begin region=congestion-variants spec=4b732374c3c9 body=a5ad8258f75d
+class CubicX(Cubic):
+    """Spec-defined CUBIC variant 'cubicx': (C, beta) = (0.6, 0.85).
+
+    Same window-growth machinery as Cubic (the base class reads
+    ``self.C``/``self.BETA``); only the coefficients differ.
+    """
+
+    name = "cubicx"
+    C = CUBICX_C
+    BETA = CUBICX_BETA
+
+
+# config token -> generated class (make_congestion_control consults this)
+CC_GENERATED = {
+    "cubicx": CubicX,
+}
+# <<< simgen:end region=congestion-variants
+
+
 def make_congestion_control(kind: str, mss: int, ssthresh: int = 0,
                             init_segments: int = INIT_CWND_SEGMENTS
                             ) -> CongestionControl:
@@ -150,4 +178,7 @@ def make_congestion_control(kind: str, mss: int, ssthresh: int = 0,
         return AIMD(mss, ssthresh, init_segments)
     if kind == "cubic":
         return Cubic(mss, ssthresh, init_segments)
+    cls = CC_GENERATED.get(kind)
+    if cls is not None:
+        return cls(mss, ssthresh, init_segments)
     raise ValueError(f"unknown congestion control {kind!r}")

@@ -20,8 +20,10 @@ from .network_interface import NetworkInterface
 from .router import Router, make_queue
 from .tracker import Tracker
 
+# >>> simgen:begin region=port-alloc spec=4b732374c3c9 body=00a7ffddc53c
 MIN_EPHEMERAL_PORT = 10000
 MAX_PORT = 65535
+# <<< simgen:end region=port-alloc
 
 
 class HostParams:
@@ -30,6 +32,7 @@ class HostParams:
 
     def __init__(self, name: str, bw_down_kibps: int, bw_up_kibps: int,
                  qdisc: str = "fifo", router_queue: str = "codel",
+                 tcp_cc: Optional[str] = None,
                  recv_buf_size: int = 174760, send_buf_size: int = 131072,
                  autotune_recv: bool = True, autotune_send: bool = True,
                  cpu_frequency_khz: int = 0, cpu_threshold_ns: int = -1,
@@ -45,6 +48,9 @@ class HostParams:
         self.bw_up_kibps = bw_up_kibps
         self.qdisc = qdisc
         self.router_queue = router_queue
+        # per-host congestion-control override (<host tcpcc="...">);
+        # None = the engine-wide --tcp-congestion-control choice
+        self.tcp_cc = tcp_cc
         self.recv_buf_size = recv_buf_size
         self.send_buf_size = send_buf_size
         self.autotune_recv = autotune_recv

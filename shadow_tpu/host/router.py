@@ -16,8 +16,11 @@ from __future__ import annotations
 from collections import deque
 from typing import Optional
 
-from ..core import stime
 from ..core.worker import current_worker
+
+# >>> simgen:begin region=router-static spec=4b732374c3c9 body=424e965b21b5
+STATIC_CAPACITY = 1024  # packets (reference router_queue_static.c)
+# <<< simgen:end region=router-static
 
 
 class QueueManager:
@@ -64,7 +67,7 @@ class SingleQueue(QueueManager):
 class StaticQueue(QueueManager):
     """Fixed-capacity drop-tail FIFO (router_queue_static.c)."""
 
-    def __init__(self, capacity_packets: int = 1024):
+    def __init__(self, capacity_packets: int = STATIC_CAPACITY):
         self.capacity = capacity_packets
         self._q = deque()
 
@@ -92,9 +95,11 @@ class CoDelQueue(QueueManager):
     size cap to bound memory like the kernel's implementation.
     """
 
-    TARGET_NS = 10 * stime.SIM_TIME_MS
-    INTERVAL_NS = 100 * stime.SIM_TIME_MS
+    # >>> simgen:begin region=codel-params spec=4b732374c3c9 body=eb7dab75d865
+    TARGET_NS = 10000000
+    INTERVAL_NS = 100000000
     HARD_LIMIT = 1000  # packets
+    # <<< simgen:end region=codel-params
 
     def __init__(self):
         self._q = deque()              # (enqueue_time, packet)

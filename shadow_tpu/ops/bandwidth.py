@@ -50,7 +50,9 @@ import jax.numpy as jnp
 
 from ..core import defs, stime
 
-REFILL_NS = defs.INTERFACE_REFILL_INTERVAL_NS   # 1 ms
+# >>> simgen:begin region=token-bucket-kernel spec=4b732374c3c9 body=ae8bb8568cdc
+REFILL_NS = 1000000   # == defs.INTERFACE_REFILL_INTERVAL_NS (1 ms)
+# <<< simgen:end region=token-bucket-kernel
 
 
 def bucket_params(rate_kibps: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:

@@ -1,0 +1,99 @@
+"""Kernel-plane protocol tables, generated from the authoritative spec.
+
+The device kernels operate on integer state ids and coefficient arrays,
+not on the Python plane's string states or the C plane's enums.  This
+module is the kernel plane's copy of the protocol surfaces that the
+other two planes also carry — the TCP state universe (tuple index ==
+C-plane ``TcpState`` id), the legal state-transition pairs, and the
+congestion-control coefficient families — materialized by simgen from
+``spec/protocol_spec.json`` exactly like the twin regions in
+``core/defs.py`` and ``native/dataplane.cc``.  simtwin's SIM201/SIM203
+passes hold this module to the same cross-plane agreement as the
+runtime planes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# >>> simgen:begin region=protocol-tables spec=4b732374c3c9 body=1585a58dc283
+# TCP state universe, reference-enum order; the tuple index IS
+# the C-plane TcpState id.
+TCP_STATES = (
+    "closed",
+    "listen",
+    "syn_sent",
+    "syn_received",
+    "established",
+    "fin_wait_1",
+    "fin_wait_2",
+    "closing",
+    "time_wait",
+    "close_wait",
+    "last_ack",
+)
+
+# Legal (from, to) transition pairs; "?" = unguarded.
+TCP_TRANSITIONS = (
+    ("?", "closed"),
+    ("?", "established"),
+    ("?", "listen"),
+    ("?", "syn_received"),
+    ("?", "syn_sent"),
+    ("?", "time_wait"),
+    ("close_wait", "last_ack"),
+    ("established", "close_wait"),
+    ("established", "fin_wait_1"),
+    ("fin_wait_1", "closing"),
+    ("fin_wait_1", "fin_wait_2"),
+    ("fin_wait_1", "time_wait"),
+    ("syn_received", "established"),
+    ("syn_received", "fin_wait_1"),
+)
+
+# Congestion-control coefficient families + config-token kind ids.
+CUBIC_C = 0.4
+CUBIC_BETA = 0.7
+CUBICX_C = 0.6
+CUBICX_BETA = 0.85
+CC_KIND_IDS = {"aimd": 1, "cubic": 2, "cubicx": 3, "reno": 0}
+# (C, beta) per kind id; non-cubic kinds carry the cubic defaults (unused)
+CC_COEFFS = {
+    1: (CUBIC_C, CUBIC_BETA),  # aimd
+    2: (CUBIC_C, CUBIC_BETA),  # cubic
+    3: (CUBICX_C, CUBICX_BETA),  # cubicx
+    0: (CUBIC_C, CUBIC_BETA),  # reno
+}
+# <<< simgen:end region=protocol-tables
+
+ANY_STATE = "?"          # an assignment no state guard encloses
+
+
+def state_id(name: str) -> int:
+    """C-plane TcpState id for a state name (255 for the '?' wildcard,
+    matching the C transition table's encoding)."""
+    if name == ANY_STATE:
+        return 255
+    return TCP_STATES.index(name)
+
+
+def transition_matrix() -> np.ndarray:
+    """Boolean [n_states+1, n_states] allow-matrix: row ``i`` = from-state
+    id (last row = the '?' wildcard), column = to-state id."""
+    n = len(TCP_STATES)
+    m = np.zeros((n + 1, n), dtype=np.bool_)
+    for frm, to in TCP_TRANSITIONS:
+        row = n if frm == ANY_STATE else TCP_STATES.index(frm)
+        m[row, TCP_STATES.index(to)] = True
+    return m
+
+
+def cc_coefficients() -> np.ndarray:
+    """[n_kinds, 2] float64 (C, beta) rows indexed by CC_KIND_IDS, built
+    from the generated CC_COEFFS table — a new spec variant lands here
+    via `make gen` with no hand edit."""
+    n = max(CC_KIND_IDS.values()) + 1
+    out = np.zeros((n, 2))
+    for kind_id, (c, beta) in CC_COEFFS.items():
+        out[kind_id] = (c, beta)
+    return out

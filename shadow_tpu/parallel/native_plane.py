@@ -140,7 +140,7 @@ def native_available() -> bool:
     return _load_module() is not None
 
 
-_CC_KINDS = {"reno": 0, "aimd": 1, "cubic": 2}
+_CC_KINDS = {"reno": 0, "aimd": 1, "cubic": 2, "cubicx": 3}
 _RQ_KINDS = {"codel": 0, "single": 1, "static": 2}
 
 
@@ -499,7 +499,9 @@ class NativePlane:
                 int(host._next_handle), int(host._next_port),
                 int(host._event_seq), int(host._packet_counter),
                 int(host._packet_priority),
-                1 if eng.owns_host(host) else 0)
+                1 if eng.owns_host(host) else 0,
+                _CC_KINDS[p.tcp_cc] if getattr(p, "tcp_cc", None)
+                else -1)
             # the per-host deterministic counters move into C so both
             # planes draw from the same sequence space, interleaved exactly
             host.native_plane = self

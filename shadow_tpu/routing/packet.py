@@ -64,12 +64,14 @@ class TCPHeader:
         self.timestamp_echo = timestamp_echo
 
 
-# TCP header flag bits (tcp.c enum ProtocolTCPFlags)
+# >>> simgen:begin region=tcp-flags spec=4b732374c3c9 body=5c389b66fae3
+# TCP header flag bits (reference tcp.c enum ProtocolTCPFlags).
 TCP_NONE = 0
-TCP_RST = 1 << 1
-TCP_SYN = 1 << 2
-TCP_ACK = 1 << 3
-TCP_FIN = 1 << 4
+TCP_RST = 2
+TCP_SYN = 4
+TCP_ACK = 8
+TCP_FIN = 16
+# <<< simgen:end region=tcp-flags
 
 
 # Full per-packet delivery-status audit trails (the reference's PDS_* flags,

@@ -1,0 +1,524 @@
+"""simgen (shadow_tpu/analysis/simgen.py): spec-authoritative protocol
+codegen, ISSUE 11's tentpole.
+
+``spec/protocol_spec.json`` is the SOURCE; the Python/C/kernel planes
+carry fenced, checksummed regions materialized from it (`make gen`).
+Pinned here: the authoritative spec's canonical form, per-surface
+round-trip gates (every declared region byte-matches its renderer and
+the planes read back to the spec's IR), the `make gen-check` staleness
+and hand-edit gates, the SIM205 fire+suppress pair, the CUBIC payoff —
+the ``cubicx`` variant defined ONLY in the spec, materialized on all
+three planes, selectable engine-wide and per-host, with python-vs-native
+runtime digest parity — and THE GATE: zero unsuppressed findings (and
+zero simgen problems) over the real tree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from shadow_tpu.analysis import simgen
+from shadow_tpu.analysis.genmark import (SPEC_RELPATH, begin_marker,
+                                         end_marker, scan_regions, sha12)
+from shadow_tpu.analysis.simlint import load_config
+from shadow_tpu.analysis.simtwin import load_map, twin_paths, twin_sources
+from shadow_tpu.analysis.twin_rules import parse_map
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPEC_PATH = os.path.join(REPO, SPEC_RELPATH)
+SPEC, SPEC_HASH = simgen.load_spec(SPEC_PATH)
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings if not f.suppressed})
+
+
+# ---------------------------------------------------------------------------
+# the authoritative spec artifact
+
+
+def test_spec_is_canonical_json():
+    """Byte-stable form: sorted keys, 2-indent, trailing newline — the
+    same canonicalization the extracted IR uses, so diffs stay minimal."""
+    with open(SPEC_PATH, "rb") as f:
+        raw = f.read()
+    assert raw == simgen.canonical_spec_bytes(SPEC)
+
+
+def test_spec_names_all_four_surfaces():
+    assert set(SPEC["surfaces"]) >= {"wire", "clock", "tcp-timers",
+                                     "token-bucket", "codel", "congestion"}
+    assert len(SPEC["constants"]) >= 44
+    assert len(SPEC["transitions"]["pairs"]) == 14
+    assert len(SPEC["transitions"]["states"]) == 11
+    # every surface member names a real constant
+    for surface, names in SPEC["surfaces"].items():
+        for n in names:
+            assert n in SPEC["constants"], (surface, n)
+
+
+# ---------------------------------------------------------------------------
+# per-surface round-trip gates: region bytes == renderer output == spec IR
+
+
+@pytest.mark.parametrize("surface", ["constants", "transitions",
+                                     "hop-math", "congestion"])
+def test_surface_regions_round_trip(surface):
+    """Every region of the surface is present in its file, carries the
+    current spec digest, and byte-matches what the generator renders."""
+    defs = [rd for rd in simgen.REGIONS
+            if simgen.SURFACE_OF_REGION[rd[1]] == surface]
+    assert defs, f"no regions declared for surface {surface}"
+    for path, name, _lead, renderer in defs:
+        with open(os.path.join(REPO, path), encoding="utf-8") as f:
+            regions, problems = scan_regions(f.read())
+        assert problems == [], (path, problems)
+        by_name = {r.name: r for r in regions}
+        assert name in by_name, f"{path} lost region {name}"
+        reg = by_name[name]
+        body = "".join(ln + "\n" for ln in renderer(SPEC))
+        assert reg.body == body, f"{path}:{name} drifted from renderer"
+        assert reg.body_hash == sha12(body)
+        assert reg.spec_hash == SPEC_HASH, f"{path}:{name} stale"
+
+
+def test_check_tree_clean_including_readback():
+    """`make gen-check` over the real tree: no stale/hand-edited region,
+    and simtwin's extractors read the generated planes back to the
+    spec's exact IR (values, transition tables, CC coefficients)."""
+    assert simgen.check_tree(REPO, SPEC, SPEC_HASH, readback=True) == []
+
+
+def test_write_tree_is_idempotent(tmp_path):
+    """A second `make gen` writes nothing (byte-stable generation)."""
+    # check_tree clean (above) + rewrite_text returning no changes on
+    # every real file IS idempotence; assert it directly per file
+    for path, defs in sorted(simgen._regions_by_file().items()):
+        with open(os.path.join(REPO, path), encoding="utf-8") as f:
+            text = f.read()
+        new_text, changed, problems = simgen.rewrite_text(
+            text, defs, SPEC, SPEC_HASH)
+        assert changed == [] and problems == [], (path, changed, problems)
+        assert new_text == text
+
+
+def test_readback_catches_spec_value_drift():
+    """Editing the spec without `make gen` MUST fail the read-back gate:
+    the planes still spell the old value."""
+    drifted = json.loads(json.dumps(SPEC))
+    drifted["constants"]["MTU"] = 9000
+    diffs = simgen.readback_diffs(REPO, drifted)
+    assert any("MTU" in d for d in diffs)
+
+
+# ---------------------------------------------------------------------------
+# gen-check failure modes on synthetic files
+
+
+def _region_text(name, lead, body_lines, spec_hash=SPEC_HASH,
+                 body_hash=None, indent=""):
+    body = "".join(indent + ln + "\n" for ln in body_lines)
+    bh = body_hash if body_hash is not None else sha12(body)
+    return (begin_marker(name, lead, spec_hash, bh, indent) + "\n"
+            + body + end_marker(name, lead, indent) + "\n")
+
+
+def test_check_text_flags_hand_edit_and_staleness():
+    path, name, lead, renderer = simgen.REGIONS[0]   # wire-defs
+    good_body = renderer(SPEC)
+    # 1) hand edit: body no longer matches its own recorded digest
+    tampered = _region_text(name, lead, good_body)
+    tampered = tampered.replace("CONFIG_MTU = 1500", "CONFIG_MTU = 9000")
+    out = simgen.check_text(path, tampered, [simgen.REGIONS[0]], SPEC,
+                            SPEC_HASH)
+    assert len(out) == 1 and "edited by hand" in out[0]
+    # 2) stale: consistent region, but emitted from an older spec
+    stale = _region_text(name, lead, good_body, spec_hash="b" * 12)
+    out = simgen.check_text(path, stale, [simgen.REGIONS[0]], SPEC,
+                            SPEC_HASH)
+    assert len(out) == 1 and "older spec" in out[0]
+    # 3) renderer drift: hashes self-consistent but content outdated
+    old = _region_text(name, lead, ["CONFIG_MTU = 1400"])
+    out = simgen.check_text(path, old, [simgen.REGIONS[0]], SPEC, SPEC_HASH)
+    assert len(out) == 1 and "stale" in out[0]
+    # 4) missing markers
+    out = simgen.check_text(path, "X = 1\n", [simgen.REGIONS[0]], SPEC,
+                            SPEC_HASH)
+    assert len(out) == 1 and "markers not found" in out[0]
+
+
+def test_rewrite_text_repairs_all_failure_modes():
+    path, name, lead, renderer = simgen.REGIONS[0]
+    want = _region_text(name, lead, renderer(SPEC))
+    for broken in (
+            _region_text(name, lead, ["CONFIG_MTU = 1400"]),      # outdated
+            _region_text(name, lead, renderer(SPEC), "c" * 12),   # stale
+            _region_text(name, lead, renderer(SPEC),
+                         body_hash="d" * 12)):                    # tampered
+        fixed, changed, problems = simgen.rewrite_text(
+            broken, [simgen.REGIONS[0]], SPEC, SPEC_HASH)
+        assert changed == [name] and problems == []
+        assert fixed == want
+
+
+def test_malformed_markers_are_problems_not_silence():
+    bad = ("# >>> simgen:begin region=x spec=zz body=zz\n"
+           "X = 1\n")
+    regions, problems = scan_regions(bad)
+    assert regions == [] and len(problems) == 1
+    assert "malformed" in problems[0][1]
+    unclosed = begin_marker("x", "#", "a" * 12, "b" * 12) + "\nX = 1\n"
+    regions, problems = scan_regions(unclosed)
+    assert regions == [] and "never closed" in problems[0][1]
+
+
+# ---------------------------------------------------------------------------
+# SIM205: fire + suppress (the lint face of the same invariants)
+
+
+_GEN_MAP = {"wire-constants": ["py:shadow_tpu/fake/defs.py",
+                               "c:native/fake.cc"]}
+
+
+def _twin(sources, surface_map=_GEN_MAP):
+    return twin_sources(sources, None, parse_map(surface_map))
+
+
+def test_sim205_fires_on_hand_edited_region_and_suppresses():
+    body = "CONFIG_MTU = 1500\n"
+    region = (begin_marker("wire-defs", "#", "a" * 12, sha12(body)) + "\n"
+              + "CONFIG_MTU = 1500  # tampered after generation\n"
+              + end_marker("wire-defs", "#") + "\n")
+    out = _twin({"shadow_tpu/fake/defs.py": region,
+                 "native/fake.cc": "constexpr int MTU = 1500;\n"})
+    assert _rules_of(out) == ["SIM205"]
+    assert "edited by hand" in out[0].message
+    suppressed = ("# simtwin: disable=SIM205 -- fixture tamper\n" + region)
+    out = _twin({"shadow_tpu/fake/defs.py": suppressed,
+                 "native/fake.cc": "constexpr int MTU = 1500;\n"})
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM205"]
+
+
+def test_sim205_fires_on_stale_region_vs_spec():
+    """A region emitted from an older spec digest fails once the
+    authoritative spec rides along in the source set."""
+    body = "CONFIG_MTU = 1500\n"
+    region = (begin_marker("wire-defs", "#", "a" * 12, sha12(body)) + "\n"
+              + body + end_marker("wire-defs", "#") + "\n")
+    spec_text = "{\"version\": 1}\n"
+    assert sha12(spec_text) != "a" * 12
+    out = _twin({"shadow_tpu/fake/defs.py": region,
+                 "native/fake.cc": "constexpr int MTU = 1500;\n",
+                 "spec/protocol_spec.json": spec_text})
+    assert _rules_of(out) == ["SIM205"]
+    assert "stale" in out[0].message
+    # consistent digest -> quiet
+    ok = (begin_marker("wire-defs", "#", sha12(spec_text), sha12(body))
+          + "\n" + body + end_marker("wire-defs", "#") + "\n")
+    out = _twin({"shadow_tpu/fake/defs.py": ok,
+                 "native/fake.cc": "constexpr int MTU = 1500;\n",
+                 "spec/protocol_spec.json": spec_text})
+    assert out == []
+
+
+def test_sim205_fires_in_c_files_too():
+    body = "constexpr int MTU = 1500;\n"
+    region = (begin_marker("c-wire", "//", "a" * 12, sha12(body)) + "\n"
+              + "constexpr int MTU = 1500;  // tampered\n"
+              + end_marker("c-wire", "//") + "\n")
+    out = _twin({"shadow_tpu/fake/defs.py": "CONFIG_MTU = 1500\n",
+                 "native/fake.cc": region})
+    assert _rules_of(out) == ["SIM205"]
+    assert out[0].path == "native/fake.cc"
+
+
+# ---------------------------------------------------------------------------
+# CLI + Makefile wiring
+
+
+def test_cli_check_and_list(tmp_path):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simgen", "--check"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr
+    assert "0 problem(s)" in run.stdout
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simgen", "--list"],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert run.returncode == 0
+    for surface in ("constants", "transitions", "hop-math", "congestion"):
+        assert surface in run.stdout
+    missing = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simgen",
+         "--spec", str(tmp_path / "nope.json")],
+        capture_output=True, text=True, cwd=REPO, env=env, timeout=120)
+    assert missing.returncode == 2
+
+
+def test_makefile_wires_gen_and_retires_spec():
+    with open(os.path.join(REPO, "Makefile"), encoding="utf-8") as f:
+        text = f.read()
+    assert "simgen --write" in text.split("gen:", 1)[1]
+    assert "simgen --check" in text.split("gen-check:", 1)[1]
+    # gen-check gates every lint pass
+    assert "gen-check" in text.split("\nlint:", 1)[1].split("\n", 1)[0]
+    # `make spec` is retired with a pointer at the new flow
+    spec_body = text.split("\nspec:", 1)[1].split("\n\n", 1)[0]
+    assert "retired" in spec_body and "exit 1" in spec_body
+
+
+def test_emit_spec_refuses_uncommitted_hand_edits(tmp_path):
+    """ISSUE 11 satellite: --emit-spec must not silently clobber
+    uncommitted working-tree edits to spec/protocol.json."""
+    def git(*args):
+        return subprocess.run(
+            ["git", "-c", "user.email=t@t", "-c", "user.name=t"]
+            + list(args), cwd=tmp_path, capture_output=True, text=True,
+            timeout=60)
+
+    (tmp_path / "pkg").mkdir()
+    (tmp_path / "spec").mkdir()
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""\
+        [tool.simlint]
+
+        [tool.simtwin.map]
+        wire-constants = [
+            "py:pkg/defs.py",
+        ]
+    """))
+    (tmp_path / "pkg" / "defs.py").write_text("CONFIG_MTU = 1500\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+
+    def emit(*extra):
+        return subprocess.run(
+            [sys.executable, "-m", "shadow_tpu.analysis.simtwin",
+             "--emit-spec", "spec/protocol.json",
+             "--config", str(tmp_path / "pyproject.toml")] + list(extra),
+            capture_output=True, text=True, cwd=tmp_path, env=env,
+            timeout=120)
+
+    assert git("init", "-q").returncode == 0
+    # first emission: file doesn't exist yet -> no refusal
+    assert emit().returncode == 0
+    assert git("add", "-A").returncode == 0
+    assert git("commit", "-qm", "base").returncode == 0
+    # clean tree, identical regeneration -> fine
+    assert emit().returncode == 0
+    # hand edit the DERIVED artifact -> refused with a pointer at the flow
+    spec_file = tmp_path / "spec" / "protocol.json"
+    doc = json.loads(spec_file.read_text())
+    doc["constants"]["MTU"]["python"]["value"] = 9000
+    spec_file.write_text(json.dumps(doc, indent=2, sort_keys=True) + "\n")
+    run = emit()
+    assert run.returncode == 1
+    assert "refusing" in run.stderr and "--force" in run.stderr
+    assert "protocol_spec.json" in run.stderr
+    # the hand edit survived the refusal
+    assert "9000" in spec_file.read_text()
+    # --force overwrites
+    assert emit("--force").returncode == 0
+    assert "9000" not in spec_file.read_text()
+
+
+# ---------------------------------------------------------------------------
+# the CUBIC payoff: cubicx defined once in the spec, live on all planes
+
+
+def test_cubicx_is_defined_only_in_the_spec():
+    """The variant's coefficients appear exactly where simgen emitted
+    them: inside generated regions on all three planes, wired to the
+    spec's values."""
+    from shadow_tpu.descriptor.tcp_cong import (Cubic, CubicX,
+                                                make_congestion_control)
+    from shadow_tpu.ops import protocol_tables as pt
+    c = SPEC["constants"]
+    cc = make_congestion_control("cubicx", 1448)
+    assert isinstance(cc, CubicX) and isinstance(cc, Cubic)
+    assert (cc.C, cc.BETA) == (c["CUBICX_C"], c["CUBICX_BETA"])
+    assert (pt.CUBICX_C, pt.CUBICX_BETA) == (c["CUBICX_C"],
+                                             c["CUBICX_BETA"])
+    assert pt.CC_KIND_IDS["cubicx"] == SPEC["congestion"]["kinds"]["cubicx"]
+    coeff = pt.cc_coefficients()
+    assert tuple(coeff[pt.CC_KIND_IDS["cubicx"]]) == (c["CUBICX_C"],
+                                                      c["CUBICX_BETA"])
+    # the class itself lives in a generated region, not hand code
+    path = os.path.join(REPO, "shadow_tpu/descriptor/tcp_cong.py")
+    with open(path, encoding="utf-8") as f:
+        regions, _ = scan_regions(f.read())
+    variants = {r.name: r for r in regions}["congestion-variants"]
+    assert "class CubicX(Cubic):" in variants.body
+
+
+def test_cc_kind_tables_stay_synced_with_the_spec():
+    """The two hand-kept CC token lists (core/options.TCP_CC_KINDS for
+    CLI validation, parallel/native_plane._CC_KINDS for the C plane)
+    cannot IMPORT the generated table — ops/__init__ force-imports jax
+    and flips x64 mode, far too heavy for the options layer — so this
+    gate holds them to the spec instead: adding a variant to the spec
+    without updating both lists fails here, not as a runtime KeyError."""
+    from shadow_tpu.core.options import TCP_CC_KINDS
+    from shadow_tpu.ops.protocol_tables import CC_KIND_IDS
+    from shadow_tpu.parallel.native_plane import _CC_KINDS
+    want = SPEC["congestion"]["kinds"]
+    assert CC_KIND_IDS == want                 # generated kernel table
+    assert _CC_KINDS == want                   # native-plane mapping
+    assert set(TCP_CC_KINDS) == set(want)      # CLI choice list
+    # hand-written base algorithms + every generated variant construct
+    from shadow_tpu.descriptor.tcp_cong import make_congestion_control
+    for kind in TCP_CC_KINDS:
+        assert make_congestion_control(kind, 1448).name == kind
+
+
+def test_unknown_per_host_tcpcc_fails_at_config_time():
+    """<host tcpcc=\"bbr\"> (unknown kind) must be rejected while the
+    config is being applied — with the host and the choices named — not
+    crash as a native-plane KeyError or a mid-run ValueError."""
+    xml = textwrap.dedent("""\
+        <shadow stoptime="10">
+          <plugin id="app" path="python:echo" />
+          <host id="h1" bandwidthdown="1024" bandwidthup="1024"
+                iphint="10.0.0.1" tcpcc="bbr">
+            <process plugin="app" starttime="1"
+                     arguments="tcp server 8000" />
+          </host>
+        </shadow>
+    """)
+    from shadow_tpu.core import configuration
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.core.options import Options
+    cfg = configuration.parse_xml(xml)
+    ctrl = Controller(Options(stop_time_sec=10, seed=1), cfg)
+    with pytest.raises(ValueError, match=r"h1.*tcpcc.*bbr"):
+        ctrl.setup()
+
+
+def test_per_host_tcpcc_round_trips_both_config_parsers():
+    """The dict parser must carry the per-host CC knob exactly like the
+    XML parser (both spellings), or dict scenarios silently lose it."""
+    from shadow_tpu.core import configuration
+    xml_cfg = configuration.parse_xml(
+        '<shadow stoptime="10">'
+        '<host id="a" tcpcc="cubicx" bandwidthdown="1" bandwidthup="1"/>'
+        "</shadow>")
+    assert xml_cfg.hosts[0].tcp_cc == "cubicx"
+    for key in ("tcpcc", "tcp_cc"):
+        dict_cfg = configuration.parse_dict(
+            {"stop_time": 10,
+             "hosts": {"a": {"bandwidth_down": 1, "bandwidth_up": 1,
+                             key: "cubicx"}}})
+        assert dict_cfg.hosts[0].tcp_cc == "cubicx", key
+
+
+def test_kernel_transition_tables_match_spec():
+    from shadow_tpu.ops import protocol_tables as pt
+    assert list(pt.TCP_STATES) == SPEC["transitions"]["states"]
+    pairs = {f"{f} -> {t}" for f, t in pt.TCP_TRANSITIONS}
+    assert pairs == set(SPEC["transitions"]["pairs"])
+    m = pt.transition_matrix()
+    assert m.shape == (12, 11)
+    assert m.sum() == len(SPEC["transitions"]["pairs"])
+    assert m[pt.state_id("established"), pt.TCP_STATES.index("close_wait")]
+    assert not m[pt.state_id("established"),
+                 pt.TCP_STATES.index("listen")]
+
+
+# -- runtime digest parity ---------------------------------------------------
+
+
+def _run_sim(xml, plane, stop, cc=None, seed=42):
+    from shadow_tpu.core import configuration
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.core.logger import SimLogger, set_logger
+    from shadow_tpu.core.options import Options
+    set_logger(SimLogger(level="warning"))
+    cfg = configuration.parse_xml(xml)
+    cfg.stop_time_sec = stop
+    kw = {"tcp_congestion_control": cc} if cc else {}
+    ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                              stop_time_sec=stop, seed=seed,
+                              dataplane=plane, **kw), cfg)
+    rc = ctrl.run()
+    return rc, ctrl.engine
+
+
+def _native_or_skip():
+    from shadow_tpu.parallel.native_plane import native_available
+    if not native_available():
+        pytest.skip("native dataplane not built")
+
+
+def test_cubicx_runtime_parity_python_vs_native():
+    """The generated C-plane cubicx must reproduce the generated
+    Python-plane cubicx bit-exactly — and both must actually take the
+    variant's trajectory (digest differs from stock cubic)."""
+    _native_or_skip()
+    from shadow_tpu.core.checkpoint import state_digest
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_tcp_e2e import two_host_xml
+    xml = two_host_xml("tcp client server 8000 3 65536", loss=0.1, stop=300)
+    rc_p, eng_p = _run_sim(xml, "python", 300, "cubicx")
+    rc_n, eng_n = _run_sim(xml, "native", 300, "cubicx")
+    assert rc_p == 0 and rc_n == 0
+    assert eng_n.native_plane is not None and eng_p.native_plane is None
+    assert eng_p.events_executed == eng_n.events_executed
+    assert state_digest(eng_p) == state_digest(eng_n)
+    rc_c, eng_c = _run_sim(xml, "python", 300, "cubic")
+    assert rc_c == 0
+    assert state_digest(eng_p) != state_digest(eng_c), (
+        "cubicx trajectory is indistinguishable from cubic — the variant "
+        "coefficients never engaged")
+
+
+def test_cubicx_per_host_selection_with_parity():
+    """<host tcpcc=\"cubicx\"> selects the variant for ONE host while the
+    rest keep the engine default — in both planes, digest-identically."""
+    _native_or_skip()
+    from shadow_tpu.core.checkpoint import state_digest
+    xml = textwrap.dedent("""\
+        <shadow stoptime="200">
+          <plugin id="app" path="python:echo" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240"
+                iphint="10.0.0.1">
+            <process plugin="app" starttime="1" arguments="tcp server 8000" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240"
+                iphint="10.0.0.2" tcpcc="cubicx">
+            <process plugin="app" starttime="2"
+                     arguments="tcp client server 8000 4 8192" />
+          </host>
+        </shadow>
+    """)
+    rc_p, eng_p = _run_sim(xml, "python", 200)
+    rc_n, eng_n = _run_sim(xml, "native", 200)
+    assert rc_p == 0 and rc_n == 0
+    assert eng_p.host_by_name("client").params.tcp_cc == "cubicx"
+    assert eng_p.host_by_name("server").params.tcp_cc is None
+    assert state_digest(eng_p) == state_digest(eng_n)
+
+
+# ---------------------------------------------------------------------------
+# THE GATE: zero problems, zero unsuppressed findings
+
+
+def test_gate_zero_simgen_problems_and_zero_findings():
+    """`make gen-check` + simtwin (incl. SIM205) over the real tree must
+    be clean: a hand edit inside any generated region, a spec newer than
+    its emitted regions, or any cross-plane drift fails HERE."""
+    assert simgen.check_tree(REPO, SPEC, SPEC_HASH, readback=True) == []
+    cfg = load_config(os.path.join(REPO, "pyproject.toml"))
+    result = twin_paths([os.path.join(REPO, "shadow_tpu"),
+                         os.path.join(REPO, "native")], cfg,
+                        load_map(None, cfg))
+    pretty = "\n".join(f.render() for f in result.unsuppressed)
+    assert not result.unsuppressed, (
+        f"cross-plane drift or generated-region violation:\n{pretty}")

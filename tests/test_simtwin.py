@@ -441,9 +441,17 @@ def test_spec_content_proves_extraction_is_alive():
     assert py_pairs == c_pairs
     assert len(spec["surfaces"]) >= 10
     # a surface mapping several symbols of ONE file keeps them all
+    # (CubicX is the simgen-generated spec-defined variant, ISSUE 11)
     cong = spec["surfaces"]["congestion-control"]
     assert cong["py:shadow_tpu/descriptor/tcp_cong.py"] == [
-        "CongestionControl", "Cubic"]
+        "CongestionControl", "Cubic", "CubicX"]
+    # symbol-anchored source attribution (ISSUE 11 satellite): no raw
+    # line offsets anywhere in the spec — a generated region changing a
+    # file's length can never churn this artifact
+    for canon, planes in spec["constants"].items():
+        for plane, site in planes.items():
+            assert "#" in site["source"] and not \
+                site["source"].rsplit("#", 1)[1].isdigit(), (canon, site)
 
 
 # ---------------------------------------------------------------------------
@@ -567,6 +575,81 @@ def test_cspec_array_with_trailing_comma_still_extracts():
     assert ext.constants["_ROT"][0] == [13, 15, 26, 6, 17, 29, 16, 24]
 
 
+def test_cspec_nested_block_comments_fold_like_a_c_compiler():
+    """ISSUE 11 satellite: /* */ does not nest in C — the first `*/`
+    closes the comment.  The extractor must keep line numbers exact
+    across the comment and still see every constant after it."""
+    from shadow_tpu.analysis import cspec
+    src = ("/* outer /* inner (not a nested open) */\n"
+           "constexpr int MTU = 1500;\n"
+           "/* multi\n"
+           "   line /* with noise\n"
+           "*/\n"
+           "constexpr int MSS = 1460;\n")
+    ext = cspec.extract("t.cc", src)
+    assert ext.constants["MTU"] == (1500, 2)
+    assert ext.constants["MSS"] == (1460, 6)
+
+
+def test_cspec_if_guarded_constants_last_definition_wins():
+    """#if/#else branches are all scanned (no preprocessor evaluation);
+    the LAST definition of a name wins, deterministically — the shape
+    generated regions meet around include guards."""
+    from shadow_tpu.analysis import cspec
+    src = ("#ifndef DATAPLANE_GUARD\n"
+           "#define DATAPLANE_GUARD 1\n"
+           "#if USE_FAST\n"
+           "#define LIMIT 9\n"
+           "#else\n"
+           "#define LIMIT 12\n"
+           "#endif\n"
+           "constexpr int CAP = LIMIT + 1;\n")
+    ext = cspec.extract("t.cc", src)
+    assert ext.constants["LIMIT"] == (12, 6)      # last branch wins
+    assert ext.constants["CAP"][0] == 13          # folded through env
+
+
+def test_cspec_multiline_constexpr_arrays_extract():
+    """constexpr arrays spanning lines (the simgen-emitted shape)."""
+    from shadow_tpu.analysis import cspec
+    src = ("static constexpr int64_t DELAYS[2] = {\n"
+           "    1000000,\n"
+           "    5000000,\n"
+           "};\n"
+           "constexpr int TF[8] = {13, 15, 26, 6,\n"
+           "                       17, 29, 16, 24};\n")
+    ext = cspec.extract("t.cc", src)
+    assert ext.constants["DELAYS"] == ([1000000, 5000000], 1)
+    assert ext.constants["TF"][0] == [13, 15, 26, 6, 17, 29, 16, 24]
+
+
+def test_spec_sources_stable_when_a_region_grows():
+    """ISSUE 11 satellite: SIM201/202 sources anchor to SYMBOLS, so a
+    generated fenced region growing by 3 lines must leave the emitted
+    spec byte-identical (line offsets shifted; anchors did not)."""
+    from shadow_tpu.analysis.twin_rules import TwinModel, build_spec
+    smap = parse_map({"wire-constants": ["py:shadow_tpu/fake/defs.py",
+                                         "c:native/fake.cc"],
+                      "tcp-state-machine": ["py:shadow_tpu/fake/tcp.py",
+                                            "c:native/fake.cc"]})
+    c_src = ("// >>> simgen:begin region=x spec=aaaaaaaaaaaa "
+             "body=aaaaaaaaaaaa\n"
+             "{FILLER}"
+             "// <<< simgen:end region=x\n"
+             "constexpr int MTU = 1500;\n"
+             + textwrap.dedent(_C_TCP_OK))
+    py_srcs = {"shadow_tpu/fake/defs.py": "CONFIG_MTU = 1500\n",
+               "shadow_tpu/fake/tcp.py": textwrap.dedent(_PY_TCP)}
+    blob = []
+    for filler in ("", "// a\n// b\n// c\n"):
+        twin = TwinModel(dict(py_srcs,
+                              **{"native/fake.cc":
+                                 c_src.replace("{FILLER}", filler)}), smap)
+        blob.append(json.dumps(build_spec(twin), indent=2, sort_keys=True))
+    assert blob[0] == blob[1], "spec churned when a region grew 3 lines"
+    assert "native/fake.cc#MTU" in blob[0]
+
+
 def test_cspec_probe_disagreement_surfaces_as_drift():
     # two divergent spellings of one coefficient inside the C plane must
     # COMPARE UNEQUAL against the python plane, not silently drop the
@@ -649,7 +732,7 @@ def test_cli_exit_codes(tmp_path):
          "--list-rules"],
         capture_output=True, text=True, cwd=REPO, timeout=120)
     assert rules.returncode == 0
-    for rid in ("SIM201", "SIM202", "SIM203", "SIM204"):
+    for rid in ("SIM201", "SIM202", "SIM203", "SIM204", "SIM205"):
         assert rid in rules.stdout
 
 
