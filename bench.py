@@ -339,6 +339,14 @@ def _run_sim(xml, policy: str, workers: int, stop: int, **opt_kw) -> dict:
             # by one extension call; demoted must be 0 in a healthy run
             out["native_round_windows"] = scrape["native.round_windows"]
             out["native_round_demoted"] = scrape["native.round_demoted"]
+        if "native.py_exec_batch_calls" in scrape:
+            # batched continuation plane (ISSUE 12): green-thread resumes
+            # delivered per fused py_exec_batch call; single must be 0 in
+            # a healthy (undemoted) run
+            out["py_exec_batch_calls"] = scrape["native.py_exec_batch_calls"]
+            out["continuations_fused"] = scrape["native.continuations_fused"]
+            out["continuation_batch_size"] = scrape[
+                "native.continuation_batch_size"]
     if "policy.device_calls" in scrape:
         # device engagement is a tracked metric (VERDICT r3 weak #1/#6):
         # how many round flushes actually dispatched to the device vs took
@@ -695,6 +703,8 @@ def _tor10k_flagship_rows(scenario: str,
     latency structure trivial — the gate is recorded, not enforced."""
     from shadow_tpu.tools import workloads
 
+    import tempfile
+
     stop_long = TOR10K_STOPTIME * 8
     kw = dict(topology_path=topo_path) if topo_path else {}
     xml = workloads.tor_network(10000, stoptime=stop_long,
@@ -705,9 +715,14 @@ def _tor10k_flagship_rows(scenario: str,
         scenario=scenario)
     # the two planes COMPOSED: the C data plane executes the control
     # plane (10k circuit builds over real TCP — the Amdahl term) while
-    # the bulk cells advance in HBM
-    flag = dict(_run_sim(xml, "global", 0, stop_long), stoptime=stop_long,
-                scenario=scenario)
+    # the bulk cells advance in HBM.  The run streams its metrics JSONL so
+    # the PR10-vs-now column diff below goes through the same
+    # trace_report --compare path humans use.
+    mpath = os.path.join(tempfile.mkdtemp(prefix="bench-tor10k-"),
+                         "metrics.jsonl")
+    flag = dict(_run_sim(xml, "global", 0, stop_long, metrics_path=mpath),
+                stoptime=stop_long, scenario=scenario)
+    flag["vs_pr10"] = _compare_vs_pr10(mpath, scenario, stop_long)
     host_wall = flag["host_exec_sec"] + flag["flush_sec"]
     r05_wall = TOR10K_R05["host_exec_sec"] + TOR10K_R05["flush_sec"]
     flag["host_wall_sec"] = round(host_wall, 2)
@@ -723,6 +738,33 @@ def _tor10k_flagship_rows(scenario: str,
                                else f"stoptime {stop_long} != 64"))
     out["tor10k_device_plane_native_long"] = flag
     return out
+
+
+def _compare_vs_pr10(metrics_path: str, scenario: str, stop_long: int):
+    """ISSUE 12 acceptance surface: diff this flagship run's metrics JSONL
+    against the checked-in PR 10 measurement of the SAME stand-in scenario
+    (BENCH_PR10_tor10k.metrics.jsonl, captured on this box before the
+    continuation plane landed) through trace_report.compare_metrics — the
+    continuation-plane columns the PR is judged by, as (pr10, now, ratio)
+    triples.  None when not comparable (different scenario/stoptime, or
+    the baseline file is absent)."""
+    from shadow_tpu.obs.metrics import read_metrics_file
+    from shadow_tpu.tools.trace_report import compare_metrics
+
+    base = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_PR10_tor10k.metrics.jsonl")
+    if scenario != "standin" or stop_long != 64 or not os.path.exists(base):
+        return None
+    try:
+        cmp_ = compare_metrics(read_metrics_file(base),
+                               read_metrics_file(metrics_path))
+    except (OSError, ValueError) as e:
+        return {"error": repr(e)}
+    cols = cmp_["columns"]
+    keep = ("engine.host_exec_ctrl_sec", "engine.host_exec_plugin_sec",
+            "engine.host_exec_sec", "engine.flush_sec",
+            "native.events_executed", "engine.events")
+    return {k: cols[k] for k in keep if k in cols}
 
 
 def _run_scale_scenario(name: str, device_plane: str = "device",
@@ -1126,9 +1168,52 @@ def bench_smoke() -> int:
                             "executor drove no windows")
         if r_phold.get("native_round_demoted"):
             failures.append("C round executor demoted during the smoke")
+        # batched continuation plane (ISSUE 12): green-thread wakes must
+        # deliver through py_exec_batch (per-event deliveries mean the
+        # executor demoted or the ledger never engaged)
+        if not r_phold.get("continuations_fused"):
+            failures.append("no continuations delivered through "
+                            "py_exec_batch on the phold leg")
     else:
         failures.append("native plane never engaged on the phold leg "
                         "(extension missing?)")
+    # untraced continuation overhead (ISSUE 12 satellite): the resume path
+    # binds its tracer hook at Process construction — with tracing off the
+    # fast path must be bound (zero span machinery per resume), and its
+    # entry cost must measure ~0
+    from shadow_tpu.process.process import Process
+
+    class _ProbeHost:
+        def next_process_id(self):
+            return 1
+
+        def add_process(self, p):
+            pass
+
+    probe = Process(_ProbeHost(), "probe", lambda api, args: 0, [], 0)
+    if probe._continue_now.__func__ is not Process._continue_fast:
+        failures.append("untraced run bound the traced continue path "
+                        "(span construction back on the resume path)")
+    # a live-but-blocked thread keeps the probe process alive, so each
+    # timed call runs the REAL fast-path frame (entry + runnable scan +
+    # done check), not just the exited-guard early return
+    from shadow_tpu.process.process import BLOCKED
+
+    def _probe_gen():
+        yield None
+
+    probe.spawn_thread(_probe_gen()).state = BLOCKED
+    n_probe = 50_000
+    t0 = time.perf_counter_ns()
+    for _ in range(n_probe):
+        probe._continue_now()
+    per_call_ns = (time.perf_counter_ns() - t0) / n_probe
+    out["continue_untraced_ns_per_call"] = round(per_call_ns, 1)
+    if per_call_ns > 2000:
+        failures.append(f"untraced continue_ entry costs {per_call_ns:.0f}"
+                        "ns/call — the bound fast path is not ~0")
+    out["continuations_fused"] = r_phold.get("continuations_fused")
+    out["continuation_batch_size"] = r_phold.get("continuation_batch_size")
     if not quiet_skips:
         failures.append("no quiet flush rounds on the star leg — "
                         "dirty-tracking is not engaging")

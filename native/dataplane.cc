@@ -35,7 +35,7 @@
 
 namespace {
 
-// >>> simgen:begin region=c-protocol-constants spec=4b732374c3c9 body=79a2955fdd12
+// >>> simgen:begin region=c-protocol-constants spec=f421682bce6f body=79a2955fdd12
 // ---- constants (mirror core/defs.py / descriptor/tcp.py) ------------------
 constexpr int64_t SIM_MS = 1000000LL;
 constexpr int64_t SIM_SEC = 1000000000LL;
@@ -66,7 +66,15 @@ enum { S_ACTIVE = 1, S_READABLE = 2, S_WRITABLE = 4, S_CLOSED = 8 };
 enum { F_RST = 2, F_SYN = 4, F_ACK = 8, F_FIN = 16 };
 // <<< simgen:end region=c-protocol-constants
 
-// >>> simgen:begin region=c-tcp-states spec=4b732374c3c9 body=bd57e0fc733c
+// >>> simgen:begin region=c-epoll-bits spec=f421682bce6f body=fc15dfac4ddd
+// epoll readiness bits (descriptor/epoll.py) — the C-side
+// readiness cache (ISSUE 12) computes revents for epoll-watched
+// native sockets with these
+enum { EPOLLIN = 0x001, EPOLLOUT = 0x004, EPOLLERR = 0x008, EPOLLHUP = 0x010 };
+// <<< simgen:end region=c-epoll-bits
+constexpr unsigned EPOLLET = 1u << 31;
+
+// >>> simgen:begin region=c-tcp-states spec=f421682bce6f body=bd57e0fc733c
 enum TcpState {
   ST_CLOSED = 0, ST_LISTEN, ST_SYN_SENT, ST_SYN_RECEIVED, ST_ESTABLISHED,
   ST_FIN_WAIT_1, ST_FIN_WAIT_2, ST_CLOSING, ST_TIME_WAIT, ST_CLOSE_WAIT,
@@ -241,7 +249,7 @@ struct Tally {
 };
 
 // ---- congestion control (descriptor/tcp_cong.py) ---------------------------
-// >>> simgen:begin region=c-congestion-params spec=4b732374c3c9 body=8264260e3de1
+// >>> simgen:begin region=c-congestion-params spec=f421682bce6f body=8264260e3de1
 enum CcKind { CC_RENO = 0, CC_AIMD = 1, CC_CUBIC = 2, CC_CUBICX = 3 };
 // CUBIC coefficient families (RFC 9438 §4.1 / §4.6)
 constexpr double CUBIC_C = 0.4;
@@ -552,6 +560,25 @@ enum SockKind { K_TCP = 0, K_UDP = 1 };
 
 struct Iface;  // fwd
 
+// one blocked green thread (process._Block on a C-plane socket): the wake
+// condition is decided HERE, at status-change time, with no Python callback
+// (ISSUE 12 piece 2) — the fired cont_id is applied by the continuation
+// ledger at delivery
+struct BlockWait {
+  int bits = 0;          // wake when status & (bits | S_CLOSED)
+  int64_t cont_id = -1;  // ledger entry (parallel/native_plane.py)
+  int32_t token = -1;    // owning process's coalescing token
+};
+
+// one epoll membership of a C-plane socket: want mask + the C-computed
+// revents cache, so Epoll._refresh never recomputes _revents_for in Python
+struct EpWatch {
+  int64_t ep_tok = -1;   // plane-assigned epoll identity
+  unsigned want = 0;     // EPOLLIN|EPOLLOUT (+EPOLLET)
+  int prev_r = 0;        // edge detector (mirror of Epoll._prev)
+  int delivered = 0;     // last revents delivered to Python (LT dedupe)
+};
+
 struct Sock {
   int32_t id = -1;
   int32_t hid = -1;
@@ -560,6 +587,8 @@ struct Sock {
   bool closed = false;   // descriptor closed (base Descriptor.close ran)
   bool watched = false;  // Python listeners present -> fire CB_STATUS
   int32_t status = 0;
+  std::vector<BlockWait> waiters;   // blocked green threads (fire in order)
+  std::vector<EpWatch> ep_watches;  // epoll memberships (readiness cache)
 
   // naming: -1 == Python None (wrapper translates)
   int64_t bound_ip = -1, bound_port = -1, peer_ip = -1, peer_port = -1;
@@ -701,6 +730,10 @@ enum EvType {
   EV_PERSIST,       // a = sock
   EV_DELACK,        // a = sock
   EV_TIMEWAIT,      // a = sock
+  EV_PY_CONT,       // green-thread continuation (ISSUE 12): a = ledger
+                    // cont_id, b = process token (>=0: coalesced continue,
+                    // clear cont_pending[b] on execute) or -1 (one-shot
+                    // sleep/timeout/device wake)
 };
 
 struct Ev {
@@ -736,7 +769,10 @@ struct EvGreater {  // min-heap via std::*_heap with greater-than
 };
 
 // ---- callback kinds --------------------------------------------------------
-enum CbKind { CB_STATUS = 0, CB_CHILD = 1, CB_CLOSED = 2 };
+// CB_EPOLL: a = sid, b = (ep_tok << 16) | revents — the C readiness cache's
+// delivery to the Python Epoll (ISSUE 12; fires only when the epoll-visible
+// outcome CHANGES, so quiet status churn never crosses the boundary)
+enum CbKind { CB_STATUS = 0, CB_CHILD = 1, CB_CLOSED = 2, CB_EPOLL = 3 };
 
 // ---- the plane -------------------------------------------------------------
 struct Plane {
@@ -768,6 +804,15 @@ struct Plane {
   EvKey py_key;
   int64_t now;              // current virtual time during C execution
   int32_t active_host;      // current executing host (seq owner for pushes)
+  // continuation plane (ISSUE 12): cont_cb delivers ONE continuation
+  // (per-event/demoted path); fired collects block-wake cont_ids decided
+  // in C awaiting ledger application; cont_pending/token tables mirror
+  // Process._continue_scheduled coalescing per registered process
+  PyObject *cont_cb;
+  std::vector<int64_t> *fired;
+  std::vector<uint8_t> *cont_pending;    // token -> continue event in flight
+  std::vector<int32_t> *cont_token_hid;  // token -> host id
+  std::vector<int64_t> *cont_token_id;   // token -> persistent ledger id
   // counters
   int64_t events_scheduled, events_executed, packet_drops;
   int64_t last_event_time;
@@ -829,21 +874,117 @@ bool plane_cb(Plane *pl, int kind, int32_t hid, int64_t a, int64_t b) {
   return true;
 }
 
-// adjust_status mirror: returns false on callback exception
+// Propagate Python-callback exceptions: CK(x) bubbles a false return up the
+// call chain to run()/the API entry, where the pending exception surfaces.
+#define CK(x) do { if (!(x)) return false; } while (0)
+
+// ---- continuation plane (ISSUE 12) -----------------------------------------
+
+// push one green-thread continuation event (EV_PY_CONT) with the EXACT
+// identity Worker.schedule_task would claim: time = now + delay, dst = src =
+// the process's host, seq from that host's counter at this moment.  Returns
+// the scheduled time, or -1 when declined (past end time) — the same
+// decline schedule_task answers with None.
+int64_t plane_push_cont(Plane *pl, int64_t now, int32_t hid, int64_t delay,
+                        int64_t cont_id, int64_t token) {
+  int64_t t = now + (delay > 0 ? delay : 0);
+  if (t >= pl->end_time) return -1;
+  HostS *h = pl->H(hid);
+  Ev ev;
+  ev.time = t;
+  ev.dst = hid;
+  ev.src = hid;
+  ev.seq = h->next_event_sequence();
+  ev.type = EV_PY_CONT;
+  ev.a = (int32_t)cont_id;
+  ev.b = token;
+  ev.pkt = nullptr;
+  plane_push_ev(pl, ev);
+  return t;
+}
+
+// coalesced process-continue (Process._schedule_continue mirror): one
+// continue event in flight per process, tracked HERE so C-side block wakes
+// and Python-side wakes share one flag.  Returns whether an event was
+// pushed (false: already pending, or declined past end time).
+bool plane_sched_continue(Plane *pl, int64_t now, int32_t token) {
+  if ((*pl->cont_pending)[token]) return false;
+  int64_t t = plane_push_cont(pl, now, (*pl->cont_token_hid)[token], 0,
+                              (*pl->cont_token_id)[token], token);
+  if (t < 0) return false;
+  (*pl->cont_pending)[token] = 1;
+  return true;
+}
+
+inline int ep_revents(int status, unsigned want) {
+  int r = 0;
+  if ((want & EPOLLIN) && (status & S_READABLE)) r |= EPOLLIN;
+  if ((want & EPOLLOUT) && (status & S_WRITABLE)) r |= EPOLLOUT;
+  if (status & S_CLOSED) r |= EPOLLHUP;
+  return r;
+}
+
+// epoll readiness cache: recompute revents for every epoll watching this
+// sock and deliver to Python ONLY when the epoll-visible outcome changed
+// (LT: the cached revents moved; ET: a fresh edge) — the exact transitions
+// Epoll._refresh would have detected, minus the per-change recompute.
+bool sock_update_ep(Plane *pl, Sock *s) {
+  for (auto &w : s->ep_watches) {
+    int r = ep_revents(s->status, w.want);
+    if (w.want & EPOLLET) {
+      int edges = r & ~w.prev_r;
+      w.prev_r = r;
+      if (edges) {
+        w.delivered |= edges;
+        CK(plane_cb(pl, CB_EPOLL, s->hid, s->id,
+                    (w.ep_tok << 16) | (unsigned)edges));
+      }
+    } else if (r != w.delivered) {
+      w.delivered = r;
+      CK(plane_cb(pl, CB_EPOLL, s->hid, s->id,
+                  (w.ep_tok << 16) | (unsigned)r));
+    }
+  }
+  return true;
+}
+
+// block-wake decision IN C (no Python callback): a blocked green thread's
+// condition (status & (bits|S_CLOSED)) is checked at the status change;
+// satisfied waiters are recorded in pl->fired (applied by the ledger at
+// delivery) and the owning process's coalesced continue event is pushed —
+// exactly what the retired Python on_status closure did per wake.
+void sock_fire_waiters(Plane *pl, Sock *s) {
+  if (s->waiters.empty()) return;
+  for (size_t i = 0; i < s->waiters.size();) {
+    BlockWait &w = s->waiters[i];
+    if (s->status & (w.bits | S_CLOSED)) {
+      int64_t cid = w.cont_id;
+      int32_t tok = w.token;
+      s->waiters.erase(s->waiters.begin() + i);
+      pl->fired->push_back(cid);
+      plane_sched_continue(pl, pl->now, tok);
+    } else {
+      i++;
+    }
+  }
+}
+
+// adjust_status mirror: returns false on callback exception.  Listener
+// order mirrors the Python plane's registration order for the common
+// shapes: CB_STATUS (foreign listeners) first, then epoll memberships,
+// then blocked-thread waiters (a block is registered last in practice).
 bool sock_adjust_status(Plane *pl, Sock *s, int bits, bool on) {
   int old = s->status;
   if (on) s->status |= bits;
   else s->status &= ~bits;
   int changed = old ^ s->status;
-  if (changed && s->watched) {
-    return plane_cb(pl, CB_STATUS, s->hid, s->id, changed);
+  if (changed) {
+    if (s->watched) CK(plane_cb(pl, CB_STATUS, s->hid, s->id, changed));
+    CK(sock_update_ep(pl, s));
+    sock_fire_waiters(pl, s);
   }
   return true;
 }
-
-// Propagate Python-callback exceptions: CK(x) bubbles a false return up the
-// call chain to run()/the API entry, where the pending exception surfaces.
-#define CK(x) do { if (!(x)) return false; } while (0)
 
 // ---- binding table ---------------------------------------------------------
 std::unordered_map<uint64_t, int32_t> &bind_map(Iface *f, int kind) {
@@ -2084,6 +2225,20 @@ bool plane_exec(Plane *pl, Ev &ev) {
       if (s->state == ST_TIME_WAIT) return tcp_teardown(pl, s);
       return true;
     }
+    case EV_PY_CONT: {
+      // per-event delivery (the demoted/pop-loop path; the round executor
+      // batches runs of these through py_exec_batch instead): clear the
+      // coalescing flag BEFORE the resume — a wake arriving during the
+      // continue schedules a fresh event, exactly like the Python plane
+      if (ev.b >= 0) (*pl->cont_pending)[ev.b] = 0;
+      if (!pl->cont_cb || pl->cont_cb == Py_None) return true;
+      PyObject *r = PyObject_CallFunction(pl->cont_cb, "LL",
+                                          (long long)ev.a,
+                                          (long long)ev.time);
+      if (!r) return false;
+      Py_DECREF(r);
+      return true;
+    }
   }
   return true;
 }
@@ -2136,6 +2291,11 @@ PyObject *Plane_py_new(PyTypeObject *type, PyObject *, PyObject *) {
   pl->ip2host = new std::unordered_map<int64_t, int32_t>();
   pl->cb = nullptr;
   pl->xshard_cb = nullptr;
+  pl->cont_cb = nullptr;
+  pl->fired = new std::vector<int64_t>();
+  pl->cont_pending = new std::vector<uint8_t>();
+  pl->cont_token_hid = new std::vector<int32_t>();
+  pl->cont_token_id = new std::vector<int64_t>();
   pl->lat_arr = pl->rel_arr = pl->cnt_arr = nullptr;
   pl->lat = nullptr;
   pl->rel = nullptr;
@@ -2167,8 +2327,13 @@ void Plane_dealloc(PyObject *self) {
   for (HostS *h : *pl->hosts) delete h;
   delete pl->hosts;
   delete pl->ip2host;
+  delete pl->fired;
+  delete pl->cont_pending;
+  delete pl->cont_token_hid;
+  delete pl->cont_token_id;
   Py_XDECREF(pl->cb);
   Py_XDECREF(pl->xshard_cb);
+  Py_XDECREF(pl->cont_cb);
   Py_XDECREF(pl->lat_arr);
   Py_XDECREF(pl->rel_arr);
   Py_XDECREF(pl->cnt_arr);
@@ -2833,7 +2998,227 @@ PyObject *Plane_lower_limit(PyObject *self, PyObject *args) {
   Py_RETURN_NONE;
 }
 
-// run_window(window_end, py_key_or_None, py_exec) -> native events executed.
+// ---- continuation plane methods (ISSUE 12) ---------------------------------
+
+PyObject *Plane_set_cont_callback(PyObject *self, PyObject *cb) {
+  Plane *pl = SELF;
+  Py_INCREF(cb);
+  Py_XDECREF(pl->cont_cb);
+  pl->cont_cb = cb;
+  Py_RETURN_NONE;
+}
+
+// register_proc(hid, cont_id) -> token: one coalescing slot per process,
+// carrying its persistent "continue" ledger entry
+PyObject *Plane_register_proc(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long hid, cont_id;
+  if (!PyArg_ParseTuple(args, "LL", &hid, &cont_id)) return nullptr;
+  int32_t token = (int32_t)pl->cont_pending->size();
+  pl->cont_pending->push_back(0);
+  pl->cont_token_hid->push_back((int32_t)hid);
+  pl->cont_token_id->push_back(cont_id);
+  return PyLong_FromLong(token);
+}
+
+// sched_continue(now, token) -> pushed? (False: already pending/declined)
+PyObject *Plane_sched_continue(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long now, token;
+  if (!PyArg_ParseTuple(args, "LL", &now, &token)) return nullptr;
+  return PyBool_FromLong(plane_sched_continue(pl, now, (int32_t)token));
+}
+
+// push_cont(now, hid, delay, cont_id) -> scheduled time | None (declined)
+PyObject *Plane_push_cont(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long now, hid, delay, cont_id;
+  if (!PyArg_ParseTuple(args, "LLLL", &now, &hid, &delay, &cont_id))
+    return nullptr;
+  int64_t t = plane_push_cont(pl, now, (int32_t)hid, delay, cont_id, -1);
+  if (t < 0) Py_RETURN_NONE;
+  return PyLong_FromLongLong(t);
+}
+
+// push_cont_batch([(now, hid, delay, cont_id), ...]) -> scheduled count.
+// ONE extension call lands a whole collect's worth of wakes (the device
+// plane's completion fold), claiming per-host seqs in list order — the
+// identical identities the per-event push chain would claim.
+PyObject *Plane_push_cont_batch(PyObject *self, PyObject *arg) {
+  Plane *pl = SELF;
+  PyObject *seq = PySequence_Fast(arg, "push_cont_batch expects a sequence");
+  if (!seq) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  int64_t pushed = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    PyObject *it = PySequence_Fast_GET_ITEM(seq, i);
+    long long now, hid, delay, cont_id;
+    if (!PyArg_ParseTuple(it, "LLLL", &now, &hid, &delay, &cont_id)) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    if (plane_push_cont(pl, now, (int32_t)hid, delay, cont_id, -1) >= 0)
+      pushed++;
+  }
+  Py_DECREF(seq);
+  return PyLong_FromLongLong(pushed);
+}
+
+// pop_cont() -> (cont_id, time) | None.  The batch drainer's step: pops the
+// heap top iff it is a continuation that is next in the TOTAL order (below
+// the window horizon and the mirrored Python top).  Re-checking the heap
+// each step makes the drain intrusion-safe: a C event pushed by the
+// previous resume (an app send scheduling interface work) stops the run
+// exactly where the per-event order would.
+PyObject *Plane_pop_cont(PyObject *self, PyObject *) {
+  Plane *pl = SELF;
+  if (!pl->in_round || pl->heap->empty()) Py_RETURN_NONE;
+  const Ev &top = pl->heap->front();
+  if (top.type != EV_PY_CONT || !key_lt(top, pl->limit)) Py_RETURN_NONE;
+  if (pl->py_has) {
+    EvKey ck{top.time, top.dst, top.src, top.seq};
+    if (!evkey_lt(ck, pl->py_key)) Py_RETURN_NONE;
+  }
+  std::pop_heap(pl->heap->begin(), pl->heap->end(), EvGreater());
+  Ev ev = pl->heap->back();
+  pl->heap->pop_back();
+  pl->now = ev.time;
+  pl->active_host = ev.dst;
+  pl->last_event_time = ev.time;
+  pl->events_executed++;
+  if (ev.b >= 0) (*pl->cont_pending)[ev.b] = 0;
+  return Py_BuildValue("LL", (long long)ev.a, (long long)ev.time);
+}
+
+// take_fired() -> [cont_id, ...] | None: drain the C-decided block wakes
+// awaiting ledger application (None when empty — the common case costs one
+// branch)
+PyObject *Plane_take_fired(PyObject *self, PyObject *) {
+  Plane *pl = SELF;
+  if (pl->fired->empty()) Py_RETURN_NONE;
+  Py_ssize_t n = (Py_ssize_t)pl->fired->size();
+  PyObject *out = PyList_New(n);
+  if (!out) return nullptr;
+  for (Py_ssize_t i = 0; i < n; i++)
+    PyList_SET_ITEM(out, i, PyLong_FromLongLong((*pl->fired)[i]));
+  pl->fired->clear();
+  return out;
+}
+
+// sock_block(sid, bits, cont_id, token) -> 0 (condition already true; not
+// registered) | 1 (waiter registered; a later status change satisfying
+// status & (bits|S_CLOSED) fires it in C)
+PyObject *Plane_sock_block(PyObject *self, PyObject *args) {
+  long long sid, bits, cont_id, token;
+  if (!PyArg_ParseTuple(args, "LLLL", &sid, &bits, &cont_id, &token))
+    return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  if (s->status & ((int)bits | S_CLOSED)) return PyLong_FromLong(0);
+  BlockWait w;
+  w.bits = (int)bits;
+  w.cont_id = cont_id;
+  w.token = (int32_t)token;
+  s->waiters.push_back(w);
+  return PyLong_FromLong(1);
+}
+
+// sock_unblock(sid, cont_id): cancel a registered waiter (timeout fired
+// first / process teardown)
+PyObject *Plane_sock_unblock(PyObject *self, PyObject *args) {
+  long long sid, cont_id;
+  if (!PyArg_ParseTuple(args, "LL", &sid, &cont_id)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  for (auto it = s->waiters.begin(); it != s->waiters.end(); ++it)
+    if (it->cont_id == cont_id) {
+      s->waiters.erase(it);
+      break;
+    }
+  Py_RETURN_NONE;
+}
+
+// ep_add(ep_tok, sid, want) -> initial revents (LT: full; ET: the initial
+// edge) — the ctl_add-time refresh, delivered synchronously
+PyObject *Plane_ep_add(PyObject *self, PyObject *args) {
+  long long tok, sid;
+  unsigned long long want;
+  if (!PyArg_ParseTuple(args, "LLK", &tok, &sid, &want)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  EpWatch w;
+  w.ep_tok = tok;
+  w.want = (unsigned)want;
+  int r = ep_revents(s->status, w.want);
+  if (w.want & EPOLLET) {
+    w.prev_r = r;
+    w.delivered = r;
+  } else {
+    w.delivered = r;
+  }
+  s->ep_watches.push_back(w);
+  return PyLong_FromLong(r);
+}
+
+// ep_mod(ep_tok, sid, want) -> revents under the new mask (LT: full set;
+// ET: fresh edges vs the surviving edge detector) — the ctl_mod refresh
+PyObject *Plane_ep_mod(PyObject *self, PyObject *args) {
+  long long tok, sid;
+  unsigned long long want;
+  if (!PyArg_ParseTuple(args, "LLK", &tok, &sid, &want)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  for (auto &w : s->ep_watches)
+    if (w.ep_tok == tok) {
+      w.want = (unsigned)want;
+      int r = ep_revents(s->status, w.want);
+      if (w.want & EPOLLET) {
+        int edges = r & ~w.prev_r;
+        w.prev_r = r;
+        w.delivered |= edges;
+        return PyLong_FromLong(edges);
+      }
+      w.delivered = r;
+      return PyLong_FromLong(r);
+    }
+  PyErr_SetString(PyExc_KeyError, "ep_mod: watch not registered");
+  return nullptr;
+}
+
+PyObject *Plane_ep_del(PyObject *self, PyObject *args) {
+  long long tok, sid;
+  if (!PyArg_ParseTuple(args, "LL", &tok, &sid)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  for (auto it = s->ep_watches.begin(); it != s->ep_watches.end(); ++it)
+    if (it->ep_tok == tok) {
+      s->ep_watches.erase(it);
+      break;
+    }
+  Py_RETURN_NONE;
+}
+
+// ep_poison(sid, revents) — TEST-ONLY cache desync: forges a CB_EPOLL
+// delivery claiming ``revents`` without any status change, so the poison
+// gate (Epoll.wait's cache-vs-status cross-check) can prove a desynced
+// cache fails loudly instead of delivering a wrong wake
+PyObject *Plane_ep_poison(PyObject *self, PyObject *args) {
+  Plane *pl = SELF;
+  long long sid, revents;
+  if (!PyArg_ParseTuple(args, "LL", &sid, &revents)) return nullptr;
+  Sock *s = GET_SOCK(sid);
+  if (!s) return nullptr;
+  for (auto &w : s->ep_watches) {
+    w.delivered = (int)revents;
+    if (!plane_cb(pl, CB_EPOLL, s->hid, s->id,
+                  (w.ep_tok << 16) | (unsigned)revents))
+      return nullptr;
+  }
+  Py_RETURN_NONE;
+}
+
+// run_window(window_end, py_key_or_None, py_exec, py_exec_batch) -> native
+// events executed.
 // The ISSUE 10 round executor: ONE extension call drives the WHOLE merged
 // window.  C events below window_end execute natively; whenever the Python
 // queue's top (mirrored in py_key) precedes the C heap's top, py_exec() is
@@ -2842,11 +3227,17 @@ PyObject *Plane_lower_limit(PyObject *self, PyObject *args) {
 // (NativeGlobalPolicy.pop), a native event pays zero Python and a Python
 // event pays one callback instead of a peek/next_key/compare/pop round
 // trip, so per-round Python cost is O(python events), not O(all events).
+// Continuation-run fusion (ISSUE 12): when the heap's next event is a
+// green-thread continuation (EV_PY_CONT), ONE py_exec_batch() call drains
+// the whole run of consecutive continuations through pop_cont — per-event
+// delivery (py_exec_batch=None) and the pop loop remain the demotion
+// targets.
 PyObject *Plane_run_window(PyObject *self, PyObject *args) {
   Plane *pl = SELF;
   long long window_end;
-  PyObject *py_key, *py_exec;
-  if (!PyArg_ParseTuple(args, "LOO", &window_end, &py_key, &py_exec))
+  PyObject *py_key, *py_exec, *py_batch = Py_None;
+  if (!PyArg_ParseTuple(args, "LOO|O", &window_end, &py_key, &py_exec,
+                        &py_batch))
     return nullptr;
   pl->py_has = false;
   if (py_key != Py_None) {
@@ -2871,6 +3262,27 @@ PyObject *Plane_run_window(PyObject *self, PyObject *args) {
       if (evkey_lt(pl->py_key, ck)) c_ok = false;  // Python event first
     }
     if (c_ok) {
+      if (pl->heap->front().type == EV_PY_CONT && py_batch != Py_None) {
+        // continuation-run fusion: one callback resumes the whole run of
+        // consecutive continuations (the drainer pulls them via pop_cont,
+        // which re-checks the total order every step)
+        PyObject *r = PyObject_CallObject(py_batch, nullptr);
+        if (!r) {
+          pl->in_run = pl->in_round = false;
+          return nullptr;  // resume raised (or the fault drill fired)
+        }
+        long long consumed = PyLong_AsLongLong(r);
+        Py_DECREF(r);
+        if (consumed <= 0) {
+          if (!PyErr_Occurred())
+            PyErr_SetString(PyExc_RuntimeError,
+                            "py_exec_batch consumed no continuations");
+          pl->in_run = pl->in_round = false;
+          return nullptr;
+        }
+        executed += consumed;
+        continue;
+      }
       std::pop_heap(pl->heap->begin(), pl->heap->end(), EvGreater());
       Ev ev = pl->heap->back();
       pl->heap->pop_back();
@@ -2947,6 +3359,19 @@ PyMethodDef Plane_methods[] = {
     {"run", Plane_run, METH_VARARGS, nullptr},
     {"run_window", Plane_run_window, METH_VARARGS, nullptr},
     {"lower_limit", Plane_lower_limit, METH_VARARGS, nullptr},
+    {"set_cont_callback", Plane_set_cont_callback, METH_O, nullptr},
+    {"register_proc", Plane_register_proc, METH_VARARGS, nullptr},
+    {"sched_continue", Plane_sched_continue, METH_VARARGS, nullptr},
+    {"push_cont", Plane_push_cont, METH_VARARGS, nullptr},
+    {"push_cont_batch", Plane_push_cont_batch, METH_O, nullptr},
+    {"pop_cont", Plane_pop_cont, METH_NOARGS, nullptr},
+    {"take_fired", Plane_take_fired, METH_NOARGS, nullptr},
+    {"sock_block", Plane_sock_block, METH_VARARGS, nullptr},
+    {"sock_unblock", Plane_sock_unblock, METH_VARARGS, nullptr},
+    {"ep_add", Plane_ep_add, METH_VARARGS, nullptr},
+    {"ep_mod", Plane_ep_mod, METH_VARARGS, nullptr},
+    {"ep_del", Plane_ep_del, METH_VARARGS, nullptr},
+    {"ep_poison", Plane_ep_poison, METH_VARARGS, nullptr},
     {nullptr, nullptr, 0, nullptr},
 };
 

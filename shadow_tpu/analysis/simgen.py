@@ -148,6 +148,29 @@ def _r_status_bits(spec: Dict) -> List[str]:
     ]
 
 
+def _r_epoll_bits(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    return [
+        f"EPOLLIN = 0x{c['EPOLLIN']:03x}",
+        f"EPOLLOUT = 0x{c['EPOLLOUT']:03x}",
+        f"EPOLLERR = 0x{c['EPOLLERR']:03x}",
+        f"EPOLLHUP = 0x{c['EPOLLHUP']:03x}",
+    ]
+
+
+def _r_c_epoll_bits(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    return [
+        "// epoll readiness bits (descriptor/epoll.py) — the C-side",
+        "// readiness cache (ISSUE 12) computes revents for epoll-watched",
+        "// native sockets with these",
+        f"enum {{ EPOLLIN = 0x{c['EPOLLIN']:03x}, "
+        f"EPOLLOUT = 0x{c['EPOLLOUT']:03x}, "
+        f"EPOLLERR = 0x{c['EPOLLERR']:03x}, "
+        f"EPOLLHUP = 0x{c['EPOLLHUP']:03x} }};",
+    ]
+
+
 def _r_port_alloc(spec: Dict) -> List[str]:
     c = spec["constants"]
     return [
@@ -418,6 +441,7 @@ REGIONS: List[RegionDef] = [
     ("shadow_tpu/core/stime.py", "clock", PY, _r_clock),
     ("shadow_tpu/routing/packet.py", "tcp-flags", PY, _r_tcp_flags),
     ("shadow_tpu/descriptor/base.py", "status-bits", PY, _r_status_bits),
+    ("shadow_tpu/descriptor/epoll.py", "epoll-bits", PY, _r_epoll_bits),
     ("shadow_tpu/host/host.py", "port-alloc", PY, _r_port_alloc),
     ("shadow_tpu/core/rng.py", "threefry", PY, _r_threefry),
     ("shadow_tpu/descriptor/tcp.py", "tcp-states", PY, _r_tcp_states),
@@ -433,6 +457,7 @@ REGIONS: List[RegionDef] = [
     ("shadow_tpu/ops/protocol_tables.py", "protocol-tables", PY,
      _r_protocol_tables),
     ("native/dataplane.cc", "c-protocol-constants", C, _r_c_constants),
+    ("native/dataplane.cc", "c-epoll-bits", C, _r_c_epoll_bits),
     ("native/dataplane.cc", "c-tcp-states", C, _r_c_tcp_states),
     ("native/dataplane.cc", "c-congestion-params", C,
      _r_c_congestion_params),
@@ -443,6 +468,7 @@ SURFACE_OF_REGION: Dict[str, str] = {
     "tcp-flags": "constants", "status-bits": "constants",
     "port-alloc": "constants", "threefry": "constants",
     "tcp-timers": "constants", "c-protocol-constants": "constants",
+    "epoll-bits": "constants", "c-epoll-bits": "constants",
     "token-bucket-kernel": "hop-math", "router-static": "hop-math",
     "codel-params": "hop-math",
     "tcp-states": "transitions", "c-tcp-states": "transitions",

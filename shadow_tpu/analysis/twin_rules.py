@@ -95,6 +95,11 @@ CANON: Dict[str, str] = {
     # descriptor status bits (descriptor/base.py <-> dataplane.cc enum)
     "S_ACTIVE": "S_ACTIVE", "S_READABLE": "S_READABLE",
     "S_WRITABLE": "S_WRITABLE", "S_CLOSED": "S_CLOSED",
+    # epoll readiness bits (descriptor/epoll.py <-> dataplane.cc enum):
+    # the C-side readiness cache (ISSUE 12) computes revents natively, so
+    # the bit values are a two-plane surface
+    "EPOLLIN": "EPOLLIN", "EPOLLOUT": "EPOLLOUT",
+    "EPOLLERR": "EPOLLERR", "EPOLLHUP": "EPOLLHUP",
     # port allocation (host/host.py <-> dataplane.cc)
     "MIN_EPHEMERAL_PORT": "MIN_EPHEMERAL_PORT", "MAX_PORT": "MAX_PORT",
     # congestion control: the coefficient families are NAMED constants on

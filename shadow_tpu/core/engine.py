@@ -410,6 +410,18 @@ class Engine:
                 # executor back to the per-event path
                 out["native.round_windows"] = pol.round_windows
                 out["native.round_demoted"] = int(pol.round_demoted)
+            # batched continuation plane (ISSUE 12): green-thread resumes
+            # delivered per py_exec_batch call vs one-callback-each
+            # (getattr: test stand-in planes predate the ledger)
+            np_ = self.native_plane
+            batches = getattr(np_, "py_exec_batch_calls", 0)
+            fused = getattr(np_, "continuations_fused", 0)
+            out["native.py_exec_batch_calls"] = batches
+            out["native.continuations_fused"] = fused
+            out["native.continuations_single"] = getattr(
+                np_, "continuations_single", 0)
+            out["native.continuation_batch_size"] = round(
+                fused / max(batches, 1), 2)
         return out
 
     def _obs_round_end(self) -> None:

@@ -110,7 +110,11 @@ def parse_fault_inject(spec: str) -> Optional[Dict]:
       (exercises dead-shard detection);
     * ``native-round:N``         — the Nth C round-executor window raises,
       exercising permanent demotion to the per-event dispatch path with
-      digest parity (ISSUE 10).
+      digest parity (ISSUE 10);
+    * ``continuation-batch:N``   — the Nth batched-continuation delivery
+      (py_exec_batch) raises mid-window, exercising demotion to the
+      per-event pop loop where continuations deliver one callback each
+      (ISSUE 12).
     """
     if not spec:
         return None
@@ -135,4 +139,9 @@ def parse_fault_inject(spec: str) -> Optional[Dict]:
             raise ValueError(f"--fault-inject {spec!r}: expected "
                              "native-round:N")
         return {"kind": kind, "window": int(parts[1])}
+    if kind == "continuation-batch":
+        if len(parts) != 2:
+            raise ValueError(f"--fault-inject {spec!r}: expected "
+                             "continuation-batch:N")
+        return {"kind": kind, "batch": int(parts[1])}
     raise ValueError(f"--fault-inject {spec!r}: unknown fault kind {kind!r}")

@@ -18,7 +18,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Callable, List, Optional, Set
 
-# >>> simgen:begin region=status-bits spec=4b732374c3c9 body=dab61b8b2aea
+# >>> simgen:begin region=status-bits spec=f421682bce6f body=dab61b8b2aea
 # Status bits (reference descriptor.h DS_*).
 S_NONE = 0
 S_ACTIVE = 1
@@ -29,6 +29,12 @@ S_CLOSED = 8
 
 
 class Descriptor:
+    # the C plane a descriptor's state lives in — always None for Python
+    # descriptors; NativeSocket (duck-typed, not a subclass) carries the
+    # real plane.  Class-level so process._dispatch's native-block routing
+    # reads it as a plain attribute on every blocking syscall.
+    plane = None
+
     def __init__(self, host, handle: int, kind: str):
         self.host = host
         self.handle = handle

@@ -48,7 +48,7 @@ from .retransmit_tally import make_tally
 from .tcp_cong import make_congestion_control
 from ..core.worker import current_worker
 
-# >>> simgen:begin region=tcp-states spec=4b732374c3c9 body=c91ef6656a5d
+# >>> simgen:begin region=tcp-states spec=f421682bce6f body=c91ef6656a5d
 # states (reference tcp.c enum TCPState :42-47)
 CLOSED = "closed"
 LISTEN = "listen"
@@ -84,7 +84,7 @@ TCP_TRANSITIONS = (
 
 MSS = defs.CONFIG_TCP_MAX_SEGMENT_SIZE
 
-# >>> simgen:begin region=tcp-timers spec=4b732374c3c9 body=21bb9e099dc9
+# >>> simgen:begin region=tcp-timers spec=f421682bce6f body=21bb9e099dc9
 RTO_INIT_NS = 1000000000
 RTO_MIN_NS = 200000000
 RTO_MAX_NS = 120000000000

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 INIT_CWND_SEGMENTS = 10       # Linux default initial window (RFC 6928)
 
-# >>> simgen:begin region=congestion-params spec=4b732374c3c9 body=6a36d8b1dbdf
+# >>> simgen:begin region=congestion-params spec=f421682bce6f body=6a36d8b1dbdf
 # CUBIC coefficient families (RFC 9438 §4.1 / §4.6).
 CUBIC_C = 0.4      # cubic: scaling constant
 CUBIC_BETA = 0.7   # cubic: multiplicative decrease
@@ -149,7 +149,7 @@ class Cubic(CongestionControl):
             super()._congestion_avoidance(acked_bytes, now_ns)
 
 
-# >>> simgen:begin region=congestion-variants spec=4b732374c3c9 body=a5ad8258f75d
+# >>> simgen:begin region=congestion-variants spec=f421682bce6f body=a5ad8258f75d
 class CubicX(Cubic):
     """Spec-defined CUBIC variant 'cubicx': (C, beta) = (0.6, 0.85).
 

@@ -20,7 +20,7 @@ from .network_interface import NetworkInterface
 from .router import Router, make_queue
 from .tracker import Tracker
 
-# >>> simgen:begin region=port-alloc spec=4b732374c3c9 body=00a7ffddc53c
+# >>> simgen:begin region=port-alloc spec=f421682bce6f body=00a7ffddc53c
 MIN_EPHEMERAL_PORT = 10000
 MAX_PORT = 65535
 # <<< simgen:end region=port-alloc
@@ -73,6 +73,13 @@ class HostParams:
 
 
 class Host:
+    # C data plane back-reference; an instance attribute when
+    # parallel/native_plane.py attaches.  Class-level default so the hot
+    # wake paths (process.py _schedule_continue/_dispatch) read it as a
+    # plain attribute instead of paying getattr's missing-attr exception
+    # per wake on python-plane runs.
+    native_plane = None
+
     def __init__(self, host_id: int, params: HostParams, root_key: int):
         self.id = host_id
         self.name = params.name

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import numpy as np
 
-# >>> simgen:begin region=protocol-tables spec=4b732374c3c9 body=1585a58dc283
+# >>> simgen:begin region=protocol-tables spec=f421682bce6f body=1585a58dc283
 # TCP state universe, reference-enum order; the tuple index IS
 # the C-plane TcpState id.
 TCP_STATES = (

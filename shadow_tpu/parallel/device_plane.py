@@ -1050,19 +1050,31 @@ class DeviceTrafficPlane:
             steps = np.maximum(d, u)
             wakes = np.maximum((steps + 1) * TICK_NS * self.granule,
                                barrier)
-            events = []
+            # ONE fold loop for both delivery sinks, so the done-guard /
+            # decline rules can never desync between the planes: under the
+            # native plane the wakes land as C-heap continuation events in
+            # ONE push_cont_batch extension call (ISSUE 12 — same per-host
+            # sequence claims, same wake times, no Python Task/Event per
+            # flow); otherwise as Events through one push_batch call
+            native = getattr(engine, "native_plane", None)
+            make = self._make_wake_item if native is not None \
+                else self._make_wake_event
+            items = []
             for circ, wake in zip(circs[ready].tolist(),
                                   wakes[ready].tolist()):
                 if circ in self._done:
                     continue
                 self._done[circ] = wake
-                ev = self._make_wake_event(engine, circ, wake)
-                if ev is not None:
-                    events.append(ev)
-            if events:
-                engine.counters.count_new("event", len(events))
-                engine.scheduler.policy.push_batch(
-                    events, 0, engine.scheduler.window_end)
+                item = make(engine, circ, wake)
+                if item is not None:
+                    items.append(item)
+            if items:
+                if native is not None:
+                    native.push_device_wakes(items)
+                else:
+                    engine.counters.count_new("event", len(items))
+                    engine.scheduler.policy.push_batch(
+                        items, 0, engine.scheduler.window_end)
         self.host_ns += _wt.perf_counter_ns() - t1
 
     def _collect_flush(self, engine, handle) -> np.ndarray:
@@ -1185,6 +1197,20 @@ class DeviceTrafficPlane:
         task = Task(_device_wake_task, (self, circuit, waiter), None,
                     name="device_flow_done")
         return Event(task, when, host, host, host.next_event_sequence())
+
+    def _make_wake_item(self, engine, circuit: int, when: int):
+        """The _make_wake_event twin for the native continuation plane:
+        (when, host, plane, circuit, waiter) for push_device_wakes —
+        identical decline rules, the sequence claim deferred to the ONE
+        push_cont_batch extension call (same per-host counter, same
+        order)."""
+        if when >= engine.end_time:
+            return None
+        if self.specs[circuit].auto_start_ns is not None:
+            return None
+        waiter = self._waiters.pop(circuit, None)
+        host = self.engine.host_by_name(self.specs[circuit].client_name)
+        return (when, host, self, circuit, waiter)
 
     def _stage_autos(self, now_ns: int) -> None:
         """Activate every processless flow whose start time has been
@@ -1324,7 +1350,9 @@ def _device_wake_task(args, _unused) -> None:
     if thread.state == BLOCKED:
         thread.state = RUNNABLE
         thread._unblock_cb = None
-        process._continue_scheduled = False
+        # the wake IS the continue: resume directly; any separately
+        # scheduled continue event keeps its own (no-op) delivery and
+        # clears the coalescing flag itself (ISSUE 12 satellite)
         process.continue_()
 
 

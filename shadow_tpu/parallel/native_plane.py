@@ -31,6 +31,7 @@ from __future__ import annotations
 import importlib.util
 import os
 import subprocess
+import time as _walltime
 from contextlib import contextmanager
 from typing import List, Optional
 
@@ -39,7 +40,7 @@ from ..core.logger import get_logger
 from ..core.scheduler import GlobalSinglePolicy
 from ..core.worker import current_worker
 
-CB_STATUS, CB_CHILD, CB_CLOSED = 0, 1, 2
+CB_STATUS, CB_CHILD, CB_CLOSED, CB_EPOLL = 0, 1, 2, 3
 K_TCP, K_UDP = 0, 1
 _SENT_D = -(2 ** 31)
 _SENT_Q = -(2 ** 63)
@@ -289,6 +290,142 @@ class NativeSocket:
         return f"NativeSocket(fd={self.handle}, kind={self.kind})"
 
 
+class ContinuationLedger:
+    """Green-thread continuation ledger (ISSUE 12): the Python side of the
+    batched continuation plane.
+
+    Every suspended-plugin wake — sleep expiry, descriptor-block
+    satisfaction/timeout, device-flow completion, coalesced process
+    continue — lives as ONE C-heap event (``EV_PY_CONT``) carrying an index
+    into this table, instead of a Python Task+Event through the scheduler
+    queue.  The C round executor delivers *runs* of consecutive
+    continuations through one ``py_exec_batch`` callback (``pop_cont``
+    re-checks the total order every step, so the run is exactly as long as
+    the per-event order allows); the per-event path (`cont_cb`, used by the
+    demoted pop loop) delivers the same entries one callback each.  Wakes
+    the C plane decides itself (socket-block waiters) arrive through
+    ``take_fired`` and are applied before any resume, preserving the
+    fire-before-continue ordering of the retired Python listener closures.
+
+    Delivery order is the event total order: at equal times that is
+    (host id, per-host sequence) — i.e. host-id order across processes and
+    wake order within one, with each process's threads resumed in creation
+    order by ``continue_`` — the deterministic drain the batched plane
+    pins against the per-event path."""
+
+    __slots__ = ("plane", "entries", "_free")
+
+    def __init__(self, plane: "NativePlane"):
+        self.plane = plane
+        self.entries: List = []
+        self._free: List[int] = []
+
+    def add(self, entry) -> int:
+        if self._free:
+            cid = self._free.pop()
+            self.entries[cid] = entry
+        else:
+            cid = len(self.entries)
+            self.entries.append(entry)
+        return cid
+
+    def free(self, cid: int) -> None:
+        self.entries[cid] = None
+        self._free.append(cid)
+
+    def apply_fired(self) -> None:
+        """Apply every C-decided block wake (sock waiters satisfied at
+        status-change time): set the woken thread's resume value + state.
+        The owning process's coalesced continue event was pushed by C at
+        fire time, so application is pure bookkeeping — it must happen
+        before ANY continuation resumes (a timeout event ordered before
+        the continue must observe the disarm)."""
+        fired = self.plane.c.take_fired()
+        if fired is None:
+            return
+        from ..process.process import BLOCKED, RUNNABLE
+        for cid in fired:
+            e = self.entries[cid]
+            self.free(cid)
+            if e is None:
+                continue
+            _kind, _host, _process, thread, box = e
+            if not box[0]:
+                continue
+            box[0] = False
+            if thread.state == BLOCKED:
+                thread.wake_value = True
+                thread.state = RUNNABLE
+                thread._unblock_cb = None
+
+    def deliver(self, cid: int, t: int) -> None:
+        """Execute one continuation event: mirror the worker/host context
+        exactly as ``Event.execute`` would, then resume.  Simulation-side
+        exceptions are marked (plane.sim_exc) so the round executor's
+        demotion guard re-raises them untouched."""
+        self.apply_fired()
+        e = self.entries[cid]
+        kind = e[0]
+        host = e[1]
+        w = current_worker()
+        if w is not None:
+            w.now = t
+            w.active_host = host
+        host.now = t
+        try:
+            if kind == "continue":
+                # persistent per-process entry (never freed); C cleared the
+                # coalescing flag before delivery
+                e[2]._continue_now()
+                return
+            self.free(cid)
+            from ..process.process import BLOCKED, RUNNABLE
+            if kind == "wake":
+                # sleep expiry: the wake IS the continue
+                _k, _h, process, thread = e
+                if thread.state == BLOCKED:
+                    thread.state = RUNNABLE
+                    thread._unblock_cb = None
+                process._continue_now()
+            elif kind == "timeout":
+                # block timeout: lost the race iff the box was disarmed
+                _k, _h, process, thread, box, sid, block_cid, cancel = e
+                if not box[0]:
+                    return
+                box[0] = False
+                if sid is not None:
+                    self.plane.c.sock_unblock(sid, block_cid)
+                    self.free(block_cid)
+                elif cancel is not None:
+                    cancel()
+                if thread.state == BLOCKED:
+                    thread.wake_value = False
+                    process._wake_thread(thread)
+            elif kind == "device":
+                # device-flow completion (device_plane._device_wake_task
+                # semantics): resume the joining client directly
+                _k, _h, dplane, circuit, waiter = e
+                if waiter is None:
+                    waiter = dplane._waiters.pop(circuit, None)
+                if waiter is None or circuit in dplane._woken:
+                    return
+                dplane._woken.add(circuit)
+                process, thread = waiter
+                thread.wake_value = dplane._done[circuit]
+                if thread.state == BLOCKED:
+                    thread.state = RUNNABLE
+                    thread._unblock_cb = None
+                    process._continue_now()
+            else:  # pragma: no cover - ledger corruption is a plane bug
+                raise RuntimeError(f"unknown continuation kind {kind!r}")
+        except BaseException as exc:
+            self.plane.sim_exc = exc
+            raise
+        finally:
+            if w is not None:
+                w.active_host = None
+
+
 class NativeGlobalPolicy(GlobalSinglePolicy):
     """Serial global policy merging the C event heap into the total order.
 
@@ -326,10 +463,24 @@ class NativeGlobalPolicy(GlobalSinglePolicy):
             getattr(plane.engine.options, "fault_inject", "") or "")
         self._fault_countdown = fault["window"] \
             if fault and fault["kind"] == "native-round" else 0
+        # --fault-inject continuation-batch:N — the Nth py_exec_batch call
+        # raises, drilling demotion to the per-event pop loop (where
+        # continuations deliver one cont_cb each)
+        self._cont_fault_countdown = fault["batch"] \
+            if fault and fault["kind"] == "continuation-batch" else 0
 
     def _run_c_traced(self, t, d, s, q) -> None:
         with self._tracer.span("native.run", "native", sim_ns=int(t)):
             self._plane.c.run(t, d, s, q)
+
+    def _batch_drilled(self) -> int:
+        """drain_cont_batch wrapped in the continuation-batch:N countdown
+        (--fault-inject): the Nth batch delivery raises, and the window
+        finishes on the per-event pop loop — the drilled demotion target."""
+        self._cont_fault_countdown -= 1
+        if self._cont_fault_countdown == 0:
+            raise RuntimeError("fault injection: continuation batch")
+        return self._plane.drain_cont_batch()
 
     def run_window(self, worker, window_end) -> bool:
         """Execute the whole window via the C round executor.  Returns
@@ -361,6 +512,8 @@ class NativeGlobalPolicy(GlobalSinglePolicy):
                 raise
             return q.peek_key()
 
+        batch = self._batch_drilled if self._cont_fault_countdown > 0 \
+            else self._plane.drain_cont_batch
         try:
             if self._fault_countdown > 0:
                 self._fault_countdown -= 1
@@ -370,9 +523,10 @@ class NativeGlobalPolicy(GlobalSinglePolicy):
             if self._tracer.enabled:
                 with self._tracer.span("native.round", "native",
                                        sim_ns=we):
-                    self._plane.c.run_window(we, q.peek_key(), py_exec)
+                    self._plane.c.run_window(we, q.peek_key(), py_exec,
+                                             batch)
             else:
-                self._plane.c.run_window(we, q.peek_key(), py_exec)
+                self._plane.c.run_window(we, q.peek_key(), py_exec, batch)
         except BaseException as e:
             if e is self._py_exc or e is self._plane.sim_exc \
                     or not isinstance(e, Exception):
@@ -449,6 +603,12 @@ class NativePlane:
         self._bulk_rows = None      # hid -> row, inside bulk_sync() only
         self.sim_exc = None         # last simulation-code exception (the
                                     # round-executor guard re-raises these)
+        # batched continuation plane (ISSUE 12)
+        self.ledger = ContinuationLedger(self)
+        self.eps: List = []         # epoll token -> Epoll (readiness cache)
+        self.py_exec_batch_calls = 0
+        self.continuations_fused = 0    # delivered through py_exec_batch
+        self.continuations_single = 0   # delivered per-event (demoted path)
         topo = engine.topology
         opts = engine.options
         lat = topo.latency_ns
@@ -463,6 +623,7 @@ class NativePlane:
             int(getattr(opts, "tcp_windows", 10)),
             lat, rel, cnt)
         self.c.set_callback(self._callback)
+        self.c.set_cont_callback(self._deliver_cont)
         if engine.shard_count > 1:
             # --processes: finished cross-shard hops land in the engine's
             # outboxes exactly where the Python plane appends them
@@ -526,6 +687,116 @@ class NativePlane:
         host.register_descriptor(w)
         return w
 
+    # -- continuation plane (ISSUE 12) -------------------------------------
+    def token_for(self, process) -> int:
+        """The process's C-side coalescing token (lazily registered with a
+        persistent 'continue' ledger entry)."""
+        tok = process._cont_token
+        if tok is None:
+            host = process.host
+            cid = self.ledger.add(("continue", host, process))
+            tok = self.c.register_proc(host.id, cid)
+            process._cont_token = tok
+        return tok
+
+    def sched_continue(self, process, now: int) -> None:
+        """Coalesced process-continue: ONE EV_PY_CONT in flight per process
+        (the C-side mirror of Process._continue_scheduled, shared with the
+        C-decided socket-block wakes)."""
+        self.c.sched_continue(now, self.token_for(process))
+
+    def push_sleep(self, process, thread, now: int, delay_ns: int) -> None:
+        host = process.host
+        cid = self.ledger.add(("wake", host, process, thread))
+        if self.c.push_cont(now, host.id, delay_ns, cid) is None:
+            self.ledger.free(cid)    # past end time: never wakes (parity
+                                     # with schedule_task's decline)
+
+    def block_native(self, process, thread, desc, bits: int,
+                     timeout_ns: int, now: int) -> bool:
+        """Register a C-side socket-block waiter: the wake condition
+        (status & (bits|S_CLOSED)) is decided IN C at status-change time,
+        with no per-change Python callback.  Returns False when the
+        condition already holds (caller resumes synchronously)."""
+        host = process.host
+        box = [True]
+        cid = self.ledger.add(("block", host, process, thread, box))
+        tok = self.token_for(process)
+        if not self.c.sock_block(desc.sid, bits, cid, tok):
+            self.ledger.free(cid)
+            return False
+        if timeout_ns >= 0:
+            tid = self.ledger.add(("timeout", host, process, thread, box,
+                                   desc.sid, cid, None))
+            if self.c.push_cont(now, host.id, timeout_ns, tid) is None:
+                self.ledger.free(tid)
+        return True
+
+    def push_block_timeout(self, process, thread, box, now: int,
+                           timeout_ns: int, cancel) -> None:
+        """Timeout leg for a block on a PYTHON descriptor under the native
+        plane: the wake detection stays a Python listener, but the timeout
+        event lives in the C heap like every other continuation."""
+        host = process.host
+        cid = self.ledger.add(("timeout", host, process, thread, box,
+                               None, None, cancel))
+        if self.c.push_cont(now, host.id, timeout_ns, cid) is None:
+            self.ledger.free(cid)
+
+    def push_device_wakes(self, items) -> None:
+        """Land a collect's completion wakes in ONE extension call:
+        ``items`` = [(when, host, dplane, circuit, waiter), ...] in the
+        per-event fold's order, so the C-side per-host sequence claims are
+        identical to the retired push_batch Event chain."""
+        batch = []
+        for when, host, dplane, circuit, waiter in items:
+            cid = self.ledger.add(("device", host, dplane, circuit, waiter))
+            batch.append((when, host.id, 0, cid))
+        self.c.push_cont_batch(batch)
+
+    def ep_token(self, ep) -> int:
+        tok = getattr(ep, "_native_tok", None)
+        if tok is None:
+            tok = len(self.eps)
+            self.eps.append(ep)
+            ep._native_tok = tok
+        return tok
+
+    def _deliver_cont(self, cid: int, t: int) -> None:
+        """Per-event continuation delivery (the demoted pop loop / a lone
+        continuation executed by plane_exec)."""
+        self.continuations_single += 1
+        t0 = _walltime.perf_counter_ns()
+        try:
+            self.ledger.deliver(cid, t)
+        finally:
+            self.engine.add_plugin_exec_ns(
+                _walltime.perf_counter_ns() - t0)
+
+    def drain_cont_batch(self) -> int:
+        """The py_exec_batch callback: drain the maximal run of consecutive
+        continuations in one C->Python round trip.  ``pop_cont`` re-checks
+        the merged total order each step (window horizon, the Python-top
+        mirror, AND any C event a resume just scheduled), so the batch ends
+        exactly where per-event dispatch would interleave something else.
+        Plugin wall is attributed once per batch, not per resume."""
+        n = 0
+        pop = self.c.pop_cont
+        deliver = self.ledger.deliver
+        t0 = _walltime.perf_counter_ns()
+        try:
+            e = pop()
+            while e is not None:
+                n += 1
+                deliver(e[0], e[1])
+                e = pop()
+        finally:
+            self.py_exec_batch_calls += 1
+            self.continuations_fused += n
+            self.engine.add_plugin_exec_ns(
+                _walltime.perf_counter_ns() - t0)
+        return n
+
     # -- callback shim -----------------------------------------------------
     def _callback(self, kind: int, hid: int, t: int, a: int, b: int) -> None:
         """Invoked by C at listener/lifecycle points.  Mirrors the clock and
@@ -558,6 +829,13 @@ class NativePlane:
                 if wrap is not None:
                     wrap.closed = True
                     host.descriptor_table_remove(wrap.handle)
+            elif kind == CB_EPOLL:
+                # C readiness cache delivery: b = (ep_tok << 16) | revents,
+                # fired only when the epoll-visible outcome changed
+                ep = self.eps[b >> 16]
+                wrap = self.wrappers[a]
+                if wrap is not None:
+                    ep._apply_native_revents(wrap.handle, b & 0xFFFF)
         except BaseException as e:
             # mark simulation-side failures so the round executor's guard
             # PROPAGATES them (a listener/app bug is not the executor's

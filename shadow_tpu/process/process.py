@@ -22,6 +22,7 @@ the virtual time of the event that woke them.
 from __future__ import annotations
 
 import inspect
+import time as _walltime
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core import stime
@@ -29,7 +30,9 @@ from ..core.logger import get_logger
 from ..core.task import Task
 from ..descriptor.base import S_CLOSED, S_READABLE, S_WRITABLE
 from ..core.worker import current_worker
-from ..obs.trace import NULL_SPAN, get_tracer
+from ..obs.trace import get_tracer
+
+_perf = _walltime.perf_counter_ns
 
 RUNNABLE = "runnable"
 BLOCKED = "blocked"
@@ -114,6 +117,17 @@ class Process:
         self.return_values: Dict[int, Any] = {}
         self.app_state: Any = None  # apps may park observable state here (tests)
         self._continue_scheduled = False
+        self._in_continue = False   # suppress redundant continue events for
+                                    # wakes arriving DURING continue_ (the
+                                    # running loop rescans — ISSUE 12)
+        self._cont_token = None     # C-side coalescing token (native plane)
+        # tracer hook bound ONCE at construction (the zero-cost pattern
+        # native.run uses): the untraced resume path pays no span
+        # construction, no get_tracer lookup, no null context manager
+        tracer = get_tracer()
+        self._continue_now = self._continue_traced if tracer.enabled \
+            else self._continue_fast
+        self._tracer = tracer
         self._signal_fds: List = []   # open SignalFD descriptors (delivery)
         # the kernel's per-process pending-signal set, shared by every
         # signalfd this process opens (descriptor/signalfd.py)
@@ -175,19 +189,42 @@ class Process:
 
     def continue_(self) -> None:
         """Resume all runnable green threads until everything blocks
-        (reference process_continue :1197-1275).  One plugin-execution
-        span per resume when the run is traced (ISSUE 3: plugin execution
-        is a named span, like the reference's process_continue timings)."""
-        self._continue_scheduled = False
+        (reference process_continue :1197-1275), attributing the wall to
+        the plugin side of the host_exec split.  Batched deliveries
+        (parallel/native_plane.py ContinuationLedger) call
+        ``_continue_now`` directly and time the whole batch once."""
         if self.exited:
             return
-        tracer = get_tracer()
-        span = tracer.span("plugin.continue", "plugin", sim_ns=self.host.now,
-                           args={"proc": self.name}) \
-            if tracer.enabled else NULL_SPAN
-        import time as _wt
-        t0 = _wt.perf_counter_ns()
-        with span:
+        t0 = _perf()
+        self._continue_now()
+        engine = self.host.engine
+        if engine is not None:
+            engine.add_plugin_exec_ns(_perf() - t0)
+
+    def _continue_traced(self) -> None:
+        """One plugin-execution span per resume when the run is traced
+        (ISSUE 3); selected at construction so the untraced path never
+        pays the span machinery (ISSUE 12 satellite)."""
+        if self.exited:
+            return
+        with self._tracer.span("plugin.continue", "plugin",
+                               sim_ns=self.host.now,
+                               args={"proc": self.name}):
+            self._run_runnable()
+
+    def _continue_fast(self) -> None:
+        if self.exited:
+            return
+        self._run_runnable()
+
+    def _run_runnable(self) -> None:
+        # _in_continue: a wake arriving DURING the loop (an app send making
+        # another descriptor of this process readable) marks its thread
+        # RUNNABLE and the rescan resumes it — scheduling a continue event
+        # for it would execute as a redundant no-op (ISSUE 12 satellite:
+        # the coalescing flag used to reset before the generators ran)
+        self._in_continue = True
+        try:
             progressed = True
             while progressed:
                 progressed = False
@@ -195,12 +232,8 @@ class Process:
                     if t.state == RUNNABLE:
                         progressed = True
                         self._run_thread(t)
-        # plugin-vs-control-plane host_exec split (ISSUE 7): wall spent
-        # resuming app code, accumulated so the engine can attribute the
-        # remaining round wall to engine overhead rather than app work
-        engine = self.host.engine
-        if engine is not None:
-            engine.add_plugin_exec_ns(_wt.perf_counter_ns() - t0)
+        finally:
+            self._in_continue = False
         if all(t.state == DONE for t in self.threads) and not self.exited:
             main_done = self.threads[0].state == DONE if self.threads else True
             if main_done:
@@ -232,14 +265,34 @@ class Process:
 
     def _dispatch(self, t: GreenThread, req) -> None:
         w = current_worker()
+        plane = self.host.native_plane
         if isinstance(req, _Sleep):
             t.state = BLOCKED
             if w is not None:
-                w.schedule_task(Task(_thread_wake_task, (self, t), None,
-                                     name="sleep_wake"), req.ns, dst_host=self.host)
+                if plane is not None:
+                    # one C-heap continuation event, no Python Task/Event
+                    plane.push_sleep(self, t, w.now, req.ns)
+                else:
+                    w.schedule_task(Task(_thread_wake_task, (self, t), None,
+                                         name="sleep_wake"), req.ns,
+                                    dst_host=self.host)
             return
         if isinstance(req, _Block):
             desc, bits = req.desc, req.bits
+            if plane is not None and desc.plane is plane:
+                # C-plane socket: the block waiter lives in C — the wake
+                # condition is decided at status-change time with no
+                # Python callback, and the wake itself is a C-heap
+                # continuation event (ISSUE 12)
+                t.state = BLOCKED
+                if not plane.block_native(self, t, desc, bits,
+                                          req.timeout_ns if w is not None
+                                          else -1,
+                                          w.now if w is not None else
+                                          self.host.now):
+                    t.state = RUNNABLE
+                    t.wake_value = True  # condition already true
+                return
             if desc.status & (bits | S_CLOSED):
                 t.wake_value = True  # condition already true; loop continues
                 return
@@ -256,6 +309,15 @@ class Process:
             desc.add_listener(on_status)
             t._unblock_cb = (desc, on_status)
             if req.timeout_ns >= 0 and w is not None:
+                if plane is not None:
+                    # Python-descriptor block under the native plane: the
+                    # wake stays a listener, the timeout is a C-heap
+                    # continuation event
+                    plane.push_block_timeout(
+                        self, t, armed, w.now, req.timeout_ns,
+                        (lambda _desc=desc, _cb=on_status:
+                         _desc.remove_listener(_cb)))
+                    return
 
                 def on_timeout(_pair, _arg, _t=t, _desc=desc):
                     if armed[0] and _t.state == BLOCKED:
@@ -293,12 +355,25 @@ class Process:
         self._schedule_continue()
 
     def _schedule_continue(self) -> None:
-        """Coalesced process_continue wakeup event."""
-        if self._continue_scheduled or self.exited:
+        """Coalesced process_continue wakeup: ONE continue event in flight
+        per process.  The flag (Python-plane ``_continue_scheduled``; the
+        C-side token mirror under the native plane) clears when the event
+        DELIVERS, not when continue_ starts — and wakes arriving while
+        continue_ is running schedule nothing at all (the loop rescans), so
+        no redundant same-time events exist on either path (ISSUE 12
+        satellite: the old reset-at-entry scheduled one per mid-continue
+        wake)."""
+        if self.exited or self._in_continue:
             return
         w = current_worker()
         if w is None:
             self.continue_()
+            return
+        plane = self.host.native_plane
+        if plane is not None:
+            plane.sched_continue(self, w.now)
+            return
+        if self._continue_scheduled:
             return
         self._continue_scheduled = True
         w.schedule_task(Task(_process_continue_task, self, None,
@@ -314,14 +389,21 @@ def _process_stop_task(process: Process, _arg) -> None:
 
 
 def _process_continue_task(process: Process, _arg) -> None:
+    # the in-flight continue event has left the queue: clear the coalescing
+    # flag BEFORE resuming (a wake during continue_ is absorbed by the
+    # rescan; one arriving after schedules a fresh event)
+    process._continue_scheduled = False
     process.continue_()
 
 
 def _thread_wake_task(pair, _arg) -> None:
+    # sleep wake is itself the continue event: resume directly, without
+    # routing through _schedule_continue (which would queue a redundant
+    # same-time continue event — ISSUE 12 satellite)
     process, t = pair
-    process._wake_thread(t)
-    # sleep wake is itself the continue event
-    process._continue_scheduled = False
+    if t.state == BLOCKED:
+        t.state = RUNNABLE
+        t._unblock_cb = None
     process.continue_()
 
 

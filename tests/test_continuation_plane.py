@@ -1,0 +1,311 @@
+"""Batched continuation plane gates (ISSUE 12): green-thread wakes as
+C-heap events, run-fused delivery, C-decided socket-block wakes, and the
+epoll readiness cache.
+
+1. Engagement + exactness: continuations deliver through py_exec_batch on
+   a healthy native run, and the batched path is digest- and event-count-
+   identical to the per-event demotion target AND to every other engine
+   mode (python plane serial, tpu, threaded steal, --processes 2).
+2. The --fault-inject continuation-batch:N drill demotes mid-window to the
+   per-event pop loop with digest parity, counted in supervision.
+3. checkpoint/--resume across batched rounds lands on identical digests.
+4. The C readiness cache is a VERIFIED cache: a deliberately desynced
+   entry (ep_poison) fails loudly at collect instead of delivering a
+   wrong wake.
+5. Coalescing dedupe (satellite): a wake arriving while continue_ runs
+   schedules NO redundant same-time continue event, on either plane.
+"""
+
+import os
+
+import pytest
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.checkpoint import state_digest
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+from shadow_tpu.core.supervision import parse_fault_inject
+from shadow_tpu.apps.registry import register
+from shadow_tpu.descriptor.base import S_READABLE
+from shadow_tpu.process.process import _Block
+from shadow_tpu.tools import workloads
+
+TOR_KW = dict(n_relays=30, n_clients=20, n_servers=3, stoptime=28,
+              stream_spec="512:16384")
+
+
+# -- apps exercising every ledger path ---------------------------------------
+
+@register("contplane")
+def contplane_app(api, args):
+    """sleep (push_sleep), epoll-with-timeout on a pipe (python-descriptor
+    block + C-heap timeout), native-socket block with timeout (_Block with
+    timeout_ns -> C sock waiter + timeout entry), and a pipe write that
+    wakes a sibling thread DURING the writer's own continue_ (the
+    satellite-2 dedupe scenario)."""
+    role = args[0]
+    if role == "server":
+        port = int(args[1])
+        lfd = api.socket("tcp")
+        api.bind(lfd, ("0.0.0.0", port))
+        api.listen(lfd)
+        while True:
+            cfd, _peer = yield from api.accept(lfd)
+            api.spawn(_serve_conn, api, cfd)
+        return 0
+    server, port = args[1], int(args[2])
+    rfd, wfd = api.pipe()
+    api.spawn(_pipe_reader, api, rfd)
+    fd = api.socket("tcp")
+    yield from api.connect(fd, (server, port))
+    for i in range(6):
+        yield from api.send(fd, bytes([i]) * 400)
+        data = yield from api.recv_exact(fd, 400)
+        if data is None:
+            return 1
+        # wake the sibling reader DURING this thread's continue_: the
+        # running loop must absorb it without a redundant continue event
+        api.write(wfd, data[:64])
+        yield from api.sleep(0.05)           # push_sleep / sleep-wake path
+    # native-socket block with a timeout that FIRES (nothing more arrives)
+    sock = api._sock(fd)
+    fired = yield _Block(sock, S_READABLE, timeout_ns=200_000_000)
+    if fired:
+        return 2
+    api.close(fd)
+    api.write(wfd, b"")                       # EOF-mark for the reader
+    api.close(wfd)
+    return 0
+
+
+def _serve_conn(api, fd):
+    while True:
+        data = yield from api.recv(fd, 65536)
+        if not data:
+            api.close(fd)
+            return
+        yield from api.send(fd, data)
+
+
+def _pipe_reader(api, rfd):
+    ep = api.epoll_create()
+    api.epoll_ctl(ep, "add", rfd, 0x001)      # EPOLLIN
+    got = 0
+    while True:
+        events = yield from api.epoll_wait(ep, timeout_sec=0.5)
+        if not events:
+            continue                          # timeout leg exercised
+        data = api.read(rfd)
+        data = yield from data if hasattr(data, "send") else data
+        if not data:
+            api.close(rfd)
+            api.close(ep)
+            return
+        got += len(data)
+
+
+CONT_XML = """<shadow stoptime="20">
+  <plugin id="contplane" path="python:contplane" />
+  <host id="s1"><process plugin="contplane" starttime="1"
+        arguments="server 7000" /></host>
+  <host id="c1"><process plugin="contplane" starttime="2"
+        arguments="client s1 7000" /></host>
+  <host id="c2"><process plugin="contplane" starttime="3"
+        arguments="client s1 7000" /></host>
+</shadow>"""
+
+
+def _run(xml=None, policy="global", workers=0, stop=28, demote=False,
+         **opt_kw):
+    cfg = configuration.parse_xml(xml or workloads.tor_network(**TOR_KW))
+    cfg.stop_time_sec = stop
+    ctrl = Controller(Options(scheduler_policy=policy, workers=workers,
+                              seed=3, stop_time_sec=stop,
+                              log_level="warning", **opt_kw), cfg)
+    ctrl.setup()
+    eng = ctrl.engine
+    if demote:
+        eng.scheduler.policy.round_demoted = True
+    assert eng.run() == 0
+    return eng
+
+
+# deterministic repeat runs shared across tests (the meshplane suite's
+# module-cache idiom — holds the tier-1 wall)
+_CACHE = {}
+
+
+def _cached(key, **kw):
+    if key not in _CACHE:
+        _CACHE[key] = _run(**kw)
+    return _CACHE[key]
+
+
+def _require_native(eng):
+    if eng.native_plane is None:
+        pytest.skip("native plane unavailable")
+
+
+# -- engagement + batched-vs-per-event exactness -----------------------------
+
+def test_batched_continuations_engage_and_match_per_event():
+    """The tentpole gate: continuations live in the C heap, deliver through
+    py_exec_batch, and the batched total order is EXACTLY the per-event
+    one (digests + event counts), with the demoted run delivering the same
+    continuations one cont_cb each."""
+    ex = _cached("native")
+    _require_native(ex)
+    plane = ex.native_plane
+    assert plane.py_exec_batch_calls > 0
+    assert plane.continuations_fused > 0
+    assert plane.continuations_single == 0
+    scrape = ex.metrics.scrape()
+    assert scrape["native.continuations_fused"] == plane.continuations_fused
+    assert scrape["native.py_exec_batch_calls"] == plane.py_exec_batch_calls
+    pe = _cached("demoted", demote=True)
+    assert pe.native_plane.continuations_fused == 0
+    assert pe.native_plane.continuations_single > 0
+    assert ex.events_executed == pe.events_executed
+    assert state_digest(ex) == state_digest(pe)
+
+
+def test_ledger_paths_digest_parity_native_vs_python():
+    """Every ledger path (sleep wake, python-descriptor epoll block with
+    timeout, native-sock block with a firing timeout, mid-continue pipe
+    wake) produces the python plane's exact digest."""
+    nat = _run(xml=CONT_XML, stop=20)
+    _require_native(nat)
+    assert nat.plugin_errors == 0
+    py = _run(xml=CONT_XML, stop=20, dataplane="python")
+    assert py.plugin_errors == 0
+    assert nat.events_executed == py.events_executed
+    assert state_digest(nat) == state_digest(py)
+
+
+def test_digest_parity_matrix_engine_modes():
+    """Batched continuations vs serial python plane vs tpu policy vs
+    threaded steal: one state digest."""
+    nat = _cached("native")
+    _require_native(nat)
+    digests = {"native": state_digest(nat)}
+    digests["python"] = state_digest(_run(dataplane="python"))
+    digests["tpu"] = state_digest(_run(policy="tpu"))
+    digests["steal"] = state_digest(_run(policy="steal", workers=2))
+    assert len(set(digests.values())) == 1, digests
+
+
+def test_digest_parity_processes_2():
+    """--processes 2: each shard's round executor runs the batched
+    continuation plane; the merged digest equals the serial run's."""
+    from shadow_tpu.parallel.procs import ProcsController
+    serial = _cached("native")
+    cfg = configuration.parse_xml(workloads.tor_network(**TOR_KW))
+    cfg.stop_time_sec = 28
+    ctrl = ProcsController(Options(scheduler_policy="global", workers=0,
+                                   seed=3, stop_time_sec=28,
+                                   log_level="warning", processes=2), cfg)
+    assert ctrl.run() == 0
+    assert ctrl.digest == state_digest(serial)
+
+
+# -- fault drill --------------------------------------------------------------
+
+def test_fault_drill_demotes_mid_window_with_parity():
+    healthy = _cached("native")
+    _require_native(healthy)
+    drilled = _run(fault_inject="continuation-batch:20")
+    sup = drilled.supervision
+    assert sup.native_round_demotions == 1
+    assert drilled.scheduler.policy.round_demoted
+    # after the drill, continuations keep flowing — per-event
+    assert drilled.native_plane.continuations_single > 0
+    assert drilled.events_executed == healthy.events_executed
+    assert state_digest(drilled) == state_digest(healthy)
+
+
+def test_fault_parse_continuation_batch():
+    assert parse_fault_inject("continuation-batch:9") == {
+        "kind": "continuation-batch", "batch": 9}
+    with pytest.raises(ValueError):
+        parse_fault_inject("continuation-batch:1:2")
+
+
+# -- checkpoint / resume ------------------------------------------------------
+
+def test_checkpoint_resume_across_batched_rounds(tmp_path):
+    """Round-stamped snapshots under the batched plane land on the same
+    (round, digest) pairs as the per-event path, and --resume replays
+    through batched rounds to a verified boundary."""
+    ck = str(tmp_path / "ck")
+    a = _run(checkpoint_every_rounds=200, checkpoint_dir=ck)
+    _require_native(a)
+    assert a.native_plane.continuations_fused > 0
+    snaps = sorted(os.listdir(ck))
+    assert snaps
+    ck2 = str(tmp_path / "ck2")
+    b = _run(demote=True, checkpoint_every_rounds=200, checkpoint_dir=ck2)
+    import pickle
+    for name in snaps:
+        with open(os.path.join(ck, name), "rb") as f:
+            da = pickle.load(f)["digest"]
+        with open(os.path.join(ck2, name), "rb") as f:
+            db = pickle.load(f)["digest"]
+        assert da == db, f"checkpoint {name} diverged batched-vs-per-event"
+    resumed = _run(resume_path=os.path.join(ck, snaps[-1]))
+    assert resumed.supervision.resume_verified
+    assert state_digest(resumed) == state_digest(a)
+
+
+# -- readiness-cache poison gate ---------------------------------------------
+
+def test_stale_readiness_cache_fails_loudly():
+    """The C epoll cache is a VERIFIED cache: poisoning an entry (claiming
+    EPOLLIN with nothing readable) must raise at collect, never hand the
+    app a wake for data that is not there."""
+    from shadow_tpu.descriptor.epoll import EPOLLIN, Epoll
+    cfg = configuration.parse_xml(CONT_XML)
+    cfg.stop_time_sec = 20
+    ctrl = Controller(Options(scheduler_policy="global", workers=0, seed=3,
+                              stop_time_sec=20, log_level="warning"), cfg)
+    ctrl.setup()
+    eng = ctrl.engine
+    _require_native(eng)
+    plane = eng.native_plane
+    host = next(iter(eng.hosts.values()))
+    sock = plane.create_socket(host, "tcp")
+    ep = Epoll(host, host.allocate_handle())
+    ep.ctl_add(sock, EPOLLIN)
+    assert not ep.has_ready()
+    plane.c.ep_poison(sock.sid, EPOLLIN)      # forge readability
+    assert ep.has_ready()                     # the lie landed in the cache
+    with pytest.raises(RuntimeError, match="readiness cache desync"):
+        ep.wait()
+
+
+# -- coalescing dedupe (satellite) -------------------------------------------
+
+@pytest.mark.parametrize("dataplane", ["auto", "python"])
+def test_no_redundant_continue_scheduled_mid_continue(dataplane):
+    """A wake arriving while continue_ is running (the client writes to a
+    pipe its sibling thread is blocked on) must schedule NO continue event
+    — the running loop rescans.  Pinned by asserting no continue task is
+    ever scheduled for a process whose loop is live."""
+    from shadow_tpu.core.worker import Worker
+
+    orig = Worker.schedule_task
+    violations = []
+
+    def guarded(self, task, delay_ns, dst_host=None):
+        if task.name.startswith("continue:"):
+            proc = task.obj
+            if getattr(proc, "_in_continue", False):
+                violations.append(task.name)
+        return orig(self, task, delay_ns, dst_host=dst_host)
+
+    Worker.schedule_task = guarded
+    try:
+        eng = _run(xml=CONT_XML, stop=20, dataplane=dataplane)
+    finally:
+        Worker.schedule_task = orig
+    assert eng.plugin_errors == 0
+    assert violations == []
