@@ -9,7 +9,7 @@ PY ?= python
 BASE ?= HEAD
 
 .PHONY: lint lint-diff gen gen-check spec test bench-smoke bench-multichip \
-	native sanitize sanitize-thread
+	fuzz-smoke check native sanitize sanitize-thread
 
 lint: gen-check
 	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu
@@ -60,6 +60,20 @@ bench-smoke:
 # Also gated inside bench-smoke via trace_report's metrics read-back.
 bench-multichip:
 	JAX_PLATFORMS=cpu $(PY) bench.py --multichip
+
+# the scenario-fuzzing smoke (ISSUE 13): replay the checked-in
+# fuzz/corpus/ regression set, then a bounded seeded sweep — each
+# scenario in its own wall-capped child (the bench-multichip subprocess
+# pattern: killed + reported on overrun, never rc 124), the sweep capped
+# overall so a loaded box stops early and says so.  Any violation exits
+# 1 with a shrunk repro file to replay (`simfuzz --repro PATH`).
+fuzz-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m shadow_tpu.fuzz --corpus --in-process
+	JAX_PLATFORMS=cpu $(PY) -m shadow_tpu.fuzz --seeds 8 \
+		--timeout-sec 240 --wall-cap-sec 420
+
+# the lint-adjacent gate set: static analysis + the fuzz smoke
+check: lint fuzz-smoke
 
 native:
 	$(MAKE) -C native

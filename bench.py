@@ -833,18 +833,40 @@ def bench_scale() -> dict:
     # and cannot reshape it; an in-process row would silently measure
     # the single-chip path).  10 ms granule bounds the tick count on the
     # virtual mesh; killed + reported on overrun, never rc 124.
-    out["scale_tor100k"] = _tor100k_sharded_row()
+    # NOTE: the child always receives --stop-time from the `stop`
+    # parameter (it overrides cfg.stop_time_sec), so the expressions
+    # deliberately carry no stoptime of their own
+    out["scale_tor100k"] = _sharded_scenario_row(
+        "genscen.tor(100_000, stagger_waves=2)",
+        prefix="bench-tor100k-")
+    # the production workload fleet (ISSUE 13 / ROADMAP item 4): the cdn
+    # flash crowd (tens of thousands of clients over 4 origins — few huge
+    # egress segments) and the BitTorrent-style swarm (uniform many-to-
+    # many partner graph, the partitioner's cut-fraction worst case),
+    # both through the sharded mesh with the >= 90%-on-device gate
+    # computed from the same metrics JSONL
+    out["scen_cdn"] = _sharded_scenario_row(
+        "genscen.build('cdn20k')", prefix="bench-cdn-")
+    out["scen_swarm"] = _sharded_scenario_row(
+        "genscen.build('swarm2k')", prefix="bench-swarm-")
+    for key in ("scen_cdn", "scen_swarm"):
+        row = out[key]
+        out[f"{key}_pass"] = bool(
+            row.get("ok") and row.get("flows_completed") == row.get("flows")
+            and (row.get("device_traffic_fraction") or 0) >= 0.90
+            and row.get("mesh.host_bounces") == 0)
     return out
 
 
-def _tor100k_sharded_row(n_dev: int = 8, stop: int = 30,
-                         timeout_sec: int = 600) -> dict:
-    """The tor100k-through-the-mesh row: same scenario shape as the slow
-    test (stagger_waves=2 — the active phase is what costs kernel wall;
-    the preset's 16 waves would multiply it for no extra coverage).
-    Measured 57 s on this box unloaded; shared-tenant slowdowns of 4-5x
-    have been observed, hence the generous bound — overruns report an
-    honest failed row, never rc 124."""
+def _sharded_scenario_row(build_expr: str, n_dev: int = 8, stop: int = 30,
+                          timeout_sec: int = 600,
+                          prefix: str = "bench-scen-") -> dict:
+    """One generated scenario through the SHARDED mesh plane in a bounded
+    subprocess (the parent booted jax single-device and cannot reshape
+    it): ``build_expr`` is evaluated in the child against the genscen
+    module.  tor100k measured 57 s on this box unloaded; shared-tenant
+    slowdowns of 4-5x have been observed, hence the generous bound —
+    overruns report an honest failed row, never rc 124."""
     import shutil
     import subprocess
     import sys
@@ -853,13 +875,12 @@ def _tor100k_sharded_row(n_dev: int = 8, stop: int = 30,
     from shadow_tpu.obs.metrics import read_metrics_file
     from shadow_tpu.tools.trace_report import summarize_metrics
 
-    mdir = tempfile.mkdtemp(prefix="bench-tor100k-")
+    mdir = tempfile.mkdtemp(prefix=prefix)
     mpath = os.path.join(mdir, "metrics.jsonl")
     child = ("import sys\n"
              "from shadow_tpu.scale import genscen\n"
              "from shadow_tpu.tools import mkscenario\n"
-             f"cfg = genscen.tor(100_000, stoptime={stop}, "
-             "stagger_waves=2)\n"
+             f"cfg = {build_expr}\n"
              "sys.exit(mkscenario.run_scenario(cfg, sys.argv[1:]))\n")
     cmd = [sys.executable, "-c", child,
            "--stop-time", str(stop), "--tpu-devices", str(n_dev),
@@ -873,8 +894,8 @@ def _tor100k_sharded_row(n_dev: int = 8, stop: int = 30,
     except subprocess.TimeoutExpired:
         shutil.rmtree(mdir, ignore_errors=True)
         return {"ok": False,
-                "reason": f"tor100k run exceeded the {timeout_sec}s bound "
-                          "and was killed"}
+                "reason": f"{build_expr} exceeded the {timeout_sec}s "
+                          "bound and was killed"}
     wall = time.perf_counter() - t0
     final = {}
     read_error = None
@@ -884,15 +905,22 @@ def _tor100k_sharded_row(n_dev: int = 8, stop: int = 30,
         except (OSError, ValueError, KeyError) as e:
             read_error = repr(e)
     shutil.rmtree(mdir, ignore_errors=True)
+    forwards = final.get("plane.forwards") or 0
+    events = final.get("engine.events") or 0
     row = {
         "ok": bool(proc.returncode == 0 and read_error is None),
         "rc": proc.returncode,
+        "scenario": build_expr,
         "sim_sec_per_wall_sec": round(stop / wall, 2),
         "wall_sec": round(wall, 2),
         "flows": final.get("plane.circuits"),
         "flows_completed": final.get("plane.completed"),
         "peak_rss_mb": final.get("scale.peak_rss_mb"),
         "materialized_hosts": final.get("scale.materialized_hosts"),
+        # the fleet acceptance gate: share of per-packet work that
+        # advanced on-device, from the same metrics JSONL as the rest
+        "device_traffic_fraction": round(
+            forwards / (forwards + events), 4) if forwards else None,
         **{k: v for k, v in final.items() if k.startswith("mesh.")},
     }
     if read_error is not None:
@@ -970,6 +998,19 @@ def _mesh_subprocess_env(n_dev: int) -> dict:
     return env
 
 
+def _last_json_row(stdout: str) -> Optional[dict]:
+    """The last parseable JSON object line of a child's stdout (bounded
+    bench children print their row last, after any log noise)."""
+    for line in reversed(stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
 def bench_multichip(n_dev: int = 8, timeout_sec: int = 420) -> dict:
     """``make bench-multichip`` / ``bench.py --multichip``: the MULTICHIP
     bench row with REAL throughput columns (sim_sec_per_wall,
@@ -998,15 +1039,7 @@ def bench_multichip(n_dev: int = 8, timeout_sec: int = 420) -> dict:
                 "reason": f"multichip run exceeded the {timeout_sec}s "
                           "bound and was killed (no rc 124 leaks to the "
                           "caller)"}
-    row = None
-    for line in reversed(proc.stdout.splitlines()):
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                row = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            break
+    row = _last_json_row(proc.stdout)
     if row is None or proc.returncode != 0:
         shutil.rmtree(mdir, ignore_errors=True)
         return {"skipped": False, "ok": False, "n_devices": n_dev,
@@ -1019,6 +1052,54 @@ def bench_multichip(n_dev: int = 8, timeout_sec: int = 420) -> dict:
     row["rc"] = proc.returncode
     row["metrics_path"] = mpath
     return row
+
+
+def bench_fuzz(n_seeds: int = 4, timeout_sec: int = 600) -> dict:
+    """ISSUE 13: the scenario-fuzzing columns — a bounded simfuzz pass
+    (each scenario already runs in its own wall-capped child; this bound
+    covers the whole sweep) whose seed/violation counts land in the bench
+    record.  Violations must be 0 in a healthy round; a nonzero count
+    names the repro files simfuzz wrote."""
+    import subprocess
+    import sys
+
+    # the wall cap + shrink budget keep a violating run INSIDE the outer
+    # subprocess bound, so the repro file and violation detail survive
+    # (an outer TimeoutExpired would lose both)
+    cmd = [sys.executable, "-m", "shadow_tpu.fuzz",
+           "--seeds", str(n_seeds), "--timeout-sec", "240",
+           "--wall-cap-sec", str(timeout_sec - 120),
+           "--shrink-budget", "8",
+           "--repro-dir", "simfuzz-repros"]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(cmd, timeout=timeout_sec,
+                              capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return {"fuzz_seeds": 0, "fuzz_violations": None,
+                "fuzz_sec": timeout_sec,
+                "fuzz_error": f"simfuzz exceeded the {timeout_sec}s bound "
+                              "and was killed"}
+    row = _last_json_row(proc.stdout)
+    out = {"fuzz_sec": round(time.perf_counter() - t0, 1)}
+    # rc 0 = clean, rc 1 = violations (the summary row carries them);
+    # anything else is a harness failure the gate must NOT read as pass
+    if proc.returncode not in (0, 1):
+        out.update(fuzz_seeds=0, fuzz_violations=None,
+                   fuzz_error=f"simfuzz exited rc={proc.returncode}",
+                   fuzz_tail=(proc.stdout + proc.stderr)[-600:])
+        return out
+    if row is None:
+        out.update(fuzz_seeds=0, fuzz_violations=None,
+                   fuzz_error="simfuzz produced no summary row",
+                   fuzz_tail=(proc.stdout + proc.stderr)[-600:])
+        return out
+    s = row.get("simfuzz", {})
+    out.update(fuzz_seeds=s.get("seeds"),
+               fuzz_violations=s.get("violations"))
+    if s.get("repros"):
+        out["fuzz_repros"] = s["repros"]
+    return out
 
 
 def bench_smoke() -> int:
@@ -1285,6 +1366,7 @@ def main() -> None:
     # 145k events/s on tor200_serial depending on order)
     sims = bench_full_sims()
     sims.update(bench_scale())
+    fuzz_cols = bench_fuzz()
     topo = build_topology(256)
     cpu_rate = bench_cpu_scalar(topo, 200_000)
     dev_rate = bench_device(topo, batch=1 << 20, iters=8)
@@ -1366,6 +1448,7 @@ def main() -> None:
         "simgen_surfaces": simgen_surfaces,
         "simgen_sec": simgen_sec,
         "cubic_parity_pass": cubic_parity_pass,
+        **fuzz_cols,
         "kernel_transfer_inclusive_mpkts": round(dev_rate / 1e6, 3),
         "kernel_device_compute_mpkts": round(dev_compute / 1e6, 2),
         "own_scalar_python_mpkts": round(cpu_rate / 1e6, 4),
@@ -1468,6 +1551,13 @@ def main() -> None:
         "simgen_surfaces": simgen_surfaces,
         "simgen_sec": simgen_sec,
         "cubic_parity_pass": cubic_parity_pass,
+        # scenario fuzzing (ISSUE 13): violations must be 0; the fleet
+        # rows must complete >= 90% on-device through the sharded mesh
+        "fuzz_seeds": fuzz_cols.get("fuzz_seeds"),
+        "fuzz_violations": fuzz_cols.get("fuzz_violations"),
+        "fuzz_sec": fuzz_cols.get("fuzz_sec"),
+        "scen_cdn_pass": sims.get("scen_cdn_pass"),
+        "scen_swarm_pass": sims.get("scen_swarm_pass"),
         "gates_enforced": True,
     }
     blob = json.dumps(summary)
@@ -1499,6 +1589,18 @@ def main() -> None:
     if flag.get("native_round_demoted"):
         failures.append("tor10k flagship ran with the C round executor "
                         "demoted — investigate before publishing rates")
+    # ISSUE 13: fuzz violations and fleet-row regressions fail the bench;
+    # a fuzz leg that never produced a verdict (timeout/crash — the
+    # fail-open case) fails it too, never reads as pass
+    if fuzz_cols.get("fuzz_violations"):
+        failures.append(
+            f"simfuzz found {fuzz_cols['fuzz_violations']} violation(s); "
+            f"repros: {fuzz_cols.get('fuzz_repros')}")
+    elif fuzz_cols.get("fuzz_error"):
+        failures.append(f"fuzz leg failed: {fuzz_cols['fuzz_error']}")
+    for key in ("scen_cdn_pass", "scen_swarm_pass"):
+        if sims.get(key) is False:
+            failures.append(f"{key} failed: {sims.get(key[:-5])}")
     if failures:
         print("BENCH GATE FAILURES: " + "; ".join(failures),
               file=sys.stderr, flush=True)

@@ -47,7 +47,14 @@ class FlowConfig:
     3-relay path per quantity-expanded host from a seeded draw over
     ``tor_relays`` hosts named ``<tor_relay_prefix>1..N`` (and a dest drawn
     over ``tor_servers`` hosts named ``<tor_server_prefix>1..N``), so a
-    100k-client Tor shape needs ONE FlowConfig, not 100k.  ``stagger``:
+    100k-client Tor shape needs ONE FlowConfig, not 100k.
+
+    ``dest_seed`` draws a distinct 2-hop destination per quantity-expanded
+    host from a seeded draw over ``dest_count`` hosts named
+    ``<dest_prefix>1..N`` (a draw landing on the host itself shifts to the
+    next name, so a group can target its own peers) — the cdn flash-crowd
+    (many clients over few origins) and the swarm many-to-many shape need
+    ONE FlowConfig per piece, not one per client.  ``stagger``:
     host q's start is start_time_sec + (q %% stagger_waves) * stagger_step_sec."""
     dest: str = ""
     start_time_sec: float = 1.0
@@ -61,6 +68,9 @@ class FlowConfig:
     tor_relay_prefix: str = "relay"
     tor_servers: int = 0
     tor_server_prefix: str = "dest"
+    dest_seed: Optional[int] = None
+    dest_count: int = 0
+    dest_prefix: str = ""
 
 
 def tokenize_arguments(arguments: str) -> List[str]:
@@ -219,7 +229,11 @@ def parse_xml(text: str) -> Configuration:
                         tor_relays=_to_int(pel.get("torrelays")),
                         tor_relay_prefix=pel.get("torrelayprefix", "relay"),
                         tor_servers=_to_int(pel.get("torservers")),
-                        tor_server_prefix=pel.get("torserverprefix", "dest")))
+                        tor_server_prefix=pel.get("torserverprefix", "dest"),
+                        dest_seed=(_to_int(pel.get("destseed"))
+                                   if pel.get("destseed") else None),
+                        dest_count=_to_int(pel.get("destcount")),
+                        dest_prefix=pel.get("destprefix", "")))
             cfg.hosts.append(h)
     return cfg
 
@@ -291,7 +305,11 @@ def parse_dict(d: dict) -> Configuration:
                 tor_relays=_to_int(fl.get("tor_relays")),
                 tor_relay_prefix=fl.get("tor_relay_prefix", "relay"),
                 tor_servers=_to_int(fl.get("tor_servers")),
-                tor_server_prefix=fl.get("tor_server_prefix", "dest")))
+                tor_server_prefix=fl.get("tor_server_prefix", "dest"),
+                dest_seed=(_to_int(fl.get("dest_seed"))
+                           if fl.get("dest_seed") is not None else None),
+                dest_count=_to_int(fl.get("dest_count")),
+                dest_prefix=fl.get("dest_prefix", "")))
         cfg.hosts.append(hc)
     return cfg
 

@@ -389,13 +389,21 @@ class ThreadSinglePolicy(SchedulerPolicy):
         self._locks: Dict[int, threading.Lock] = {}
         self._assignment: Dict[int, List] = {}
         self._host_worker: Dict[int, int] = {}
+        # guards lazy queue/mailbox CREATION: a first-push from worker A
+        # while worker B iterates the dict raised "dictionary changed
+        # size during iteration" (fuzz-era flake); iterations below also
+        # snapshot via list() (atomic under the GIL) so a racing create
+        # can never invalidate a live iterator
+        self._create_lock = threading.Lock()
 
     def add_host(self, host, worker_id: int) -> None:
         self._assignment.setdefault(worker_id, []).append(host)
         self._host_worker[host.id] = worker_id
         if worker_id not in self._queues:
-            self._queues[worker_id] = PriorityQueue()
-            self._locks[worker_id] = threading.Lock()
+            with self._create_lock:
+                if worker_id not in self._queues:
+                    self._locks[worker_id] = threading.Lock()
+                    self._queues[worker_id] = PriorityQueue()
 
     def assigned_hosts(self, worker_id: int) -> List:
         return self._assignment.get(worker_id, [])
@@ -409,8 +417,12 @@ class ThreadSinglePolicy(SchedulerPolicy):
             event.time = barrier
         w = self._queue_for(event)
         if w not in self._queues:
-            self._queues[w] = PriorityQueue()
-            self._locks[w] = threading.Lock()
+            with self._create_lock:
+                if w not in self._queues:
+                    # lock first: anyone who can see the queue key must
+                    # be able to take its lock
+                    self._locks[w] = threading.Lock()
+                    self._queues[w] = PriorityQueue()
         with self._locks[w]:
             self._queues[w].push(event)
 
@@ -426,7 +438,7 @@ class ThreadSinglePolicy(SchedulerPolicy):
 
     def next_time(self) -> int:
         t = stime.SIM_TIME_MAX
-        for w, q in self._queues.items():
+        for w, q in list(self._queues.items()):
             with self._locks[w]:
                 key = q.peek_key()
             if key is not None:
@@ -434,7 +446,7 @@ class ThreadSinglePolicy(SchedulerPolicy):
         return t
 
     def pending_count(self) -> int:
-        return sum(len(q) for q in self._queues.values())
+        return sum(len(q) for q in list(self._queues.values()))
 
 
 class ThreadPerThreadPolicy(ThreadSinglePolicy):
@@ -448,8 +460,8 @@ class ThreadPerThreadPolicy(ThreadSinglePolicy):
         self._mlocks: Dict[tuple, threading.Lock] = {}
 
     def pending_count(self) -> int:
-        return (sum(len(q) for q in self._queues.values())
-                + sum(len(q) for q in self._mailboxes.values()))
+        return (sum(len(q) for q in list(self._queues.values()))
+                + sum(len(q) for q in list(self._mailboxes.values())))
 
     def push(self, event: Event, worker_id: int, barrier: int) -> None:
         if event.dst_host is not event.src_host and event.time < barrier:
@@ -457,14 +469,16 @@ class ThreadPerThreadPolicy(ThreadSinglePolicy):
         dst_worker = self._queue_for(event)
         key = (worker_id, dst_worker)
         if key not in self._mailboxes:
-            self._mailboxes[key] = PriorityQueue()
-            self._mlocks[key] = threading.Lock()
+            with self._create_lock:
+                if key not in self._mailboxes:
+                    self._mlocks[key] = threading.Lock()
+                    self._mailboxes[key] = PriorityQueue()
         with self._mlocks[key]:
             self._mailboxes[key].push(event)
 
     def pop(self, worker_id: int, window_end: int) -> Optional[Event]:
         best_key, best_mb = None, None
-        for (src, dst), q in self._mailboxes.items():
+        for (src, dst), q in list(self._mailboxes.items()):
             if dst != worker_id:
                 continue
             with self._mlocks[(src, dst)]:
@@ -479,7 +493,7 @@ class ThreadPerThreadPolicy(ThreadSinglePolicy):
 
     def next_time(self) -> int:
         t = stime.SIM_TIME_MAX
-        for key, q in self._mailboxes.items():
+        for key, q in list(self._mailboxes.items()):
             with self._mlocks[key]:
                 k = q.peek_key()
             if k is not None:

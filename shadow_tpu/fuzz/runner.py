@@ -1,0 +1,263 @@
+"""Execute a spec's mode matrix and report per-mode results.
+
+Two execution surfaces over ONE code path:
+
+* :func:`run_modes` — in-process: build the spec's ``Configuration``
+  fresh per mode, run it, capture digest/events/supervision/metrics and
+  the log tail.  Used by the tier-1 gates, corpus replay, and the
+  subprocess child.
+* :class:`SubprocessRunner` — production fuzzing: each spec runs in a
+  BOUNDED child (``python -m shadow_tpu.fuzz --child IN OUT``, the
+  bench-multichip pattern: killed + reported on overrun, never a hang),
+  with the virtual device mesh forced on CPU so the sharded-mesh mode is
+  exercised even where no accelerator pool exists.
+
+``apply_fault`` implements the fuzz-level fault harness (ISSUE 13): a
+deliberately drifted oracle INPUT — perturbing the reported digest/
+events/supervision/rc of one named mode — that the oracle set must
+catch, the shrinker minimize, and ``--repro`` replay.  ``engine:*``
+faults pass through to ``Options.fault_inject`` instead (the ISSUE-2
+harness), driving REAL supervised recoveries.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import time as _walltime
+import traceback
+from typing import Dict, List, Optional
+
+from .gen import build_config
+
+# metrics keys copied into each mode result (oracle surfaces)
+_SCRAPE_KEYS_PREFIX = ("mesh.",)
+_SCRAPE_KEYS = ("plane.circuits", "plane.completed", "plane.forwards",
+                "scale.materialized_hosts", "scale.table_rows")
+
+
+def _mode_options(spec: Dict, mode: Dict):
+    from ..core.options import Options
+    opts = Options(
+        scheduler_policy=mode.get("policy", "global"),
+        workers=int(mode.get("workers", 0)),
+        processes=int(mode.get("processes", 0)),
+        stop_time_sec=int(spec["stoptime"]),
+        seed=int(spec.get("engine_seed", 1)),
+        host_table=mode.get("host_table", "on"),
+        dataplane=mode.get("dataplane", "python"),
+        device_plane=mode.get("device_plane", "device"),
+        superwindow_rounds=int(mode.get("superwindow_rounds", 8)),
+        device_plane_sync=bool(mode.get("device_plane_sync", False)),
+        tpu_devices=int(mode.get("tpu_devices", 1)),
+        heartbeat_interval_sec=0,
+        log_level="warning")
+    fault = spec.get("fault_inject") or {}
+    if fault.get("kind") == "engine":
+        opts.fault_inject = fault["spec"]
+    return opts
+
+
+def _mesh_skip_reason(mode: Dict) -> Optional[str]:
+    if int(mode.get("tpu_devices", 1)) <= 1:
+        return None
+    import jax
+    n = len(jax.devices())
+    if n < 2:
+        return f"mesh mode needs >= 2 devices, {n} visible"
+    return None
+
+
+def run_one_mode(spec: Dict, mode: Dict) -> Dict:
+    """Run the spec under one mode.  Never raises: harness errors land in
+    the result as rc=-1 + traceback (the rc/log oracle fails them)."""
+    from ..core.checkpoint import state_digest
+    from ..core.controller import Controller
+    from ..core.logger import SimLogger, set_logger
+
+    out: Dict = {"mode": mode["name"],
+                 "repeat_of": mode.get("repeat_of"),
+                 "events_comparable": bool(
+                     mode.get("events_comparable", True)),
+                 "skipped": None, "rc": None, "digest": None,
+                 "events": None, "rounds": None, "supervision": None,
+                 "scrape": {}, "log_tail": "", "wall_sec": None}
+    reason = _mesh_skip_reason(mode)
+    if reason:
+        out["skipped"] = reason
+        return out
+    buf = io.StringIO()
+    set_logger(SimLogger(stream=buf, level="warning"))
+    t0 = _walltime.perf_counter()
+    try:
+        cfg = build_config(spec)
+        opts = _mode_options(spec, mode)
+        if opts.processes >= 2:
+            from ..parallel.procs import ProcsController
+            pc = ProcsController(opts, cfg)
+            out["rc"] = pc.run()
+            out["digest"] = pc.digest
+            out["events"] = pc.events_executed
+        else:
+            ctrl = Controller(opts, cfg)
+            out["rc"] = ctrl.run()
+            eng = ctrl.engine
+            out["digest"] = state_digest(eng)
+            out["events"] = eng.events_executed
+            out["rounds"] = eng.rounds_executed
+            out["supervision"] = eng.supervision.summary()
+            scrape = eng.metrics.scrape()
+            out["scrape"] = {
+                k: v for k, v in sorted(scrape.items())
+                if k in _SCRAPE_KEYS
+                or k.startswith(_SCRAPE_KEYS_PREFIX)}
+    except Exception:
+        out["rc"] = -1
+        buf.write("\n" + traceback.format_exc())
+    out["wall_sec"] = round(_walltime.perf_counter() - t0, 3)
+    out["log_tail"] = buf.getvalue()[-2000:]
+    return out
+
+
+def apply_fault(spec: Dict, result: Dict) -> Dict:
+    """The fuzz-level fault harness: deterministically drift ONE named
+    mode's reported oracle inputs so the pipeline (catch -> shrink ->
+    repro) is drilled end to end.  ``engine:*`` faults are applied at
+    options build instead; everything else matches on the mode name."""
+    fault = spec.get("fault_inject") or {}
+    kind = fault.get("kind")
+    if not kind or kind == "engine":
+        return result
+    if fault.get("mode") not in (result["mode"], "*"):
+        return result
+    if result["skipped"]:
+        return result
+    if kind == "digest-drift" and result["digest"]:
+        result["digest"] = "drift-" + result["digest"][:56]
+    elif kind == "events-drift" and result["events"] is not None:
+        result["events"] += 1
+    elif kind == "supervision-drift" and result["supervision"] is not None:
+        result["supervision"] = dict(result["supervision"])
+        result["supervision"]["recoveries"] += 1
+        result["supervision"]["dispatch_recoveries"] += 1
+    elif kind == "rc-drift":
+        result["rc"] = 7
+    return result
+
+
+def parse_fault(spec_str: str) -> Dict:
+    """``digest-drift:MODE | events-drift:MODE | supervision-drift:MODE |
+    rc-drift:MODE | engine:ENGINE-FAULT`` (MODE is a mode name or ``*``;
+    ENGINE-FAULT is a core/supervision.py --fault-inject token)."""
+    kind, _, rest = spec_str.partition(":")
+    if kind == "engine":
+        if not rest:
+            raise ValueError("fault engine: needs an engine fault token")
+        from ..core.supervision import parse_fault_inject
+        parse_fault_inject(rest)      # validate eagerly
+        return {"kind": "engine", "spec": rest}
+    if kind in ("digest-drift", "events-drift", "supervision-drift",
+                "rc-drift"):
+        return {"kind": kind, "mode": rest or "*"}
+    raise ValueError(f"unknown fuzz fault kind {kind!r}")
+
+
+def run_modes(spec: Dict, modes: Optional[List[Dict]] = None) -> List[Dict]:
+    """Run every mode of the spec in this process, fault drift applied."""
+    results = []
+    for mode in (modes if modes is not None else spec["modes"]):
+        results.append(apply_fault(spec, run_one_mode(spec, mode)))
+    return results
+
+
+# ---------------------------------------------------------------------------
+# bounded subprocess execution
+# ---------------------------------------------------------------------------
+
+def child_env(n_dev: int = 8) -> Dict[str, str]:
+    """Child env: CPU-pinned with the virtual device mesh (the same mesh
+    the test suite and bench-multichip use), so mesh modes run anywhere;
+    a pre-pinned accelerator environment is left alone."""
+    env = os.environ.copy()
+    if env.get("JAX_PLATFORMS", "").strip() in ("", "cpu"):
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n_dev}"
+            ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def child_main(in_path: str, out_path: str) -> int:
+    """``python -m shadow_tpu.fuzz --child IN OUT``: run the spec file's
+    modes, write the result list as JSON.  rc 0 even on violations — the
+    PARENT judges; a nonzero rc means the harness itself broke."""
+    with open(in_path, "r") as f:
+        spec = json.load(f)
+    results = run_modes(spec)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump({"spec_seed": spec.get("seed"), "results": results}, f)
+    os.replace(tmp, out_path)
+    return 0
+
+
+class SubprocessRunner:
+    """Run each spec's whole mode matrix in ONE bounded child process
+    (modes share the child's XLA compile cache; a wedged scenario is
+    killed at ``timeout_sec`` and reported as a timeout result, never a
+    hang — the bench-multichip subprocess pattern)."""
+
+    def __init__(self, timeout_sec: float = 240.0, n_dev: int = 8):
+        self.timeout_sec = float(timeout_sec)
+        self.n_dev = n_dev
+
+    def run(self, spec: Dict) -> List[Dict]:
+        import subprocess
+        import sys
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="simfuzz-") as td:
+            in_path = os.path.join(td, "spec.json")
+            out_path = os.path.join(td, "results.json")
+            with open(in_path, "w") as f:
+                json.dump(spec, f)
+            cmd = [sys.executable, "-m", "shadow_tpu.fuzz", "--child",
+                   in_path, out_path]
+            try:
+                proc = subprocess.run(
+                    cmd, env=child_env(self.n_dev),
+                    timeout=self.timeout_sec, capture_output=True,
+                    text=True, cwd=os.path.dirname(
+                        os.path.dirname(os.path.dirname(
+                            os.path.abspath(__file__)))))
+            except subprocess.TimeoutExpired:
+                return [{"mode": "<child>", "repeat_of": None,
+                         "events_comparable": False, "skipped": None,
+                         "rc": None, "timeout": True, "digest": None,
+                         "events": None, "rounds": None,
+                         "supervision": None, "scrape": {},
+                         "log_tail": f"child exceeded the "
+                                     f"{self.timeout_sec:.0f}s bound and "
+                                     "was killed",
+                         "wall_sec": self.timeout_sec}]
+            if proc.returncode != 0 or not os.path.exists(out_path):
+                return [{"mode": "<child>", "repeat_of": None,
+                         "events_comparable": False, "skipped": None,
+                         "rc": proc.returncode, "digest": None,
+                         "events": None, "rounds": None,
+                         "supervision": None, "scrape": {},
+                         "log_tail": (proc.stdout + proc.stderr)[-2000:],
+                         "wall_sec": None}]
+            with open(out_path, "r") as f:
+                return json.load(f)["results"]
+
+
+class InProcessRunner:
+    """Same contract as SubprocessRunner, no child (tests/corpus)."""
+
+    def run(self, spec: Dict) -> List[Dict]:
+        return run_modes(spec)

@@ -287,14 +287,19 @@ class DeviceTrafficPlane:
         self.specs = specs
         for i, s in enumerate(specs):
             s.circuit = i
-        self._by_client = {s.client_name: s for s in specs}
-        if len(self._by_client) != len(specs):
-            # specs/waiters are keyed by host name; two device-mode clients
-            # on one host would silently share a circuit (the second spec
-            # wins) and one client's activate/join would target the wrong
-            # flow, blocking until end_time with no error
+        # activate/check_route/join are keyed by host name, so the
+        # one-flow-per-host rule holds for PLUGIN-driven specs only; auto
+        # (processless) flows self-stage and wake by circuit index, never
+        # through this dict — a swarm peer may carry many chains
+        plugin_specs = [s for s in specs if s.auto_start_ns is None]
+        self._by_client = {s.client_name: s for s in plugin_specs}
+        if len(self._by_client) != len(plugin_specs):
+            # two device-mode clients on one host would silently share a
+            # circuit (the second spec wins) and one client's
+            # activate/join would target the wrong flow, blocking until
+            # end_time with no error
             seen: set = set()
-            dup = next(s.client_name for s in specs
+            dup = next(s.client_name for s in plugin_specs
                        if s.client_name in seen or seen.add(s.client_name))
             raise ValueError(
                 f"device plane: host {dup!r} has multiple device-mode tor "
@@ -641,6 +646,12 @@ class DeviceTrafficPlane:
             raise ValueError(
                 f"{client_name}: activate(cells={cells}) — device flows "
                 "need at least 1 cell")
+        return self._activate_spec(spec, cells)
+
+    def _activate_spec(self, spec, cells: Optional[int] = None) -> int:
+        """Inject a spec's cells (shared by name-keyed plugin activation
+        and circuit-indexed auto staging — auto flows are not in
+        ``_by_client``, a host may carry many of them)."""
         # an explicit cells argument overrides the DOWNLOAD size; the
         # configured upload still runs (completion requires both chains)
         down = spec.cells_down if cells is None else cells
@@ -1220,7 +1231,7 @@ class DeviceTrafficPlane:
                 and self._auto[self._auto_pos][0] <= now_ns:
             _t, circ = self._auto[self._auto_pos]
             self._auto_pos += 1
-            self.activate(self.specs[circ].client_name)
+            self._activate_spec(self.specs[circ])
 
     def busy(self) -> bool:
         """True while the plane still has work the engine must keep

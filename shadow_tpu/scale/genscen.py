@@ -5,7 +5,8 @@ Emits ``Configuration`` objects directly — a 100k-host scenario is a few
 multi-megabyte XML string (the tor10k generator in tools/workloads.py
 already spends seconds just formatting XML the parser then re-tokenizes).
 
-Three families, mirroring the reference's experiment shapes:
+Five families, mirroring the reference's experiment shapes plus the
+production-traffic fleet (ROADMAP item 4):
 
 * :func:`star`    — one fat server, N clients each pulling bulk bytes over
   the device-resident traffic plane (workload #2 scaled out; star10k /
@@ -16,6 +17,12 @@ Three families, mirroring the reference's experiment shapes:
 * :func:`tor`     — the reference's Tor shape (~10% relays, ~1% servers,
   the rest clients on distinct seeded 3-hop circuits; tor100k) with all
   traffic as 5-hop device-plane chains.
+* :func:`cdn`     — HTTP/1.1-shaped flash crowd: tens of thousands of
+  clients hammering a few fat origins via seeded 2-hop chains (cdn20k);
+  the contended resource is the origins' egress buckets.
+* :func:`swarm`   — BitTorrent-style many-to-many piece exchange over a
+  seeded uniform partner graph (swarm2k); the mesh partitioner's
+  cut-fraction worst case.
 
 All structure is seeded (numpy ``default_rng``) so a scenario built with
 the same arguments is identical, and the per-client tor paths are derived
@@ -80,10 +87,38 @@ def expand_flows(table, grp) -> List[tuple]:
                 guard = f"{rp}{int(g[q]) + 1}"
                 middle = f"{rp}{int(m[q]) + 1}"
                 exit_ = f"{rp}{int(e[q]) + 1}"
-                dest = f"{sp}{int(dests[q]) + 1}"
+                # a quantity-1 group keeps its bare id as its host name
+                # (the sub-100-host tor shape has ONE dest — fuzz-found)
+                dest = sp if fc.tor_servers == 1 \
+                    else f"{sp}{int(dests[q]) + 1}"
                 out.append((grp.first_row + q,
                             (dest, exit_, middle, guard, client),
                             (client, guard, middle, exit_, dest),
+                            fc.down_bytes, fc.up_bytes, int(starts[q])))
+        elif fc.dest_seed is not None:
+            # seeded 2-hop destination draw over <dest_prefix>1..dest_count
+            # (cdn flash-crowd / swarm many-to-many): a draw landing on the
+            # host itself shifts to the next name so a group can target its
+            # own peers without ever flowing to itself
+            if fc.dest_count < 1:
+                raise ValueError(
+                    f"flow on {hc.id!r}: dest_seed needs dest_count >= 1")
+            rng = np.random.default_rng(fc.dest_seed)
+            draws = rng.integers(0, fc.dest_count, n)
+            for q in range(n):
+                client = grp.name_of(q)
+                d = int(draws[q])
+                # quantity-1 dest groups keep their bare id as the name
+                dest = fc.dest_prefix if fc.dest_count == 1 \
+                    else f"{fc.dest_prefix}{d + 1}"
+                if dest == client:
+                    if fc.dest_count < 2:
+                        raise ValueError(
+                            f"flow on {hc.id!r}: dest_seed over a single-"
+                            "host group cannot avoid self-flows")
+                    dest = f"{fc.dest_prefix}{(d + 1) % fc.dest_count + 1}"
+                out.append((grp.first_row + q,
+                            (dest, client), (client, dest),
                             fc.down_bytes, fc.up_bytes, int(starts[q])))
         elif fc.path:
             hops = [h.strip() for h in fc.path.split(",") if h.strip()]
@@ -188,28 +223,131 @@ def tor(n_hosts: int = 100_000, stoptime: int = 600,
     return cfg
 
 
+def cdn(n_clients: int = 20_000, n_origins: int = 4, stoptime: int = 120,
+        down_bytes: int = 256 * 1024, up_bytes: int = 1024,
+        start_sec: float = 2.0, stagger_waves: int = 2,
+        stagger_step_sec: float = 1.0, seed: int = 1,
+        origin_bw_kibps: int = 4 * 1024 * 1024,
+        client_down_kibps: int = 102400,
+        client_up_kibps: int = 20480) -> Configuration:
+    """cdn20k: an HTTP/1.1-shaped flash crowd — tens of thousands of
+    clients hammering a handful of fat origins at once.  Every client is a
+    processless table row with ONE seeded 2-hop chain to a drawn origin
+    (``dest_seed``), so the contended resource is the few origins' egress
+    buckets (the segment-cumsum's few huge segments), the inverse of tor's
+    many-small-segments shape."""
+    if n_origins < 1:
+        raise ValueError("cdn needs at least one origin")
+    cfg = Configuration(stop_time_sec=stoptime)
+    cfg.hosts.append(HostConfig(
+        id="origin", quantity=n_origins,
+        bandwidth_down_kibps=origin_bw_kibps,
+        bandwidth_up_kibps=origin_bw_kibps))
+    cfg.hosts.append(HostConfig(
+        id="cdnclient", quantity=n_clients,
+        bandwidth_down_kibps=client_down_kibps,
+        bandwidth_up_kibps=client_up_kibps,
+        flows=[FlowConfig(dest="", start_time_sec=start_sec,
+                          down_bytes=down_bytes, up_bytes=up_bytes,
+                          stagger_waves=stagger_waves,
+                          stagger_step_sec=stagger_step_sec,
+                          dest_seed=seed, dest_count=n_origins,
+                          dest_prefix="origin")]))
+    return cfg
+
+
+def swarm(n_peers: int = 2_000, pieces: int = 4, stoptime: int = 120,
+          piece_bytes: int = 64 * 1024, start_sec: float = 2.0,
+          stagger_waves: int = 4, stagger_step_sec: float = 1.0,
+          seed: int = 1, bw_down_kibps: int = 51200,
+          bw_up_kibps: int = 25600) -> Configuration:
+    """swarm2k: a BitTorrent-style many-to-many swarm — every peer
+    exchanges ``pieces`` bidirectional transfers with seeded-drawn
+    partners (self-draws shift to the next peer).  The uniform random
+    partner graph is the mesh partitioner's worst case: cut fraction
+    approaches (D-1)/D at D shards, so this is the cut-stress workload
+    the cdn/star/tor shapes never produce."""
+    if n_peers < 2:
+        raise ValueError("swarm needs at least two peers")
+    cfg = Configuration(stop_time_sec=stoptime)
+    flows = [FlowConfig(dest="", start_time_sec=start_sec,
+                        down_bytes=piece_bytes, up_bytes=piece_bytes,
+                        stagger_waves=stagger_waves,
+                        stagger_step_sec=stagger_step_sec,
+                        dest_seed=seed * 7919 + k, dest_count=n_peers,
+                        dest_prefix="peer")
+             for k in range(pieces)]
+    cfg.hosts.append(HostConfig(
+        id="peer", quantity=n_peers, bandwidth_down_kibps=bw_down_kibps,
+        bandwidth_up_kibps=bw_up_kibps, flows=flows))
+    return cfg
+
+
+FAMILIES: Dict[str, object] = {
+    "star": star, "phold": phold, "tor": tor, "cdn": cdn, "swarm": swarm,
+}
+
+# name -> (family, preset kwargs).  build() MERGES overrides onto the
+# preset (overrides win), so build("star10k", stoptime=5) is the 10k
+# preset at stoptime 5, never the family default silently.
+PRESETS: Dict[str, tuple] = {
+    "star2k": ("star", dict(n_clients=2_000, stoptime=120,
+                            stagger_waves=2)),
+    "star10k": ("star", dict(n_clients=10_000, stoptime=300,
+                             stagger_waves=4)),
+    "star100k": ("star", dict(n_clients=100_000)),
+    "phold10k": ("phold", dict(n_hosts=10_000)),
+    "phold100k": ("phold", dict(n_hosts=100_000)),
+    "tor10k": ("tor", dict(n_hosts=10_000, stoptime=300,
+                           stagger_waves=8)),
+    "tor100k": ("tor", dict(n_hosts=100_000)),
+    "cdn2k": ("cdn", dict(n_clients=2_000, n_origins=3, stoptime=60)),
+    "cdn20k": ("cdn", dict(n_clients=20_000, n_origins=4)),
+    "swarm500": ("swarm", dict(n_peers=500, pieces=3, stoptime=60)),
+    "swarm2k": ("swarm", dict(n_peers=2_000, pieces=4)),
+}
+
+# kept for callers that list/run the presets directly
 NAMED: Dict[str, object] = {
-    "star2k": lambda: star(2_000, stoptime=120, stagger_waves=2),
-    "star10k": lambda: star(10_000, stoptime=300, stagger_waves=4),
-    "star100k": lambda: star(100_000),
-    "phold10k": lambda: phold(10_000),
-    "phold100k": lambda: phold(100_000),
-    "tor10k": lambda: tor(10_000, stoptime=300, stagger_waves=8),
-    "tor100k": lambda: tor(100_000),
+    name: (lambda fam=fam, kw=kw: FAMILIES[fam](**kw))
+    for name, (fam, kw) in PRESETS.items()
 }
 
 
-def build(name: str, **overrides) -> Configuration:
-    """Build a named scenario.  With ``overrides``, the name picks the
-    FAMILY (star/phold/tor) and the overrides parameterize it directly —
-    ``build("star", n_clients=5000)``; without, the named preset runs."""
-    if name in NAMED and not overrides:
-        return NAMED[name]()
-    for prefix, fn in (("star", star), ("phold", phold), ("tor", tor)):
+def _validate_overrides(fn, name: str, kw: Dict) -> None:
+    """Reject unknown builder kwargs LOUDLY: a typo'd ``stoptme=`` must
+    never silently build the default scenario — the fuzzer's repro files
+    depend on override fidelity."""
+    import inspect
+    valid = set(inspect.signature(fn).parameters)
+    unknown = sorted(set(kw) - valid)
+    if unknown:
+        raise ValueError(
+            f"scenario {name!r}: unknown override(s) "
+            f"{', '.join(unknown)}; valid: {', '.join(sorted(valid))}")
+
+
+def family_fn(name: str):
+    """The builder function behind a preset or family name."""
+    if name in PRESETS:
+        return FAMILIES[PRESETS[name][0]]
+    for prefix in sorted(FAMILIES, key=len, reverse=True):
         if name.startswith(prefix):
-            return fn(**overrides)
+            return FAMILIES[prefix]
     raise ValueError(f"unknown scenario {name!r}; "
-                     f"known: {', '.join(sorted(NAMED))}")
+                     f"known: {', '.join(sorted(PRESETS))}")
+
+
+def build(name: str, **overrides) -> Configuration:
+    """Build a named scenario.  A preset name (``star10k``) merges
+    ``overrides`` onto the preset's kwargs; a family name (``star``) uses
+    the overrides directly.  Unknown override names raise ValueError
+    naming the valid set (never a silently-default scenario)."""
+    fn = family_fn(name)
+    kw = {**PRESETS[name][1], **overrides} if name in PRESETS \
+        else dict(overrides)
+    _validate_overrides(fn, name, kw)
+    return fn(**kw)
 
 
 def config_digest(cfg: Configuration) -> str:

@@ -13,7 +13,10 @@ content digest; ``--xml`` dumps legacy XML (refused above 50k hosts —
 emitting multi-megabyte XML is exactly what the generators exist to
 avoid; the ``<flow>`` element round-trips through configuration.parse_xml
 for the sizes where XML makes sense); ``--run`` executes the scenario
-with the host table on and prints the run's scale metrics.
+with the host table on and prints the run's scale metrics, propagating
+the child engine's exit code.  ``--seed N`` pins BOTH the seeded
+families' structural draws (tor circuits, cdn/swarm partner graphs) and
+the engine seed, so a fuzz-discovered scenario replays from the CLI.
 """
 
 from __future__ import annotations
@@ -70,6 +73,10 @@ def config_to_xml(cfg: Configuration) -> str:
                 f.append(f'torrelayprefix="{fc.tor_relay_prefix}"')
                 f.append(f'torservers="{fc.tor_servers}"')
                 f.append(f'torserverprefix="{fc.tor_server_prefix}"')
+            if fc.dest_seed is not None:
+                f.append(f'destseed="{fc.dest_seed}"')
+                f.append(f'destcount="{fc.dest_count}"')
+                f.append(f'destprefix="{fc.dest_prefix}"')
             body.append(f'    <flow {" ".join(f)} />')
         if body:
             lines.append(f'  <host {" ".join(attrs)}>')
@@ -118,15 +125,42 @@ def run_scenario(cfg: Configuration, argv: List[str]) -> int:
 
 
 def main(argv: List[str]) -> int:
-    from ..scale.genscen import NAMED, build
+    from ..scale.genscen import NAMED, build, family_fn
     if not argv or argv[0].startswith("-"):
         print(f"usage: python -m shadow_tpu.tools.mkscenario "
               f"{{{','.join(sorted(NAMED))}}} [--summary|--xml|--run] "
-              "[run options]", file=sys.stderr)
+              "[--seed N] [run options]", file=sys.stderr)
         return 2
     name, rest = argv[0], argv[1:]
+    overrides = {}
+    seed_args = [a for a in rest
+                 if a == "--seed" or a.startswith("--seed=")]
+    if seed_args:
+        # --seed parameterizes the scenario BUILDER for the seeded
+        # families (tor/cdn/swarm path+partner draws) so fuzz-discovered
+        # scenarios replay from the CLI; run_scenario parses the same flag
+        # again for the engine seed, so one value pins both draws.  Both
+        # argparse spellings (--seed N / --seed=N) must hit the builder —
+        # a silently-skipped override would replay a DIFFERENT scenario.
+        import inspect
+        try:
+            # LAST occurrence wins, matching run_scenario's argparse —
+            # builder and engine must never read different seeds
+            a = seed_args[-1]
+            seed = int(a.partition("=")[2]) if "=" in a \
+                else int(rest[len(rest) - 1 - rest[::-1].index("--seed")
+                              + 1])
+        except (IndexError, ValueError):
+            print("error: --seed needs an integer", file=sys.stderr)
+            return 2
+        try:
+            if "seed" in inspect.signature(family_fn(name)).parameters:
+                overrides["seed"] = seed
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
     try:
-        cfg = build(name)
+        cfg = build(name, **overrides)
     except ValueError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
@@ -138,6 +172,8 @@ def main(argv: List[str]) -> int:
             return 2
         return 0
     if "--run" in rest:
+        # the child engine's exit code propagates verbatim — a failed
+        # fuzz replay must fail the CLI, not report rc 0
         return run_scenario(cfg, [a for a in rest if a != "--run"])
     print(json.dumps({"scenario": name, **summarize(cfg)}))
     return 0

@@ -1,6 +1,9 @@
 """The scaled event-order parity gate.
 
-One randomized 100-host star with lossy TCP bulk transfers + a UDP mix, run
+One randomized 100-host star with lossy TCP bulk transfers + a UDP mix
+(every transfer completes by ~12 virtual seconds; the stoptime covers the
+active phase plus retransmission tails — idle tail rounds add wall, not
+coverage), run
 under four scheduler configurations — serial global, host-steal with 4
 worker threads, the tpu policy single-device, and the tpu policy with the
 path matrices row-sharded over the 8-device virtual CPU mesh — must end in
@@ -72,7 +75,7 @@ def _star_config(n_clients: int = 100, seed: int = 7) -> str:
                 f'starttime="{2 + i % 7}" '
                 f'arguments="client server 80 1024:65536" /></host>')
     return textwrap.dedent(f"""\
-        <shadow stoptime="40">
+        <shadow stoptime="18">
           <topology><![CDATA[{topo}]]></topology>
           <plugin id="tgen" path="python:tgen" />
           <plugin id="echo" path="python:echo" />

@@ -85,12 +85,12 @@ def test_lazy_promotion_first_plugin_event():
 def test_table_parity_tor200():
     """The tor200 gate: 305 hosts, full circuit builds over real TCP,
     table on vs off across serial global, tpu, and --processes 2."""
-    xml = tor_network(200, n_clients=100, n_servers=5, stoptime=30,
+    xml = tor_network(200, n_clients=100, n_servers=5, stoptime=24,
                       stream_spec="512:20480")
-    oracle = state_digest(_run(xml, 30, "off").engine)
-    assert state_digest(_run(xml, 30, "on").engine) == oracle
+    oracle = state_digest(_run(xml, 24, "off").engine)
+    assert state_digest(_run(xml, 24, "on").engine) == oracle
     assert state_digest(
-        _run(xml, 30, "on", policy="tpu").engine) == oracle
+        _run(xml, 24, "on", policy="tpu").engine) == oracle
 
 
 def test_table_parity_star_device_modes():
@@ -264,6 +264,107 @@ def test_genscen_deterministic():
         genscen.config_digest(genscen.tor(1000, seed=43))
 
 
+def test_genscen_rejects_unknown_overrides():
+    """ISSUE 13 satellite: a typo'd override must raise naming the valid
+    set, never silently build the default scenario (the fuzzer's repro
+    files depend on override fidelity)."""
+    with pytest.raises(ValueError, match="stoptme"):
+        genscen.build("star", stoptme=5)
+    with pytest.raises(ValueError, match="valid:"):
+        genscen.build("tor10k", n_clients=5)   # tor takes n_hosts
+    with pytest.raises(ValueError, match="unknown scenario"):
+        genscen.build("nope")
+
+
+def test_genscen_preset_merge():
+    """Preset + overrides MERGE (overrides win): build("star10k",
+    stoptime=5) is the 10k preset at stoptime 5, not the family
+    default."""
+    cfg = genscen.build("star10k", stoptime=5)
+    assert cfg.stop_time_sec == 5
+    assert sum(h.quantity for h in cfg.hosts) == 10_001
+
+
+def test_config_digest_covers_flow_params_and_argv():
+    """ISSUE 13 satellite: two scenarios differing only in a FlowConfig
+    field or only in app argv must not share a digest — it keys the fuzz
+    corpus dedupe."""
+    assert genscen.config_digest(genscen.star(100)) != \
+        genscen.config_digest(genscen.star(100, down_bytes=999))
+    assert genscen.config_digest(genscen.star(100)) != \
+        genscen.config_digest(genscen.star(100, stagger_waves=3))
+    assert genscen.config_digest(genscen.phold(10)) != \
+        genscen.config_digest(genscen.phold(10, msgs_in_flight=2))
+    assert genscen.config_digest(genscen.swarm(50)) != \
+        genscen.config_digest(genscen.swarm(50, seed=2))
+
+
+# ---------------------------------------------------------------------------
+# workload fleet: cdn flash-crowd + swarm many-to-many (ISSUE 13)
+# ---------------------------------------------------------------------------
+
+def test_cdn_generator_shape():
+    cfg = genscen.cdn(500, n_origins=4, stoptime=60)
+    assert sum(h.quantity for h in cfg.hosts) == 504
+    assert genscen.config_digest(cfg) == \
+        genscen.config_digest(genscen.cdn(500, n_origins=4, stoptime=60))
+    # the seeded dest draw spreads clients over every origin
+    table_flows = []
+    from shadow_tpu.scale.genscen import expand_flows
+
+    class _Grp:
+        def __init__(self, hc, first_row, count):
+            self.hc, self.first_row, self.count = hc, first_row, count
+
+        def name_of(self, q):
+            return f"{self.hc.id}{q + 1}"
+    grp = _Grp(cfg.hosts[1], 4, 500)
+    table_flows = expand_flows(None, grp)
+    dests = {f[1][0] for f in table_flows}
+    assert dests == {"origin1", "origin2", "origin3", "origin4"}
+
+
+def test_swarm_generator_no_self_flows():
+    cfg = genscen.swarm(60, pieces=3, stoptime=60)
+    from shadow_tpu.scale.genscen import expand_flows
+
+    class _Grp:
+        def __init__(self, hc, first_row, count):
+            self.hc, self.first_row, self.count = hc, first_row, count
+
+        def name_of(self, q):
+            return f"{self.hc.id}{q + 1}"
+    flows = expand_flows(None, _Grp(cfg.hosts[0], 0, 60))
+    assert len(flows) == 180
+    for _row, down, up, _d, _u, _s in flows:
+        assert down[0] != down[1], "swarm drew a self-flow"
+        assert up == (down[1], down[0])
+
+
+def test_fleet_end_to_end_on_device():
+    """The fleet acceptance shape at test size, three runs doing triple
+    duty: (a) every flow completes with >= 90% of traffic advancing on
+    the device plane (measured from the metrics registry, like the
+    bench rows); (b) the fuzz-found bare-name bug stays fixed (the
+    sub-100-host tor shape has ONE dest named bare ``dest``; cdn runs
+    with ONE origin); (c) nobody materializes."""
+    for cfg in (genscen.tor(60, stoptime=30, stagger_waves=1,
+                            down_bytes=4096, up_bytes=1024),
+                genscen.cdn(60, n_origins=1, stoptime=30,
+                            stagger_waves=1, down_bytes=8192),
+                genscen.swarm(30, pieces=2, stoptime=30,
+                              piece_bytes=8192)):
+        ctrl = _run_scenario(cfg)
+        e = ctrl.engine
+        scrape = e.metrics.scrape()
+        st = e.device_plane.stats()
+        assert st["completed"] == st["circuits"] > 0
+        assert e.host_table.materialized_count == 0
+        fraction = scrape["plane.forwards"] / max(
+            scrape["plane.forwards"] + e.events_executed, 1)
+        assert fraction >= 0.90, fraction
+
+
 def test_genscen_xml_roundtrip():
     """<flow> elements survive config_to_xml -> parse_xml."""
     import dataclasses
@@ -276,6 +377,11 @@ def test_genscen_xml_roundtrip():
     tor_cfg = genscen.tor(400, stoptime=60)
     tor2 = configuration.parse_xml(config_to_xml(tor_cfg))
     assert dataclasses.asdict(tor2) == dataclasses.asdict(tor_cfg)
+    # the seeded-dest fields (cdn/swarm) round-trip too
+    for cfg3 in (genscen.cdn(40, n_origins=2, stoptime=60),
+                 genscen.swarm(20, pieces=2, stoptime=60)):
+        back = configuration.parse_xml(config_to_xml(cfg3))
+        assert dataclasses.asdict(back) == dataclasses.asdict(cfg3)
 
 
 def test_mkscenario_cli(capsys):
@@ -287,6 +393,44 @@ def test_mkscenario_cli(capsys):
     # the Configuration-object generators exist to avoid
     assert mkscenario.main(["star100k", "--xml"]) == 2
     assert mkscenario.main(["nope"]) == 2
+
+
+def test_mkscenario_seed_flag(capsys):
+    """ISSUE 13 satellite: --seed pins the seeded families' structural
+    draws from the CLI (fuzz-discovered scenarios replay by seed)."""
+    from shadow_tpu.tools import mkscenario
+    assert mkscenario.main(["swarm500", "--seed", "5"]) == 0
+    a = json.loads(capsys.readouterr().out)
+    assert mkscenario.main(["swarm500", "--seed", "6"]) == 0
+    b = json.loads(capsys.readouterr().out)
+    assert a["digest"] != b["digest"]
+    # the argparse --seed=N spelling must hit the builder too (a
+    # silently-skipped override would replay a DIFFERENT scenario)
+    assert mkscenario.main(["swarm500", "--seed=5"]) == 0
+    assert json.loads(capsys.readouterr().out)["digest"] == a["digest"]
+    # star has no builder seed: the flag still parses (engine seed only)
+    assert mkscenario.main(["star2k", "--seed", "9"]) == 0
+    capsys.readouterr()
+    assert mkscenario.main(["star2k", "--seed", "oops"]) == 2
+
+
+def test_mkscenario_run_propagates_rc(monkeypatch):
+    """ISSUE 13 satellite: --run must surface the child engine's nonzero
+    exit (a failed fuzz replay cannot report rc 0)."""
+    from shadow_tpu.core.configuration import (Configuration, HostConfig,
+                                               ProcessConfig)
+    from shadow_tpu.scale import genscen as g
+    from shadow_tpu.tools import mkscenario
+    bad = Configuration(stop_time_sec=10)
+    hc = HostConfig(id="c", bandwidth_down_kibps=1024,
+                    bandwidth_up_kibps=1024)
+    hc.processes.append(ProcessConfig(
+        plugin="python:echo", start_time_sec=1.0,
+        arguments="udp client nosuchhost 8000 1 64"))
+    bad.hosts.append(hc)
+    monkeypatch.setattr(g, "build", lambda name, **kw: bad)
+    rc = mkscenario.main(["star2k", "--run", "--log-level", "error"])
+    assert rc == 1
 
 
 def test_phold_generator_runs_eager_shape():

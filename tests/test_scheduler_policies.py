@@ -62,10 +62,10 @@ def test_phold_population_constant(serial_totals):
 
 def test_steal_soak_large_phold():
     """Concurrency soak for the indexed ready-heap + stealing paths: a
-    larger PHOLD (48 hosts, 8 worker threads, many rounds) must match the
+    larger PHOLD (36 hosts, 8 worker threads, many rounds) must match the
     serial run exactly.  Shakes the publish/consume races the small
     equivalence fixtures might never hit."""
-    n = 48
+    n = 36
     xml = textwrap.dedent(f"""\
         <shadow stoptime="6">
           <plugin id="phold" path="python:phold" />

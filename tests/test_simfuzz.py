@@ -1,0 +1,455 @@
+"""simfuzz gates (shadow_tpu/fuzz/, ISSUE 13): seeded spec generation,
+the oracle set, the shrinker, the fault-injection drill (caught ->
+shrunk -> replayed), and the checked-in corpus regression set.
+
+The expensive surfaces run IN-PROCESS (the same run_modes the bounded
+subprocess child calls); the subprocess path itself is gated by a slow
+test and `make fuzz-smoke`."""
+
+import copy
+import json
+import os
+
+import pytest
+
+from shadow_tpu.fuzz import cli as fuzz_cli
+from shadow_tpu.fuzz.gen import (build_config, draw_spec, make_graphml,
+                                 spec_digest)
+from shadow_tpu.fuzz.oracles import check
+from shadow_tpu.fuzz.runner import (InProcessRunner, apply_fault,
+                                    parse_fault, run_modes)
+from shadow_tpu.fuzz.shrink import shrink
+from shadow_tpu.scale.genscen import config_digest
+
+CORPUS = fuzz_cli.CORPUS_DIR
+
+
+# ---------------------------------------------------------------------------
+# spec generation
+# ---------------------------------------------------------------------------
+
+def test_spec_determinism():
+    """Same seed -> byte-identical spec AND identical built config;
+    different seeds differ (the corpus dedupe key)."""
+    a, b = draw_spec(5), draw_spec(5)
+    assert a == b
+    assert spec_digest(a) == spec_digest(b)
+    assert config_digest(build_config(a)) == config_digest(build_config(b))
+    assert spec_digest(draw_spec(5)) != spec_digest(draw_spec(6))
+
+
+def test_spec_is_json_roundtrippable():
+    spec = draw_spec(12)
+    again = json.loads(json.dumps(spec))
+    assert again == spec
+    assert config_digest(build_config(again)) == \
+        config_digest(build_config(spec))
+
+
+def test_spec_digest_covers_flow_params_and_modes():
+    """Two specs differing ONLY in a flow param (or only in the mode
+    matrix) must not share a digest — override fidelity is what makes
+    corpus dedupe and repro replay trustworthy."""
+    spec = draw_spec(11)            # star family
+    assert spec["family"] == "star"
+    tweaked = copy.deepcopy(spec)
+    tweaked["params"]["down_bytes"] += 1024
+    assert spec_digest(tweaked) != spec_digest(spec)
+    fewer = copy.deepcopy(spec)
+    del fewer["modes"][-1]
+    assert spec_digest(fewer) != spec_digest(spec)
+
+
+def test_mode_matrix_axes_all_engaged():
+    """Across a seed range, every acceptance axis appears: device+numpy,
+    K=1+K=8, table on+off, mesh (>1 device), threaded, and every
+    family."""
+    seen_modes, seen_fams = set(), set()
+    axes = {"numpy": False, "k1": False, "k8": False, "table_off": False,
+            "table_on": False, "mesh": False, "threaded": False,
+            "device": False}
+    for seed in range(40):
+        spec = draw_spec(seed)
+        seen_fams.add(spec["family"])
+        for m in spec["modes"]:
+            seen_modes.add(m["name"])
+            if m["device_plane"] == "numpy":
+                axes["numpy"] = True
+            elif int(m.get("tpu_devices", 1)) > 1:
+                axes["mesh"] = True
+            elif m["device_plane"] == "device":
+                axes["device"] = True
+            if m["superwindow_rounds"] == 1:
+                axes["k1"] = True
+            if m["superwindow_rounds"] > 1:
+                axes["k8"] = True
+            if m["host_table"] == "off":
+                axes["table_off"] = True
+            if m["host_table"] == "on":
+                axes["table_on"] = True
+            if m["workers"]:
+                axes["threaded"] = True
+    missing = sorted(k for k, v in axes.items() if not v)
+    assert not missing, f"axes never engaged: {missing} ({seen_modes})"
+    assert seen_fams == {"star", "tor", "cdn", "swarm", "phold", "appmix"}
+
+
+def test_appmix_group_ids_never_collide():
+    """The fuzz-found seed-66 crash stays fixed: a second drawn phold set
+    would claim the same hardcoded 'phold' group id, so suffixed draws
+    remap to echo — no seed may produce duplicate host-group ids."""
+    for seed in list(range(300)) + [66]:
+        spec = draw_spec(seed)
+        ids = [a["id"] for a in spec.get("apps", [])]
+        assert len(ids) == len(set(ids)), (seed, ids)
+
+
+def test_graphml_generation():
+    from shadow_tpu.routing.topology import Topology
+    t = {"vertices": 4, "seed": 9, "max_latency_ms": 50.0,
+         "loss_pct": 1.0}
+    text = make_graphml(t)
+    assert text == make_graphml(dict(t))     # byte-stable
+    topo = Topology.from_graphml(text)
+    assert len(topo.vertices) == 4
+
+
+# ---------------------------------------------------------------------------
+# oracles over synthetic results
+# ---------------------------------------------------------------------------
+
+def _result(**kw):
+    r = {"mode": "base", "repeat_of": None, "events_comparable": True,
+         "skipped": None, "rc": 0, "digest": "d0", "events": 100,
+         "rounds": 10, "supervision": {"recoveries": 0}, "scrape": {},
+         "log_tail": "", "wall_sec": 0.1}
+    r.update(kw)
+    return r
+
+
+def _oracle_names(viols):
+    return sorted({v["oracle"] for v in viols})
+
+
+def test_oracles_clean_pass():
+    spec = {"fault_inject": None}
+    results = [_result(),
+               _result(mode="base-repeat", repeat_of="base"),
+               _result(mode="numpy")]
+    assert check(spec, results) == []
+
+
+def test_oracle_rc_log_fires():
+    spec = {"fault_inject": None}
+    assert _oracle_names(check(spec, [_result(rc=1)])) == ["rc_log"]
+    assert _oracle_names(check(spec, [_result(
+        log_tail="...\nTraceback (most recent call last)\n...")])) \
+        == ["rc_log"]
+    # a skipped mode (mesh under 1 device) is NOT a violation
+    assert check(spec, [_result(skipped="only 1 device")]) == []
+
+
+def test_oracle_stability_and_parity_fire():
+    spec = {"fault_inject": None}
+    drift = [_result(),
+             _result(mode="base-repeat", repeat_of="base", digest="dX")]
+    names = _oracle_names(check(spec, drift))
+    assert "stability" in names and "parity" in names
+    cross = [_result(), _result(mode="numpy", digest="dY")]
+    assert _oracle_names(check(spec, cross)) == ["parity"]
+
+
+def test_oracle_events_conservation():
+    spec = {"fault_inject": None}
+    res = [_result(), _result(mode="k1", events=101)]
+    assert _oracle_names(check(spec, res)) == ["events"]
+    # threaded/procs modes are digest-checked only
+    res = [_result(),
+           _result(mode="threaded", events=101, events_comparable=False)]
+    assert check(spec, res) == []
+
+
+def test_oracle_supervision_and_mesh():
+    spec = {"fault_inject": None}
+    res = [_result(supervision={"recoveries": 2, "details": "x"})]
+    assert _oracle_names(check(spec, res)) == ["supervision"]
+    res = [_result(scrape={"mesh.host_bounces": 3,
+                           "mesh.occupancy_min": 0.5,
+                           "mesh.occupancy_mean": 0.6})]
+    assert _oracle_names(check(spec, res)) == ["mesh"]
+    res = [_result(scrape={"mesh.host_bounces": 0, "mesh.demoted": 1,
+                           "mesh.occupancy_min": 0.5,
+                           "mesh.occupancy_mean": 0.6})]
+    assert _oracle_names(check(spec, res)) == ["mesh"]
+
+
+def test_oracle_completion():
+    spec = {"fault_inject": None}
+    res = [_result(scrape={"plane.circuits": 10, "plane.completed": 10}),
+           _result(mode="numpy",
+                   scrape={"plane.circuits": 10, "plane.completed": 9})]
+    assert _oracle_names(check(spec, res)) == ["completion"]
+
+
+# ---------------------------------------------------------------------------
+# fault harness
+# ---------------------------------------------------------------------------
+
+def test_parse_fault():
+    assert parse_fault("digest-drift:numpy") == \
+        {"kind": "digest-drift", "mode": "numpy"}
+    assert parse_fault("rc-drift") == {"kind": "rc-drift", "mode": "*"}
+    assert parse_fault("engine:native-round:1") == \
+        {"kind": "engine", "spec": "native-round:1"}
+    with pytest.raises(ValueError):
+        parse_fault("nonsense:x")
+    with pytest.raises(ValueError):
+        parse_fault("engine:not-a-real-kind")
+
+
+def test_apply_fault_targets_one_mode():
+    spec = {"fault_inject": {"kind": "digest-drift", "mode": "numpy"}}
+    base = apply_fault(spec, _result())
+    assert base["digest"] == "d0"
+    hit = apply_fault(spec, _result(mode="numpy"))
+    assert hit["digest"].startswith("drift-")
+    spec = {"fault_inject": {"kind": "events-drift", "mode": "*"}}
+    assert apply_fault(spec, _result())["events"] == 101
+
+
+# ---------------------------------------------------------------------------
+# shrinker (stub runner: no engine, pins the algorithm)
+# ---------------------------------------------------------------------------
+
+class _StubRunner:
+    """Fails the parity oracle iff n_clients >= 4 AND the numpy mode is
+    still in the matrix — so the minimal repro is exactly (n_clients=4
+    ... well, the floor the halving can reach with the condition held,
+    with modes reduced to 2)."""
+
+    def __init__(self):
+        self.runs = 0
+
+    def run(self, spec):
+        self.runs += 1
+        bad = spec["params"].get("n_clients", 0) >= 4 and any(
+            m["name"] == "numpy" for m in spec["modes"])
+        out = []
+        for m in spec["modes"]:
+            d = "dX" if (bad and m["name"] == "numpy") else "d0"
+            out.append(_result(mode=m["name"],
+                               repeat_of=m.get("repeat_of"),
+                               digest=d))
+        return out
+
+
+def _stub_spec(n_clients=40):
+    return {"version": 1, "seed": 0, "family": "star",
+            "params": {"n_clients": n_clients, "down_bytes": 65536},
+            "apps": [{"id": "esrv", "quantity": 1, "bw": 1024,
+                      "plugin": "echo", "start": 1.0,
+                      "args": "udp server 8000"}],
+            "topology": {"vertices": 3, "seed": 1,
+                         "max_latency_ms": 10.0, "loss_pct": 0.0},
+            "stoptime": 24, "engine_seed": 1, "fault_inject": None,
+            "modes": [
+                {"name": "base", "device_plane": "device", "workers": 0,
+                 "superwindow_rounds": 8},
+                {"name": "base-repeat", "repeat_of": "base",
+                 "device_plane": "device", "workers": 0,
+                 "superwindow_rounds": 8},
+                {"name": "numpy", "device_plane": "numpy", "workers": 0,
+                 "superwindow_rounds": 8},
+                {"name": "k1", "device_plane": "device", "workers": 0,
+                 "superwindow_rounds": 1},
+            ]}
+
+
+def test_shrink_deterministic_minimal():
+    spec = _stub_spec()
+    runner = _StubRunner()
+    viols = check(spec, runner.run(spec))
+    assert viols and viols[0]["oracle"] == "parity"
+    small1, final1, runs1 = shrink(spec, viols[0], runner, budget=60)
+    small2, final2, runs2 = shrink(spec, viols[0], _StubRunner(),
+                                   budget=60)
+    assert small1 == small2 and runs1 == runs2      # deterministic
+    # minimal: condition boundary reached, structure stripped
+    assert small1["params"]["n_clients"] == 4
+    assert len(small1["modes"]) == 2
+    assert any(m["name"] == "numpy" for m in small1["modes"])
+    assert small1["apps"] == [] and small1["topology"] is None
+    assert small1["stoptime"] == 6
+    assert final1["oracle"] == "parity"
+
+
+def test_shrink_budget_bounds_runs():
+    spec = _stub_spec()
+    runner = _StubRunner()
+    viols = check(spec, runner.run(spec))
+    runner.runs = 0
+    _small, _final, runs = shrink(spec, viols[0], runner, budget=5)
+    assert runs == 5 and runner.runs == 5
+
+
+# ---------------------------------------------------------------------------
+# the real drill: fault-injected violation caught -> shrunk -> replayed
+# ---------------------------------------------------------------------------
+
+def _drill_spec():
+    """A tiny real spec: star, 2 modes, numpy mode drifted.  Sized so a
+    shrink pass is a handful of sub-second runs (down_bytes/stagger
+    already at their floors; only n_clients and stoptime can halve)."""
+    return {"version": 1, "seed": 999, "family": "star",
+            "params": {"n_clients": 4, "down_bytes": 1024,
+                       "stagger_waves": 1, "stagger_step_sec": 1.0},
+            "apps": [], "topology": None, "stoptime": 7,
+            "engine_seed": 7,
+            "fault_inject": {"kind": "digest-drift", "mode": "numpy"},
+            "modes": [
+                {"name": "base", "policy": "global", "workers": 0,
+                 "processes": 0, "device_plane": "numpy",
+                 "superwindow_rounds": 8, "tpu_devices": 1,
+                 "host_table": "on", "dataplane": "python",
+                 "device_plane_sync": False, "events_comparable": True},
+                {"name": "numpy", "policy": "global", "workers": 0,
+                 "processes": 0, "device_plane": "numpy",
+                 "superwindow_rounds": 8, "tpu_devices": 1,
+                 "host_table": "on", "dataplane": "python",
+                 "device_plane_sync": False, "events_comparable": True},
+            ]}
+
+
+def test_fault_drill_caught_shrunk_replayed(tmp_path):
+    """ISSUE 13 acceptance: the injected oracle drift is CAUGHT, shrinks
+    to a minimal repro DETERMINISTICALLY, and --repro replays the SAME
+    violation."""
+    spec = _drill_spec()
+    runner = InProcessRunner()
+    viols = check(spec, runner.run(spec))
+    assert viols, "drifted digest not caught"
+    assert viols[0]["oracle"] == "parity"
+    assert "numpy" in viols[0]["modes"]
+
+    small1, final1, _ = shrink(spec, viols[0], runner, budget=8)
+    small2, _final2, _ = shrink(spec, viols[0], runner, budget=8)
+    assert small1 == small2                         # deterministic
+    assert small1["params"]["n_clients"] == 2       # minimal
+    assert small1["stoptime"] == 6
+
+    path = str(tmp_path / "repro.json")
+    fuzz_cli.write_repro(small1, final1, path)
+    assert fuzz_cli.replay_file(path, runner) == 0  # reproduced
+
+    # and a repro whose drift is REMOVED fails to reproduce (rc 1): the
+    # replay actually re-judges, it does not parrot the file
+    with open(path) as f:
+        blob = json.load(f)
+    blob["spec"]["fault_inject"] = None
+    clean_path = str(tmp_path / "norepro.json")
+    with open(clean_path, "w") as f:
+        json.dump(blob, f)
+    assert fuzz_cli.replay_file(clean_path, runner) == 1
+
+
+def test_engine_fault_passthrough_sets_options():
+    from shadow_tpu.fuzz.runner import _mode_options
+    spec = _drill_spec()
+    spec["fault_inject"] = {"kind": "engine", "spec": "native-round:1"}
+    opts = _mode_options(spec, spec["modes"][0])
+    assert opts.fault_inject == "native-round:1"
+
+
+# ---------------------------------------------------------------------------
+# corpus regression set (tier-1 replays the pinned seeds; the full set
+# rides the slow tier + make fuzz-smoke)
+# ---------------------------------------------------------------------------
+
+def test_corpus_exists_and_is_wellformed():
+    files = fuzz_cli.corpus_files(CORPUS)
+    assert len(files) >= 6, "corpus must cover every family"
+    fams = set()
+    for path in files:
+        with open(path) as f:
+            blob = json.load(f)
+        assert blob["expect"] in ("clean", "violation"), path
+        assert blob["spec"]["version"] == 1, path
+        assert blob["spec_digest"] == spec_digest(blob["spec"]), \
+            f"{path}: stale spec_digest (spec edited without refresh?)"
+        fams.add(blob["spec"]["family"])
+    assert fams >= {"star", "tor", "cdn", "swarm", "phold", "appmix"}
+
+
+@pytest.mark.slow
+def test_corpus_replay_tor_regression():
+    """The fuzz-FOUND bug stays fixed: the sub-100-host tor shape (ONE
+    bare-named dest) runs clean through its whole mode matrix.  (The
+    bug itself is pinned cheaply in tier-1 by
+    test_scale.test_fleet_end_to_end_on_device; this replays the
+    discovering spec end-to-end.)"""
+    rc = fuzz_cli.replay_file(os.path.join(CORPUS, "tor-seed21.json"),
+                              InProcessRunner())
+    assert rc == 0
+
+
+def test_corpus_replay_swarm_regression():
+    """The many-to-many swarm (multiple auto flows per host — the
+    _by_client relaxation) replays clean across its matrix."""
+    rc = fuzz_cli.replay_file(os.path.join(CORPUS, "swarm-seed12.json"),
+                              InProcessRunner())
+    assert rc == 0
+
+
+@pytest.mark.slow
+def test_corpus_replay_full():
+    for path in fuzz_cli.corpus_files(CORPUS):
+        assert fuzz_cli.replay_file(path, InProcessRunner()) == 0, path
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_repro_missing_file():
+    assert fuzz_cli.main(["--repro", "/nonexistent/x.json",
+                          "--in-process"]) == 2
+
+
+def test_cli_spec_only(capsys):
+    assert fuzz_cli.main(["--seeds", "3", "--spec-only"]) == 0
+    lines = [ln for ln in capsys.readouterr().out.splitlines()
+             if ln.startswith("{")]
+    specs = [json.loads(ln) for ln in lines[:-1]]   # last line = summary
+    assert len(specs) == 3
+    assert [s["seed"] for s in specs] == [0, 1, 2]
+
+
+def test_cli_fault_drill_end_to_end(tmp_path, capsys):
+    """The CLI path of the drill: --spec + --fault-inject writes a
+    shrunk repro and exits 1; --repro on it exits 0."""
+    spec = _drill_spec()
+    spec["fault_inject"] = None       # injected via the flag instead
+    spec_path = str(tmp_path / "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+    repro_dir = str(tmp_path / "repros")
+    rc = fuzz_cli.main(["--spec", spec_path, "--in-process",
+                        "--fault-inject", "digest-drift:numpy",
+                        "--repro-dir", repro_dir,
+                        "--shrink-budget", "8"])
+    assert rc == 1
+    out = capsys.readouterr().out.splitlines()
+    summary = json.loads(out[-1])
+    repros = summary["simfuzz"]["repros"]
+    assert len(repros) == 1 and summary["simfuzz"]["violations"] >= 1
+    assert fuzz_cli.main(["--repro", repros[0], "--in-process"]) == 0
+
+
+@pytest.mark.slow
+def test_cli_subprocess_runner():
+    """The production path: one seed through the BOUNDED child process
+    (the bench-multichip pattern), clean."""
+    rc = fuzz_cli.main(["--seeds", "1", "--seed-base", "1",
+                        "--timeout-sec", "240",
+                        "--repro-dir", "/tmp/simfuzz-test-repros"])
+    assert rc == 0

@@ -41,8 +41,21 @@ STAR_KW = dict(n_clients=8, stoptime=120, bulk_bytes=256 * 1024 * 1024,
                device_data=True)
 
 
+# deterministic repeat runs shared via a module cache (the
+# test_meshplane pattern, holding the tier-1 wall): the DEFAULT star
+# run at a given (K, policy, workers, mode, sync, stop) is identical
+# every time — several parity tests use the same K=8 baseline, which
+# used to re-execute per test.  Runs with custom xml or extra options
+# (checkpoint dirs etc.) are never cached.
+_RUN_CACHE: dict = {}
+
+
 def _run(superwindow_rounds, policy="global", workers=0, mode="device",
          sync=False, stop=120, xml=None, **opt_kw):
+    key = (superwindow_rounds, policy, workers, mode, sync, stop)
+    cacheable = xml is None and not opt_kw
+    if cacheable and key in _RUN_CACHE:
+        return _RUN_CACHE[key]
     cfg = configuration.parse_xml(xml or workloads.star_bulk(**STAR_KW))
     cfg.stop_time_sec = stop
     ctrl = Controller(Options(scheduler_policy=policy, workers=workers,
@@ -52,6 +65,8 @@ def _run(superwindow_rounds, policy="global", workers=0, mode="device",
                               superwindow_rounds=superwindow_rounds,
                               **opt_kw), cfg)
     assert ctrl.run() == 0
+    if cacheable:
+        _RUN_CACHE[key] = ctrl
     return ctrl
 
 
@@ -357,7 +372,7 @@ def test_checkpoint_round_stamps_align_k1_vs_k8(tmp_path):
     digests = {}
     for k in (1, 8):
         ckdir = str(tmp_path / f"ck{k}")
-        _run(k, checkpoint_every_rounds=40, checkpoint_dir=ckdir)
+        _run(k, stop=72, checkpoint_every_rounds=40, checkpoint_dir=ckdir)
         snaps = sorted(glob.glob(ckdir + "/checkpoint_r*.ckpt"))
         assert snaps, f"K={k} wrote no snapshots"
         digests[k] = [(p.rsplit("/", 1)[1], load_snapshot(p)["digest"],
@@ -369,11 +384,12 @@ def test_resume_from_superwindow_run(tmp_path):
     """A K=8 run resumed from one of its own mid-run snapshots replays to
     the digest an uninterrupted K=8 run reaches."""
     ckdir = str(tmp_path / "ck")
-    full = _run(8, checkpoint_every_rounds=40, checkpoint_dir=ckdir)
+    full = _run(8, stop=72, checkpoint_every_rounds=40,
+                checkpoint_dir=ckdir)
     want = state_digest(full.engine)
     snaps = sorted(glob.glob(ckdir + "/checkpoint_r*.ckpt"))
     assert len(snaps) >= 1
-    resumed = _run(8, resume_path=snaps[-1])
+    resumed = _run(8, stop=72, resume_path=snaps[-1])
     assert state_digest(resumed.engine) == want
 
 
