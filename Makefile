@@ -9,7 +9,7 @@ PY ?= python
 BASE ?= HEAD
 
 .PHONY: lint lint-diff gen gen-check spec test bench-smoke bench-multichip \
-	fuzz-smoke check native sanitize sanitize-thread
+	fuzz-smoke profile-smoke check native sanitize sanitize-thread
 
 lint: gen-check
 	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu
@@ -72,8 +72,19 @@ fuzz-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m shadow_tpu.fuzz --seeds 8 \
 		--timeout-sec 240 --wall-cap-sec 420
 
-# the lint-adjacent gate set: static analysis + the fuzz smoke
-check: lint fuzz-smoke
+# the cost-observatory smoke (ISSUE 15): a wall-capped QUICK calibration
+# on the virtual CPU mesh (temp output — the checked-in COSTMODEL.json is
+# never touched), then `simprof check` validates the checked-in model's
+# schema/digest and drills the stale-fingerprint + tamper refusal paths.
+# On a box whose fingerprint differs from the model's, check still
+# passes: refusing to load THERE is the contract being verified.
+profile-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m shadow_tpu.prof calibrate --quick \
+		--wall-cap-sec 240 --out /tmp/shadow-profile-smoke.json
+	JAX_PLATFORMS=cpu $(PY) -m shadow_tpu.prof check
+
+# the lint-adjacent gate set: static analysis + the fuzz + profile smokes
+check: lint fuzz-smoke profile-smoke
 
 native:
 	$(MAKE) -C native

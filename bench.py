@@ -382,8 +382,12 @@ def _run_sim(xml, policy: str, workers: int, stop: int, **opt_kw) -> dict:
         out["rounds_per_launch"] = st["rounds_per_launch"]
         out["superwindows"] = st["superwindows"]
     # mesh columns (ISSUE 9): the mesh.* registry source is present iff
-    # the flow table was sharded over >1 device
-    out.update({k: v for k, v in scrape.items() if k.startswith("mesh.")})
+    # the flow table was sharded over >1 device.  prof.* (ISSUE 15):
+    # per-launch predicted-vs-measured attribution + the model-stale
+    # counter — present whenever a device plane ran; zeros/empty when no
+    # cost model loaded on this box.
+    out.update({k: v for k, v in scrape.items()
+                if k.startswith(("mesh.", "prof."))})
     return out
 
 
@@ -971,6 +975,16 @@ def bench_multichip_child(argv) -> int:
         "occupancy_mean": r.get("mesh.occupancy_mean"),
         "occupancy_min": r.get("mesh.occupancy_min"),
         "cut_fraction": r.get("mesh.cut_fraction"),
+        # cost-model columns (ISSUE 15): the exchange decision + its
+        # predicted per-tick cost, the run's total measured launch wall,
+        # and the stale-band counter — populated into the MULTICHIP_r*
+        # slots so real-hardware rows are comparable the day a second
+        # box exists (None = no calibration on this box, heuristic ran)
+        "exchange_mode": r.get("mesh.exchange_mode"),
+        "exchange_source": r.get("mesh.exchange_source"),
+        "predicted_us": r.get("mesh.predicted_us"),
+        "measured_us": (r.get("prof.launch_measured_us") or {}).get("sum"),
+        "model_stale": r.get("prof.model_stale"),
         "flows_completed": plane.get("completed"),
         "plane_calls_per_dispatch": r.get("plane_calls_per_dispatch"),
         "rounds_per_launch": plane.get("rounds_per_launch"),
@@ -1100,6 +1114,45 @@ def bench_fuzz(n_seeds: int = 4, timeout_sec: int = 600) -> dict:
     if s.get("repros"):
         out["fuzz_repros"] = s["repros"]
     return out
+
+
+def bench_prof(timeout_sec: int = 420) -> dict:
+    """ISSUE 15: the cost-observatory columns — a bounded QUICK
+    calibration (subprocess, temp output path: the checked-in per-box
+    COSTMODEL.json is never touched by the bench) plus a ``simprof
+    check`` of the checked-in model when one exists.  Fail-closed: a
+    crashed calibrate or a failing check is a bench-gate failure, never
+    a silent pass."""
+    import tempfile
+
+    from shadow_tpu.prof import model as prof_model
+    from shadow_tpu.prof.calibrate import run_calibration
+    from shadow_tpu.prof.cli import check_model
+
+    out = {}
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="bench-prof-") as td:
+        row = run_calibration(os.path.join(td, "costmodel.json"),
+                              quick=True, wall_cap_sec=timeout_sec - 60)
+    out["prof_calibrate_sec"] = round(time.perf_counter() - t0, 1)
+    out["prof_calibrate_ok"] = bool(row.get("ok"))
+    if not row.get("ok"):
+        out["prof_error"] = row.get("reason") or "calibration failed"
+        if row.get("tail"):
+            out["prof_tail"] = row["tail"][-400:]
+    else:
+        out["prof_collective_points"] = row.get("collective_points")
+        out["prof_truncated"] = row.get("truncated")
+    default = prof_model.default_model_path()
+    if os.path.exists(default):
+        chk = check_model(default)
+        out["prof_check_ok"] = bool(chk["ok"])
+        out["prof_model_loads_here"] = chk.get("loads_on_this_box")
+        if not chk["ok"]:
+            out["prof_error"] = "; ".join(chk["problems"])[:300]
+    else:
+        out["prof_check_ok"] = None    # no checked-in model: nothing to
+    return out                         # check, calibrate leg still gates
 
 
 def bench_smoke() -> int:
@@ -1331,6 +1384,15 @@ def bench_smoke() -> int:
         failures.append("table columns exceed 256 bytes/host")
     if peak is None or peak > 4096:
         failures.append(f"peak_rss_mb={peak}: star2k must fit in 4 GiB")
+    # the trend ledger (ISSUE 15): the smoke's machinery row and its
+    # multichip leg survive the run (append happens pass or fail — the
+    # trajectory must record regressions, not only good rounds)
+    from shadow_tpu.prof.ledger import append_bench_rows
+    hist = {"bench_smoke": out}
+    if mc.get("ok") and not mc.get("skipped"):
+        hist["multichip"] = {k: v for k, v in mc.items()
+                             if k != "metrics_path"}
+    out["history_appended"] = append_bench_rows(hist)
     print(json.dumps({"bench_smoke": out,
                       "pass": not failures,
                       "failures": failures}), flush=True)
@@ -1354,6 +1416,11 @@ def main() -> None:
         if mp:
             import shutil
             shutil.rmtree(os.path.dirname(mp), ignore_errors=True)
+        if row.get("ok") and not row.get("skipped"):
+            # the trend ledger (ISSUE 15): every sharded row survives
+            # the run that produced it
+            from shadow_tpu.prof.ledger import append_bench_rows
+            append_bench_rows({"multichip": row})
         sys.exit(0 if (row.get("ok") or row.get("skipped")) else 1)
     if "--smoke" in sys.argv:
         sys.exit(bench_smoke())
@@ -1367,6 +1434,13 @@ def main() -> None:
     sims = bench_full_sims()
     sims.update(bench_scale())
     fuzz_cols = bench_fuzz()
+    prof_cols = bench_prof()
+    # model-stale evidence across every flagship/device row this round
+    # (prof.model_stale is 0 when no model loaded — the gate is on
+    # DRIFT, absence is recorded in prof_model_loads_here)
+    prof_cols["prof_model_stale"] = sum(
+        r.get("prof.model_stale", 0) for r in sims.values()
+        if isinstance(r, dict))
     topo = build_topology(256)
     cpu_rate = bench_cpu_scalar(topo, 200_000)
     dev_rate = bench_device(topo, batch=1 << 20, iters=8)
@@ -1449,6 +1523,7 @@ def main() -> None:
         "simgen_sec": simgen_sec,
         "cubic_parity_pass": cubic_parity_pass,
         **fuzz_cols,
+        **prof_cols,
         "kernel_transfer_inclusive_mpkts": round(dev_rate / 1e6, 3),
         "kernel_device_compute_mpkts": round(dev_compute / 1e6, 2),
         "own_scalar_python_mpkts": round(cpu_rate / 1e6, 4),
@@ -1558,11 +1633,26 @@ def main() -> None:
         "fuzz_sec": fuzz_cols.get("fuzz_sec"),
         "scen_cdn_pass": sims.get("scen_cdn_pass"),
         "scen_swarm_pass": sims.get("scen_swarm_pass"),
+        # cost observatory (ISSUE 15): the bounded quick-calibrate leg
+        # must succeed and no run may accumulate model-stale evidence
+        "prof_calibrate_sec": prof_cols.get("prof_calibrate_sec"),
+        "prof_model_stale": prof_cols.get("prof_model_stale"),
         "gates_enforced": True,
     }
     blob = json.dumps(summary)
     assert len(blob) < 1500, f"summary grew past the driver tail: {len(blob)}"
     print(blob, flush=True)
+    # the trend ledger (ISSUE 15): every flagship/sharded row plus the
+    # compact summary survives this run in BENCH_HISTORY.jsonl, keyed by
+    # box + git sha — trace_report --trend renders the trajectory
+    from shadow_tpu.prof.ledger import append_bench_rows
+    hist_rows = {k: sims[k] for k in (
+        "tor200_serial", "tor200_device_plane",
+        "tor10k_device_plane_long", "tor10k_device_plane_native_long",
+        "scale_star10k", "scale_star100k", "scale_tor100k",
+        "scen_cdn", "scen_swarm") if isinstance(sims.get(k), dict)}
+    hist_rows["headline"] = summary
+    append_bench_rows(hist_rows)
     # The gate GATES (VERDICT r4 weak #3: it used to record and exit 0):
     # the flagship policy must not lose to its own fallback engine, and the
     # device plane must not lose to the serial Python plane on the same
@@ -1601,6 +1691,20 @@ def main() -> None:
     for key in ("scen_cdn_pass", "scen_swarm_pass"):
         if sims.get(key) is False:
             failures.append(f"{key} failed: {sims.get(key[:-5])}")
+    # ISSUE 15 (fail-closed): the calibrate leg must produce a model and
+    # the checked-in model must pass simprof check; accumulated
+    # model-stale evidence means the scheduler ran on drifted numbers
+    if not prof_cols.get("prof_calibrate_ok"):
+        failures.append("simprof quick-calibrate leg failed: "
+                        f"{prof_cols.get('prof_error')}")
+    if prof_cols.get("prof_check_ok") is False:
+        failures.append("checked-in COSTMODEL.json failed simprof check: "
+                        f"{prof_cols.get('prof_error')}")
+    if prof_cols.get("prof_model_stale"):
+        failures.append(
+            f"prof.model_stale={prof_cols['prof_model_stale']}: "
+            "measured launch costs left the model's band — re-run "
+            "simprof calibrate before trusting the exchange schedule")
     if failures:
         print("BENCH GATE FAILURES: " + "; ".join(failures),
               file=sys.stderr, flush=True)

@@ -92,6 +92,18 @@ class Options:
     device_plane_sync: bool = False      # block on the dispatch at launch
                                          # (serial oracle; digests identical
                                          # to the pipelined default)
+    exchange_mode: str = "auto"          # mesh cross-shard exchange kernel:
+                                         # auto = measured cost model when
+                                         # calibrated (simprof), else the
+                                         # PR-9 heuristic; fused/ppermute
+                                         # force one identical-result
+                                         # kernel (digest parity pinned)
+    cost_model: str = ""                 # --cost-model: per-box measured
+                                         # cost model path (simprof
+                                         # calibrate); "" = $SHADOW_COSTMODEL
+                                         # or the repo-root COSTMODEL.json;
+                                         # refuses a fingerprint mismatch
+                                         # and falls back to heuristics
     # Checkpointing (new capability; absent in the reference — SURVEY.md §5)
     checkpoint_interval_sec: int = 0     # --checkpoint-interval (0 = off)
     checkpoint_every_rounds: int = 0     # --checkpoint-every N rounds (0 = off)
@@ -241,6 +253,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "instead of overlapping it with the round's host "
                         "work (the serial oracle: digests are identical to "
                         "the pipelined default, only wall time differs)")
+    p.add_argument("--exchange-mode", choices=("auto", "fused", "ppermute"),
+                   default="auto", dest="exchange_mode",
+                   help="mesh cross-shard exchange kernel: 'auto' decides "
+                        "from the measured cost model (simprof calibrate; "
+                        "heuristic when uncalibrated), 'fused'/'ppermute' "
+                        "force one of the identical-result kernels "
+                        "(scheduling only — digests never change)")
+    p.add_argument("--cost-model", default="", dest="cost_model",
+                   help="path to the per-box measured cost model "
+                        "(simprof calibrate); default: $SHADOW_COSTMODEL "
+                        "or the repo-root COSTMODEL.json; a fingerprint "
+                        "mismatch refuses loudly and heuristics run")
     p.add_argument("--device-plane-batch-steps", type=int, default=8,
                    dest="device_plane_batch_steps",
                    help="accumulate at least N plane steps per kernel "

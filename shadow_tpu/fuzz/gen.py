@@ -81,7 +81,8 @@ def _mode(name: str, **kw) -> Dict:
     m = {"name": name, "policy": "global", "workers": 0, "processes": 0,
          "device_plane": "device", "superwindow_rounds": 8,
          "tpu_devices": 1, "host_table": "on", "dataplane": "python",
-         "device_plane_sync": False, "events_comparable": True}
+         "device_plane_sync": False, "exchange_mode": "auto",
+         "events_comparable": True}
     m.update(kw)
     return m
 
@@ -89,13 +90,20 @@ def _mode(name: str, **kw) -> Dict:
 def flow_modes(rng) -> List[Dict]:
     """The flow-family matrix: device/numpy twins, K=1-vs-K=8, repeat-run
     stability, and the sharded mesh (skipped gracefully under <2
-    devices)."""
+    devices) — with the ``--exchange-mode`` axis forced each way on the
+    SAME drawn mesh size (ISSUE 15), so the cross-mode digest-parity
+    oracle covers the cost-model-driven scheduler decision for free:
+    whatever auto picks, the fused and multi-leg-ppermute kernels must
+    land the identical digest."""
+    d = int(rng.integers(2, 5))
     modes = [
         _mode("base"),
         _mode("base-repeat", repeat_of="base"),
         _mode("numpy", device_plane="numpy"),
         _mode("k1", superwindow_rounds=1),
-        _mode("mesh", tpu_devices=int(rng.integers(2, 5))),
+        _mode("mesh", tpu_devices=d),
+        _mode("mesh-fused", tpu_devices=d, exchange_mode="fused"),
+        _mode("mesh-ppermute", tpu_devices=d, exchange_mode="ppermute"),
     ]
     if rng.integers(0, 2):
         modes.append(_mode("sync", device_plane_sync=True))

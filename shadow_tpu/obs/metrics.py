@@ -82,7 +82,13 @@ class Histogram:
     (bucket key k counts observations in [2^k, 2^(k+1)); everything below
     1 — sub-unit fractions, zero, negatives — lands in bucket key -1, so
     pick units that put interesting values above 1, e.g. microseconds).
-    Enough to read latency tails without per-observation storage."""
+    Enough to read latency tails without per-observation storage.
+
+    The final snapshot additionally estimates p50/p95/p99 (ISSUE 15):
+    linear interpolation inside the covering power-of-two bucket,
+    clamped to the observed [min, max] — quantization error is bounded
+    by the bucket width (a factor of two), which is exactly the
+    resolution the tails are read at."""
 
     __slots__ = ("name", "count", "total", "min", "max", "buckets",
                  "_lock")
@@ -107,6 +113,22 @@ class Histogram:
             k = -1 if v < 1 else int(v).bit_length() - 1
             self.buckets[k] = self.buckets.get(k, 0) + 1
 
+    def _quantile_locked(self, q: float) -> float:
+        """Estimate quantile ``q`` from the power-of-two buckets (lock
+        held): walk the cumulative counts to the covering bucket,
+        interpolate linearly inside it, clamp to observed [min, max]."""
+        target = q * self.count
+        run = 0
+        for k, n in sorted(self.buckets.items()):
+            run += n
+            if run >= target:
+                lo = 0.0 if k < 0 else float(2 ** k)
+                hi = 1.0 if k < 0 else float(2 ** (k + 1))
+                frac = 1.0 - (run - target) / n
+                v = lo + (hi - lo) * frac
+                return min(max(v, self.min), self.max)
+        return self.max
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             if not self.count:
@@ -114,6 +136,12 @@ class Histogram:
             return {"count": self.count, "sum": self.total,
                     "min": self.min, "max": self.max,
                     "mean": self.total / self.count,
+                    # percentile summaries (ISSUE 15): the tail columns
+                    # trace_report --metrics prints; schema pinned by
+                    # tests/test_simprof.py
+                    "p50": round(self._quantile_locked(0.50), 3),
+                    "p95": round(self._quantile_locked(0.95), 3),
+                    "p99": round(self._quantile_locked(0.99), 3),
                     "buckets": {str(k): v
                                 for k, v in sorted(self.buckets.items())}}
 

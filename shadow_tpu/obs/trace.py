@@ -114,15 +114,20 @@ class Tracer:
             ring.append(ev)
 
     def complete(self, name: str, cat: str, t0: float, t1: float,
-                 sim_ns: Optional[int], args: Optional[dict]) -> None:
-        """Record a finished span [t0, t1] (perf_counter seconds)."""
+                 sim_ns: Optional[int], args: Optional[dict],
+                 tid: Optional[str] = None) -> None:
+        """Record a finished span [t0, t1] (perf_counter seconds).
+        ``tid`` overrides the track — the device plane's sim-correlated
+        ``device-sim`` track (obs/profiler.py) gets its own lane in the
+        merged Chrome trace instead of interleaving with the engine
+        thread's round spans."""
         if sim_ns is None:
             sim_ns = self._sim_now()
         self._record({"name": name, "cat": cat, "ph": "X",
                       "ts": round((t0 - self._t0) * 1e6, 3),
                       "dur": round((t1 - t0) * 1e6, 3),
                       "pid": self.shard_id,
-                      "tid": threading.current_thread().name,
+                      "tid": tid or threading.current_thread().name,
                       "args": dict(args, sim_ns=sim_ns) if args
                       else {"sim_ns": sim_ns}})
 

@@ -306,6 +306,17 @@ class DeviceTrafficPlane:
                 "clients; run at most one per host (flows are keyed by "
                 "host name)")
         self._meshinfo = None        # set by attach_mesh when sharded
+        # the measured per-box cost model (ISSUE 15, shadow_tpu/prof/):
+        # consulted by attach_mesh for the exchange-mode decision and by
+        # advance() for per-launch predicted cost.  A missing or
+        # fingerprint-mismatched COSTMODEL.json degrades (loudly) to
+        # None — the pre-model heuristics — never a crash.
+        if mode == "device":
+            from ..prof.model import load_for_engine
+            self._costmodel, self._costmodel_status = load_for_engine(
+                engine.options)
+        else:
+            self._costmodel, self._costmodel_status = None, "off"
         self._build_layout(engine)
         # multi-chip: shard the flow table over a device mesh (same
         # --tpu-devices axis the scheduler policy scales on).  Exact — see
@@ -349,6 +360,8 @@ class DeviceTrafficPlane:
         self.device_calls = 0
         self.pipeline_overlap_ns = 0
         self._launch_wall = 0
+        self._launch_pred = None     # (per_step_us, fixed_us) model
+        self._launch_base = 0        # kernel t at launch (steps = t_stop-)
         # --device-plane-sync: block on the dispatch at launch time (the
         # serial oracle the pipelined run is digest-compared against)
         self._sync = bool(getattr(engine.options, "device_plane_sync",
@@ -943,6 +956,35 @@ class DeviceTrafficPlane:
             self._flush_handle = _PoisonedFlush(self._flush_handle,
                                                 hang=self._fault_hang)
             self._fault_dispatch = 0
+        # per-launch predicted device cost (ISSUE 15): per-tick step
+        # kernel + exchange collectives, plus the fixed transfer, from
+        # the measured model.  Stored as (per-step, fixed) — a
+        # superwindow kernel may HALT at an earlier negotiated boundary
+        # on a completion, so consume() scales the per-step half by the
+        # steps actually reached (flush t_stop) before judging the
+        # band; predicting the full plan span would flag early-halted
+        # windows as model-stale on a perfectly calibrated model.
+        self._launch_pred = None       # (per_step_us, fixed_us)
+        # the kernel's carried t runs from this base to the reached
+        # boundary: steps executed = t_stop - base (idle-banked ticks
+        # are a re-base jump, not loop iterations, so they don't count)
+        self._launch_base = int(targets[-1]) - int(n)
+        if self._costmodel is not None and self.mode == "device":
+            if self._shard is not None:
+                kernel_flows = len(self._shard["src"])
+                ex_us = self._meshinfo.predicted_us
+            else:
+                kernel_flows = self.n_flows
+                ex_us = 0.0
+            # only predict INSIDE the model's measured range: a table
+            # far below the smallest calibrated flow count would be
+            # judged by pure extrapolation and flood prof.model_stale
+            # with false positives on toy runs
+            if kernel_flows * 2 >= self._costmodel.min_flows:
+                self._launch_pred = (
+                    self._costmodel.step_us(kernel_flows)
+                    + max(ex_us, 0.0),
+                    self._costmodel.transfer_us())
         self._launch_wall = _wt.perf_counter_ns()
         self.host_ns += self._launch_wall - t0
         self._profiler.on_dispatch(t0, self._launch_wall, int(n),
@@ -988,6 +1030,29 @@ class DeviceTrafficPlane:
         from ..ops.torcells_device import parse_flush
         (forwards, delivered_sum, t_stop, done_chains, done_steps, node_idx,
          node_delta) = parse_flush(flush, self.n_chains, self.n_nodes)
+        # launch attribution (ISSUE 15): predicted-vs-measured per-launch
+        # gauges, the model-stale band check, and the sim-correlated
+        # device track span — one call per collect, ~free when no model
+        # is loaded and observability is off.  Placed AFTER parse_flush
+        # so the prediction covers the steps the kernel actually REACHED
+        # (t_stop): a superwindow halting early on a completion is
+        # judged on its real span, never flagged stale for not running
+        # the merged rounds it skipped.  Device mode only — the numpy
+        # twin's host-side walls must not pollute the launch gauges.
+        if self.mode == "device":
+            steps_done = max(int(t_stop) - self._launch_base, 0)
+            pred_us = None
+            if self._launch_pred is not None:
+                per_step, fixed = self._launch_pred
+                pred_us = steps_done * per_step + fixed
+            self._profiler.on_window(
+                self._launch_wall, t1, t1 - t0, steps_done,
+                self.granule, pred_us,
+                self._costmodel.band if self._costmodel is not None
+                else 0.0,
+                engine.scheduler.window_start,
+                self._meshinfo.exchange_mode if self._meshinfo is not None
+                else "single")
         if self._meshinfo is not None:
             # mesh flush: ONE trailing slot carries the window's
             # cross-shard cell count (zero extra device reads; a
@@ -1164,6 +1229,11 @@ class DeviceTrafficPlane:
         self._shard = None
         self._sharded_step = None
         self._flush_step = None
+        # predictions are calibrated for the DEVICE kernels; the numpy
+        # twin must not be judged (or scheduled) by them
+        self._costmodel = None
+        self._costmodel_status = "demoted"
+        self._launch_pred = None
         self._flow_args_cached = None
         self._zero_inject_cached = None
         from ..ops.torcells_device import (RING_DTYPE,

@@ -50,7 +50,7 @@ cross-shard cell count so the host learns it with zero extra reads.
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional, Tuple
 
 import numpy as np
 
@@ -172,8 +172,60 @@ def build_exchange(succ_global: np.ndarray, pad: int,
                             a2a_src.reshape(-1), a2a_dst.reshape(-1))
 
 
+def choose_exchange_mode(schedule: ExchangeSchedule, model=None,
+                         override: str = "auto"
+                         ) -> Tuple[str, float, str]:
+    """Pick the exchange execution mode for a schedule: ``fused`` (one
+    all_to_all over the superposed [D, pair_width] slots), ``ppermute``
+    (one collective per rotation leg — lone for a single leg, multi-leg
+    otherwise), or ``none`` (no cross-shard edges).
+
+    Returns ``(mode, predicted_tick_us, source)``.  ``source`` says what
+    decided: ``static`` (no cross edges), ``forced`` (the
+    ``--exchange-mode`` CLI override), ``model`` (the measured per-box
+    cost model, ISSUE 15 — cheapest predicted per-tick collective cost
+    wins), or ``heuristic`` (no calibration on this box: today's PR-9
+    rule, fused when multi-leg, lone ppermute otherwise — exactly the
+    pre-model behavior, so an uncalibrated box changes nothing).
+    ``predicted_tick_us`` is the model's per-tick exchange cost for the
+    CHOSEN mode (0.0 without a model) — recorded as
+    ``mesh.predicted_us`` so the decision is auditable in every scrape.
+
+    Every candidate delivers the identical (src value -> dst slot)
+    pairs, so the choice can only ever change WHICH bit-identical kernel
+    runs: digest parity across modes is by construction, and pinned by
+    tests/test_simprof.py with the override forced each way."""
+    d = schedule.n_shards
+
+    def predicted(mode: str) -> float:
+        if model is None:
+            return 0.0
+        return model.exchange_tick_us(d, mode, schedule.pair_width,
+                                      schedule.widths)
+
+    if schedule.legs == 0:
+        # cross-free table: no exchange collective, but the mesh kernel
+        # still issues the per-tick stats psum — predict THAT, so the
+        # audit value (and the window predictor fed from it) is the
+        # cost actually paid, not a flattering zero
+        return "none", round(predicted("none"), 2), "static"
+
+    if override in ("fused", "ppermute"):
+        return override, round(predicted(override), 2), "forced"
+    heuristic = "fused" if schedule.legs > 1 else "ppermute"
+    if model is None:
+        return heuristic, 0.0, "heuristic"
+    cost_f, cost_p = predicted("fused"), predicted("ppermute")
+    if cost_f == cost_p:
+        mode = heuristic            # measured tie: keep the known shape
+    else:
+        mode = "fused" if cost_f < cost_p else "ppermute"
+    return mode, round(min(cost_f, cost_p), 2), "model"
+
+
 def make_mesh_span_raw(mesh, axis: str, ring_len: int, pad: int,
-                       schedule: ExchangeSchedule):
+                       schedule: ExchangeSchedule,
+                       mode: Optional[str] = None):
     """The shard_map-ed SUPERWINDOW step with device-side cross-shard
     exchange.  Same argument list as the engine-facing flush kernel minus
     the flush packing; the arrival ring and arr_lat are SHARD-LOCAL
@@ -185,24 +237,36 @@ def make_mesh_span_raw(mesh, axis: str, ring_len: int, pad: int,
 
     n_shards = schedule.n_shards
     # exchange tables are closed over as constants (the per-shard slice
-    # is taken with dynamic_slice on the shard id).  Execution strategy:
-    # collective LAUNCHES dominate the per-tick wall (on every backend),
-    # so multi-leg schedules run as ONE fused all_to_all over the
-    # superposed [D, pair_width] slot layout; a single-leg schedule
-    # keeps the bytes-minimal lone ppermute; a cross-free table pays no
-    # exchange at all.
-    if schedule.legs > 1:
+    # is taken with dynamic_slice on the shard id).  Execution strategy
+    # (``mode``; decided by choose_exchange_mode — measured cost model
+    # when this box is calibrated, the PR-9 heuristic otherwise):
+    # "fused" runs every leg as ONE all_to_all over the superposed
+    # [D, pair_width] slot layout (one launch per tick — launches, not
+    # bytes, dominate the per-tick wall at these widths); "ppermute"
+    # runs one rotation collective PER leg (bytes-minimal: lone for a
+    # single-leg schedule, multi-leg when the model says L launches
+    # beat one wide all_to_all); a cross-free table pays no exchange.
+    # Every mode delivers the identical (src value -> dst slot) pairs —
+    # each slot has exactly one writer — so the choice is between
+    # bit-identical kernels and digest parity holds by construction.
+    if mode is None:
+        mode = "fused" if schedule.legs > 1 else (
+            "ppermute" if schedule.legs == 1 else "none")
+    if schedule.legs == 0:
+        mode = "none"
+    assert mode in ("fused", "ppermute", "none"), mode
+    if mode == "fused":
         ex_mode = "a2a"
         pw = schedule.pair_width
         a2a_src_tbl = jnp.asarray(schedule.a2a_src)
         a2a_dst_tbl = jnp.asarray(schedule.a2a_dst)
         chunk = n_shards * pw
-    elif schedule.legs == 1:
+    elif mode == "ppermute":
         ex_mode = "ppermute"
-        leg_r = schedule.offsets[0]
-        leg_w = schedule.widths[0]
-        leg_snd_tbl = jnp.asarray(schedule.send_src[0])
-        leg_rcv_tbl = jnp.asarray(schedule.recv_dst[0])
+        leg_tbls = [(schedule.offsets[k], schedule.widths[k],
+                     jnp.asarray(schedule.send_src[k]),
+                     jnp.asarray(schedule.recv_dst[k]))
+                    for k in range(schedule.legs)]
     else:
         ex_mode = "none"
 
@@ -250,11 +314,17 @@ def make_mesh_span_raw(mesh, axis: str, ring_len: int, pad: int,
                                                (shard * chunk,), (chunk,))
                 my_dst_slots = jnp.where(my_dst >= 0, my_dst, oob)
             elif ex_mode == "ppermute":
-                my_src = jax.lax.dynamic_slice(leg_snd_tbl,
-                                               (shard * leg_w,), (leg_w,))
-                my_dst = jax.lax.dynamic_slice(leg_rcv_tbl,
-                                               (shard * leg_w,), (leg_w,))
-                my_dst_slots = jnp.where(my_dst >= 0, my_dst, oob)
+                # per-leg shard-local slices, hoisted out of the tick
+                # loop (one (src rows, dst slots) pair per rotation leg)
+                my_legs = []
+                for leg_r, leg_w, snd_tbl, rcv_tbl in leg_tbls:
+                    l_src = jax.lax.dynamic_slice(
+                        snd_tbl, (shard * leg_w,), (leg_w,))
+                    l_dst = jax.lax.dynamic_slice(
+                        rcv_tbl, (shard * leg_w,), (leg_w,))
+                    my_legs.append(
+                        (leg_r, l_src, l_dst,
+                         jnp.where(l_dst >= 0, l_dst, oob)))
 
             def body(state):
                 (t, idx, halt, span_done, queued, ring, tokens, delivered,
@@ -295,16 +365,19 @@ def make_mesh_span_raw(mesh, axis: str, ring_len: int, pad: int,
                     cross = cross + jnp.sum(
                         jnp.where(my_dst >= 0, got, jnp.int64(0)))
                 elif ex_mode == "ppermute":
-                    vals = jnp.where(my_src >= 0,
-                                     fwd[jnp.clip(my_src, 0, fp - 1)],
-                                     jnp.int64(0))
-                    got = jax.lax.ppermute(
-                        vals, axis,
-                        perm=[(s, (s + leg_r) % n_shards)
-                              for s in range(n_shards)])
-                    v = v.at[my_dst_slots].add(got, mode="drop")
-                    cross = cross + jnp.sum(
-                        jnp.where(my_dst >= 0, got, jnp.int64(0)))
+                    # one rotation collective per leg (L launches/tick;
+                    # the cost model decided L beat one fused a2a here)
+                    for leg_r, l_src, l_dst, l_dst_slots in my_legs:
+                        vals = jnp.where(l_src >= 0,
+                                         fwd[jnp.clip(l_src, 0, fp - 1)],
+                                         jnp.int64(0))
+                        got = jax.lax.ppermute(
+                            vals, axis,
+                            perm=[(s, (s + leg_r) % n_shards)
+                                  for s in range(n_shards)])
+                        v = v.at[l_dst_slots].add(got, mode="drop")
+                        cross = cross + jnp.sum(
+                            jnp.where(l_dst >= 0, got, jnp.int64(0)))
                 ring = ring.at[jnp.mod(t, ring_len)].set(
                     v.astype(ring.dtype))
                 # fused stats reduction: forwards + the global completion
@@ -355,16 +428,17 @@ def make_mesh_span_raw(mesh, axis: str, ring_len: int, pad: int,
 
 def make_mesh_span_flush(mesh, axis: str, ring_len: int, layout: dict,
                          last_flow_pad: np.ndarray, node_src: np.ndarray,
-                         n_nodes: int):
+                         n_nodes: int, mode: Optional[str] = None):
     """Mesh superwindow step + packed flush in ONE dispatch: the engine's
     sharded kernel (DeviceTrafficPlane._sharded_step contract — same
     argument list as the PR-7 kernel, so advance()/warmup() are layout-
-    agnostic).  The flush buffer is the standard packed layout with ONE
-    trailing slot appended: [flush_len] = cross-shard cells exchanged this
-    window (consume() folds it into the mesh metrics with no extra device
-    read)."""
+    agnostic).  ``mode`` picks the exchange execution strategy
+    (choose_exchange_mode; None = the legacy heuristic).  The flush
+    buffer is the standard packed layout with ONE trailing slot appended:
+    [flush_len] = cross-shard cells exchanged this window (consume()
+    folds it into the mesh metrics with no extra device read)."""
     raw = make_mesh_span_raw(mesh, axis, ring_len, layout["pad"],
-                             layout["exchange"])
+                             layout["exchange"], mode=mode)
     lf = np.asarray(last_flow_pad, dtype=np.int64)
     nsrc = np.asarray(node_src, dtype=np.int64)
 

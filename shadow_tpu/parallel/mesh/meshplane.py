@@ -32,7 +32,7 @@ import numpy as np
 
 from ...core.logger import get_logger
 from . import device_mesh
-from .exchange import make_mesh_span_flush
+from .exchange import choose_exchange_mode, make_mesh_span_flush
 from .partition import build_mesh_layout, chain_partition
 
 
@@ -41,17 +41,28 @@ class MeshPlaneInfo:
 
     __slots__ = ("n_devices", "legs", "cross_edges", "cut_fraction",
                  "occupancy", "cross_shard_cells", "host_bounces",
-                 "flush_base")
+                 "flush_base", "exchange_mode", "predicted_us",
+                 "exchange_source", "model_status")
 
     def __init__(self, n_devices: int, legs: int, cross_edges: int,
                  cut_fraction: float, occupancy: np.ndarray,
-                 flush_base: int):
+                 flush_base: int, exchange_mode: str = "none",
+                 predicted_us: float = 0.0,
+                 exchange_source: str = "heuristic",
+                 model_status: str = "absent"):
         self.n_devices = n_devices
         self.legs = legs
         self.cross_edges = cross_edges
         self.cut_fraction = cut_fraction
         self.occupancy = occupancy
         self.flush_base = flush_base
+        # the exchange scheduling decision and its audit trail (ISSUE 15):
+        # which identical-result kernel runs, its model-predicted per-tick
+        # collective cost, and WHAT decided (model/heuristic/forced)
+        self.exchange_mode = exchange_mode
+        self.predicted_us = predicted_us
+        self.exchange_source = exchange_source
+        self.model_status = model_status
         self.cross_shard_cells = 0
         # dispatch windows whose cross-shard forwards were delivered
         # HOST-side.  No steady-state path does — the acceptance gate
@@ -72,6 +83,14 @@ class MeshPlaneInfo:
             "mesh.occupancy_min": round(float(self.occupancy.min()), 4),
             "mesh.occupancy_mean": round(float(self.occupancy.mean()), 4),
             "mesh.demoted": int(plane.demoted),
+            # the exchange decision (ISSUE 15): chosen kernel, the cost
+            # model's predicted per-tick collective cost (0.0 when no
+            # calibration loaded), and the decision source — so every
+            # scrape says WHICH kernel ran and WHY
+            "mesh.exchange_mode": self.exchange_mode,
+            "mesh.predicted_us": self.predicted_us,
+            "mesh.exchange_source": self.exchange_source,
+            "mesh.cost_model": self.model_status,
         }
 
 
@@ -92,15 +111,26 @@ def attach_mesh(plane, n_dev: int) -> None:
     sched = lay["exchange"]
     plane._mesh = mesh
     plane._shard = lay
+    # the exchange scheduling decision (ISSUE 15): the measured per-box
+    # cost model picks fused-all_to_all vs (multi-leg) ppermute from
+    # data; --exchange-mode forces it; an uncalibrated box falls back to
+    # the PR-9 heuristic.  Identical-result kernels, so digest parity
+    # across choices is by construction (pinned by tests/test_simprof.py)
+    override = getattr(plane.engine.options, "exchange_mode", "auto")
+    ex_mode, predicted_us, source = choose_exchange_mode(
+        sched, plane._costmodel, override)
     plane._sharded_step = make_mesh_span_flush(
         mesh, "flows", plane.ring_len, lay,
-        lay["inv"][plane.last_flow], lay["node_src"], plane.n_nodes)
+        lay["inv"][plane.last_flow], lay["node_src"], plane.n_nodes,
+        mode=ex_mode)
     edges_total = max(int(np.count_nonzero(plane.flow_succ >= 0)), 1)
     occupancy = lay["shard_sizes"].astype(np.float64) / max(lay["pad"], 1)
     plane._meshinfo = MeshPlaneInfo(
         n_dev, sched.legs, sched.cross_edges,
         cross_hops / edges_total, occupancy,
-        flush_len(plane.n_chains, plane.n_nodes))
+        flush_len(plane.n_chains, plane.n_nodes),
+        exchange_mode=ex_mode, predicted_us=predicted_us,
+        exchange_source=source, model_status=plane._costmodel_status)
     plane.engine.metrics.source(
         "mesh", lambda: plane._meshinfo.metrics(plane))
     get_logger().message(
@@ -108,4 +138,5 @@ def attach_mesh(plane, n_dev: int) -> None:
         f"mesh plane: flow table sharded over {n_dev} devices "
         f"(pad {lay['pad']} flows/shard, {lay['h_pad']} nodes/shard, "
         f"{sched.cross_edges}/{edges_total} cross-shard hops over "
-        f"{sched.legs} exchange legs)")
+        f"{sched.legs} exchange legs; exchange={ex_mode} "
+        f"[{source}], predicted {predicted_us} us/tick)")

@@ -29,9 +29,17 @@ side with delta and ratio, keys present on one side only called out, so a
 before/after pair of ``--metrics`` files turns into the regression table a
 reviewer reads directly.
 
+``--trend`` renders the persistent perf-trend ledger
+(``BENCH_HISTORY.jsonl``, ISSUE 15 / shadow_tpu/prof/ledger.py): rows
+grouped by family, every numeric column as a sparkline over the recorded
+rounds plus latest-vs-best-known delta, and regression flags for the
+columns whose good direction is known — the next perf regression is
+caught by rereading THIS report, not CHANGES.md.
+
 Usage: python -m shadow_tpu.tools.trace_report <trace.json> [--pretty]
        python -m shadow_tpu.tools.trace_report --metrics <metrics.jsonl>
        python -m shadow_tpu.tools.trace_report --compare <A.jsonl> <B.jsonl>
+       python -m shadow_tpu.tools.trace_report --trend <BENCH_HISTORY.jsonl>
 """
 
 from __future__ import annotations
@@ -39,7 +47,7 @@ from __future__ import annotations
 import json
 import sys
 from collections import defaultdict
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, List, Optional
 
 ROUND_PHASES = ("collect", "dispatch.launch", "round", "flush", "log.flush",
                 "checkpoint.write", "exchange")
@@ -150,11 +158,21 @@ def summarize_metrics(records: List[dict]) -> Dict:
     if not summaries:
         raise ValueError("no summary record (run did not finish?)")
     final = summaries[-1]
+    metrics = final.get("metrics", {})
+    # histogram digest table (ISSUE 15): the percentile columns pulled
+    # up next to each other so a human reads tails without digging
+    # through the flat scrape's nested dicts
+    hists = {
+        name: {k: v[k] for k in ("count", "mean", "p50", "p95", "p99",
+                                 "min", "max") if k in v}
+        for name, v in sorted(metrics.items())
+        if isinstance(v, dict) and "count" in v and v["count"]}
     return {
         "scrape_records": len(records) - len(summaries),
         "rounds": final.get("round"),
         "sim_time_ns": final.get("sim_time_ns"),
-        "final": final.get("metrics", {}),
+        "histograms": hists,
+        "final": metrics,
     }
 
 
@@ -188,20 +206,134 @@ def compare_metrics(a_records: List[dict], b_records: List[dict]) -> Dict:
     }
 
 
+# -- perf-trend ledger rendering (ISSUE 15) ---------------------------------
+
+_SPARK = "▁▂▃▄▅▆▇█"
+
+# which direction is GOOD, per column-name pattern.  Higher-better is
+# matched FIRST (sim_sec_per_wall_sec ends in _sec but is a rate);
+# unknown columns still render, they just carry no regression verdict.
+_HIGHER_BETTER = ("sim_sec_per_wall", "per_sec", "fraction", "efficiency",
+                  "rounds_per_launch", "events", "completed", "forwards",
+                  "occupancy")
+_LOWER_BETTER = ("_sec", "_us", "_ns", "_ms", "_mb", "bytes",
+                 "host_bounces", "model_stale", "violations", "recoveries",
+                 "demoted", "findings", "problems", "_rc")
+
+
+def _direction(col: str) -> Optional[str]:
+    c = col.lower()
+    # specific names first: cut_fraction is the partitioner's cross-shard
+    # hop share — LOWER is better, despite the generic "fraction" rule
+    if "cut_fraction" in c:
+        return "lower"
+    if any(p in c for p in _HIGHER_BETTER):
+        return "higher"
+    if any(p in c for p in _LOWER_BETTER):
+        return "lower"
+    return None
+
+
+def _sparkline(values: List[float]) -> str:
+    lo, hi = min(values), max(values)
+    if hi == lo:
+        return _SPARK[3] * len(values)
+    return "".join(
+        _SPARK[min(int((v - lo) / (hi - lo) * (len(_SPARK) - 1)),
+                   len(_SPARK) - 1)] for v in values)
+
+
+def summarize_trend(records: List[dict], last_n: int = 16,
+                    regress_pct: float = 10.0) -> Dict:
+    """Render the ledger: rows grouped by family (record ``row`` key),
+    each numeric column as (latest, best-known, delta, sparkline) over
+    the recorded history, regression-flagged when the good direction is
+    known and the latest value is >``regress_pct``% worse than the best.
+    Raises ValueError on an empty ledger — CI must see that as a
+    failure, not an empty trajectory."""
+    if not records:
+        raise ValueError("ledger is empty (no rows ever appended?)")
+    by_row: Dict[str, List[dict]] = defaultdict(list)
+    for rec in records:
+        by_row[rec.get("row", "?")].append(rec)
+    rows: Dict[str, Dict] = {}
+    regressions: List[str] = []
+    for name, recs in sorted(by_row.items()):
+        recs = sorted(recs, key=lambda r: r.get("ts", ""))
+        cols: Dict[str, List[float]] = defaultdict(list)
+        for rec in recs:
+            for col, v in (rec.get("cols") or {}).items():
+                if isinstance(v, (int, float)) \
+                        and not isinstance(v, bool):
+                    cols[col].append(float(v))
+        col_out: Dict[str, Dict] = {}
+        row_regs: List[str] = []
+        for col, vals in sorted(cols.items()):
+            vals = vals[-last_n:]
+            direction = _direction(col)
+            latest = vals[-1]
+            best = max(vals) if direction == "higher" else min(vals)
+            entry = {
+                "latest": latest,
+                "best": best,
+                "delta_vs_best": round(latest - best, 6),
+                "spark": _sparkline(vals),
+                "n": len(vals),
+                "direction": direction,
+            }
+            if direction is not None and len(vals) >= 2:
+                scale = abs(best) if best else 1.0
+                worse = (best - latest if direction == "higher"
+                         else latest - best)
+                entry["regressed"] = bool(
+                    worse / scale * 100.0 > regress_pct)
+                if entry["regressed"]:
+                    row_regs.append(col)
+            else:
+                entry["regressed"] = None
+            col_out[col] = entry
+        rows[name] = {
+            "n": len(recs),
+            "first_ts": recs[0].get("ts"),
+            "last_ts": recs[-1].get("ts"),
+            "latest_sha": recs[-1].get("sha"),
+            "boxes": sorted({r.get("box") for r in recs}),
+            "columns": col_out,
+            "regressions": row_regs,
+        }
+        regressions.extend(f"{name}:{c}" for c in row_regs)
+    return {"rows": rows, "row_families": sorted(by_row),
+            "records": len(records), "regressions": regressions}
+
+
 def main(argv: List[str]) -> int:
     usage = ("usage: python -m shadow_tpu.tools.trace_report "
              "<trace.json> [--pretty] | --metrics <metrics.jsonl> | "
-             "--compare <A.jsonl> <B.jsonl>")
+             "--compare <A.jsonl> <B.jsonl> | "
+             "--trend <BENCH_HISTORY.jsonl>")
     if not argv:
         print(usage, file=sys.stderr)
         return 2
     pretty = "--pretty" in argv
     metrics_mode = "--metrics" in argv
     compare_mode = "--compare" in argv
+    trend_mode = "--trend" in argv
     paths = [a for a in argv if not a.startswith("--")]
     if not paths:
         print(usage, file=sys.stderr)
         return 2
+    if trend_mode:
+        from ..prof.ledger import load_history
+        try:
+            report = summarize_trend(load_history(paths[0]))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"error: cannot render trend {paths[0]!r}: {e}",
+                  file=sys.stderr)
+            return 1
+        json.dump(report, sys.stdout, indent=2 if pretty else None,
+                  sort_keys=True, ensure_ascii=False)
+        print()
+        return 0
     if compare_mode:
         if len(paths) != 2:
             print(usage, file=sys.stderr)

@@ -67,7 +67,8 @@ def test_mode_matrix_axes_all_engaged():
     seen_modes, seen_fams = set(), set()
     axes = {"numpy": False, "k1": False, "k8": False, "table_off": False,
             "table_on": False, "mesh": False, "threaded": False,
-            "device": False}
+            "device": False, "exchange_fused": False,
+            "exchange_ppermute": False}
     for seed in range(40):
         spec = draw_spec(seed)
         seen_fams.add(spec["family"])
@@ -79,6 +80,14 @@ def test_mode_matrix_axes_all_engaged():
                 axes["mesh"] = True
             elif m["device_plane"] == "device":
                 axes["device"] = True
+            if m.get("exchange_mode") == "fused":
+                axes["exchange_fused"] = True
+                # the forced-exchange modes must ride a SHARDED mesh (a
+                # single-device plane has no exchange to force)
+                assert int(m.get("tpu_devices", 1)) > 1
+            if m.get("exchange_mode") == "ppermute":
+                axes["exchange_ppermute"] = True
+                assert int(m.get("tpu_devices", 1)) > 1
             if m["superwindow_rounds"] == 1:
                 axes["k1"] = True
             if m["superwindow_rounds"] > 1:
