@@ -381,6 +381,14 @@ def _run_sim(xml, policy: str, workers: int, stop: int, **opt_kw) -> dict:
         # wall is attacked with (>1 means multi-round launches engaged)
         out["rounds_per_launch"] = st["rounds_per_launch"]
         out["superwindows"] = st["superwindows"]
+        # autotune columns (ISSUE 16), fail-closed: the decision source is
+        # "absent" unless the plane actually published one, and the launch
+        # rate / compaction savings come from the same scrape so a run
+        # where the tuner silently failed to engage reads as exactly that
+        out["autotune_source"] = scrape.get("prof.autotune_source", "absent")
+        out["launches_per_sim_sec"] = round(
+            st["dispatches"] / max(stop, 1), 2)
+        out["flush_bytes_saved"] = int(st.get("flush_bytes_saved", 0))
     # mesh columns (ISSUE 9): the mesh.* registry source is present iff
     # the flow table was sharded over >1 device.  prof.* (ISSUE 15):
     # per-launch predicted-vs-measured attribution + the model-stale
@@ -1191,6 +1199,31 @@ def bench_smoke() -> int:
     _run_sim(xml_sw, "tpu", 0, 120, metrics_path=mpath)
     final = summarize_metrics(read_metrics_file(mpath))["final"]
     rpl = final.get("plane.rounds_per_launch", 0)
+    # tuner engagement leg (ISSUE 16): a synthetic covering cost model —
+    # stamped with THIS box's fingerprint at smoke time, so it loads
+    # wherever the smoke runs (the checked-in per-box model is exercised
+    # by bench_prof and tier-1; a fingerprint-mismatched box legitimately
+    # reports source="defaults" there).  Launch-bound shape: flat cheap
+    # step cost + a large fixed transfer cost per launch, so the tuner
+    # must deepen K past the hand default to amortize it.
+    from shadow_tpu.prof import model as prof_model
+    tmodel_path = os.path.join(os.path.dirname(mpath), "tuner-model.json")
+    prof_model.save_model(tmodel_path, prof_model.build_model({
+        "collectives": {
+            "ppermute": {"2x24": 300.0, "8x24": 300.0},
+            "all_to_all": {"2x24": 320.0, "8x24": 320.0},
+            "psum": {"2x24": 50.0, "8x24": 50.0},
+        },
+        "step_kernel": {"points": [
+            {"flows": 1, "us_per_step": 30.0},
+            {"flows": 1_000_000, "us_per_step": 30.0}]},
+        "transfer": {"dispatch_us": 400.0, "flush_us": 1600.0,
+                     "flush_us_per_mb": 3000.0},
+    }))
+    xml_tn = workloads.star_bulk(6, stoptime=120,
+                                 bulk_bytes=16 * 1024 * 1024,
+                                 device_data=True)
+    r_tune = _run_sim(xml_tn, "tpu", 0, 120, cost_model=tmodel_path)
     # star2k scale smoke (ROADMAP item 2 / ISSUE 8): a generated 2k-host
     # table-booted scenario, memory gated on bytes_per_host + peak RSS
     # read back from the metrics JSONL via trace_report --metrics — the
@@ -1360,6 +1393,28 @@ def bench_smoke() -> int:
     if not rpl or rpl <= 1:
         failures.append(f"rounds_per_launch={rpl}: superwindows never "
                         "engaged on the device-bound star run")
+    # tuner engagement gates (ISSUE 16): under the synthetic covering
+    # model the dispatch decision source must be "model", the tuned K
+    # must clear the hand default (launch-bound regime => deep K), and
+    # the launch amortization must clear the K=1 floor
+    out["autotune_source"] = r_tune.get("autotune_source")
+    out["autotune_k"] = r_tune.get("prof.autotune_k")
+    out["autotune_rounds_per_launch"] = r_tune.get("rounds_per_launch")
+    out["launches_per_sim_sec"] = r_tune.get("launches_per_sim_sec")
+    out["flush_bytes_saved"] = r_tune.get("flush_bytes_saved")
+    if out["autotune_source"] != "model":
+        failures.append(
+            f"autotune_source={out['autotune_source']!r}: the synthetic "
+            "covering cost model did not engage the dispatch tuner")
+    elif (out["autotune_k"] or 0) <= 8:
+        failures.append(
+            f"autotune_k={out['autotune_k']}: the launch-bound model did "
+            "not deepen K past the hand default")
+    if (out["autotune_rounds_per_launch"] or 0) <= 1:
+        failures.append(
+            f"tuner-leg rounds_per_launch="
+            f"{out['autotune_rounds_per_launch']}: tuned dispatch never "
+            "amortized launches above the K=1 floor")
     for key in ("plane.overlap_efficiency", "engine.host_exec_plugin_sec",
                 "engine.host_exec_ctrl_sec"):
         if key not in final:
@@ -1590,6 +1645,14 @@ def main() -> None:
         "tor10k_plane_calls_per_dispatch":
             sims.get("tor10k_device_plane_native_long",
                      {}).get("plane_calls_per_dispatch"),
+        # autotune columns (ISSUE 16): the flagship's dispatch-decision
+        # source and launch rate — the trajectory the ledger tracks
+        "tor10k_autotune_source":
+            sims.get("tor10k_device_plane_native_long",
+                     {}).get("autotune_source"),
+        "tor10k_launches_per_sim_sec":
+            sims.get("tor10k_device_plane_native_long",
+                     {}).get("launches_per_sim_sec"),
         "star100_device_traffic_fraction":
             sims.get("star100_device_plane",
                      {}).get("device_traffic_fraction"),

@@ -98,6 +98,14 @@ class Options:
                                          # PR-9 heuristic; fused/ppermute
                                          # force one identical-result
                                          # kernel (digest parity pinned)
+    device_autotune: str = "on"          # COSTMODEL-driven dispatch tuner
+                                         # (prof/autotune.py): picks the
+                                         # effective superwindow depth and
+                                         # the delta-compacted flush from
+                                         # measured per-box costs; only
+                                         # ever chooses between digest-
+                                         # identical executions. "off" =
+                                         # the hand defaults, untouched
     cost_model: str = ""                 # --cost-model: per-box measured
                                          # cost model path (simprof
                                          # calibrate); "" = $SHADOW_COSTMODEL
@@ -260,6 +268,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "heuristic when uncalibrated), 'fused'/'ppermute' "
                         "force one of the identical-result kernels "
                         "(scheduling only — digests never change)")
+    p.add_argument("--device-autotune", choices=("on", "off"),
+                   default="on", dest="device_autotune",
+                   help="COSTMODEL-driven dispatch auto-tuner: pick the "
+                        "effective superwindow depth and the delta-"
+                        "compacted flush from this box's measured costs "
+                        "(prof/autotune.py; engages only with a loaded, "
+                        "covering model and only moves knobs still at "
+                        "their hand defaults — digests never change); "
+                        "'off' restores the hand defaults exactly")
     p.add_argument("--cost-model", default="", dest="cost_model",
                    help="path to the per-box measured cost model "
                         "(simprof calibrate); default: $SHADOW_COSTMODEL "

@@ -82,7 +82,7 @@ def _mode(name: str, **kw) -> Dict:
          "device_plane": "device", "superwindow_rounds": 8,
          "tpu_devices": 1, "host_table": "on", "dataplane": "python",
          "device_plane_sync": False, "exchange_mode": "auto",
-         "events_comparable": True}
+         "device_autotune": "on", "events_comparable": True}
     m.update(kw)
     return m
 
@@ -107,6 +107,12 @@ def flow_modes(rng) -> List[Dict]:
     ]
     if rng.integers(0, 2):
         modes.append(_mode("sync", device_plane_sync=True))
+    # the auto-tuner axis (ISSUE 16): every mode above runs with the
+    # tuner's default-on behavior; this leg forces the hand defaults, so
+    # the cross-mode digest oracle pins tuned-vs-untuned parity for free.
+    # Appended AFTER all rng draws — the draw stream (and thus every
+    # historical seed's scenario) is unchanged.
+    modes.append(_mode("autotune-off", device_autotune="off"))
     return modes
 
 

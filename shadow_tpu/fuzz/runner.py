@@ -51,6 +51,7 @@ def _mode_options(spec: Dict, mode: Dict):
         superwindow_rounds=int(mode.get("superwindow_rounds", 8)),
         device_plane_sync=bool(mode.get("device_plane_sync", False)),
         exchange_mode=mode.get("exchange_mode", "auto"),
+        device_autotune=mode.get("device_autotune", "on"),
         tpu_devices=int(mode.get("tpu_devices", 1)),
         heartbeat_interval_sec=0,
         log_level="warning")

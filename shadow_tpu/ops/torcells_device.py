@@ -32,7 +32,7 @@ node_{s+1}]; leaving stage 4 means delivered.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -321,22 +321,40 @@ def torcells_step_window(t0, queued, ring, tokens, delivered, target,
 FLUSH_HEADER = 5
 
 
-def flush_len(n_chains: int, n_nodes: int) -> int:
-    return FLUSH_HEADER + 2 * n_chains + 2 * n_nodes
+def flush_len(n_chains: int, n_nodes: int,
+              cap_chains: Optional[int] = None,
+              cap_nodes: Optional[int] = None) -> int:
+    """Packed flush buffer length.  With caps (ISSUE 16 delta-compacted
+    flush) the chain/node sections carry at most ``cap_chains``/
+    ``cap_nodes`` entries — the header counts stay TRUE, so an
+    overflowing window is detectable (flush_overflowed) and re-read
+    through the full-length kernel."""
+    c = n_chains if cap_chains is None else min(cap_chains, n_chains)
+    h = n_nodes if cap_nodes is None else min(cap_nodes, n_nodes)
+    return FLUSH_HEADER + 2 * c + 2 * h
 
 
 def _pack_flush_jnp(forwards, delivered_sum, t_stop, newly, done_last,
-                    sent_delta):
+                    sent_delta, cap_chains: Optional[int] = None,
+                    cap_nodes: Optional[int] = None):
     """newly bool [C], done_last int64 [C], sent_delta int64 [H] -> packed
     buffer.  Compaction is a cumsum-cursor scatter; out-of-range slots (the
-    unselected lanes) are dropped on device."""
+    unselected lanes) are dropped on device.  With caps the buffer is the
+    CAPPED length and entries past a cap are dropped — the header still
+    carries the true counts, so the host can tell a capped buffer lost
+    entries and fall back to the full-length kernel (delta-compacted
+    flush, ISSUE 16: quiet lanes stop costing readback bytes)."""
     c = newly.shape[0]
     h = sent_delta.shape[0]
-    length = flush_len(c, h)
+    cc = c if cap_chains is None else min(int(cap_chains), c)
+    hh = h if cap_nodes is None else min(int(cap_nodes), h)
+    length = flush_len(c, h, cap_chains, cap_nodes)
     touched = sent_delta != 0
     pos_c = jnp.cumsum(newly.astype(jnp.int64)) - 1
     pos_h = jnp.cumsum(touched.astype(jnp.int64)) - 1
     oob = jnp.int64(length)
+    sel_c = newly & (pos_c < cc)
+    sel_h = touched & (pos_h < hh)
     buf = jnp.zeros(length, jnp.int64)
     buf = buf.at[0].set(forwards)
     buf = buf.at[1].set(delivered_sum)
@@ -344,13 +362,13 @@ def _pack_flush_jnp(forwards, delivered_sum, t_stop, newly, done_last,
     buf = buf.at[3].set(jnp.sum(touched.astype(jnp.int64)))
     buf = buf.at[4].set(t_stop)
     base = jnp.int64(FLUSH_HEADER)
-    buf = buf.at[jnp.where(newly, base + pos_c, oob)].set(
+    buf = buf.at[jnp.where(sel_c, base + pos_c, oob)].set(
         jnp.arange(c, dtype=jnp.int64), mode="drop")
-    buf = buf.at[jnp.where(newly, base + c + pos_c, oob)].set(
+    buf = buf.at[jnp.where(sel_c, base + cc + pos_c, oob)].set(
         done_last, mode="drop")
-    buf = buf.at[jnp.where(touched, base + 2 * c + pos_h, oob)].set(
+    buf = buf.at[jnp.where(sel_h, base + 2 * cc + pos_h, oob)].set(
         jnp.arange(h, dtype=jnp.int64), mode="drop")
-    buf = buf.at[jnp.where(touched, base + 2 * c + h + pos_h, oob)].set(
+    buf = buf.at[jnp.where(sel_h, base + 2 * cc + hh + pos_h, oob)].set(
         sent_delta, mode="drop")
     return buf
 
@@ -377,18 +395,34 @@ def pack_flush_np(forwards, delivered_sum, t_stop, newly, done_last,
     return buf
 
 
-def parse_flush(buf: np.ndarray, n_chains: int, n_nodes: int):
+def flush_overflowed(buf: np.ndarray, cap_chains: int,
+                     cap_nodes: int) -> bool:
+    """True when a CAPPED flush buffer lost entries: the header carries the
+    true per-window counts, so overflow is one comparison — the caller then
+    re-runs the same inputs through the full-length kernel (legal on the
+    non-donating CPU path, where the inputs are still alive)."""
+    return int(buf[2]) > int(cap_chains) or int(buf[3]) > int(cap_nodes)
+
+
+def parse_flush(buf: np.ndarray, n_chains: int, n_nodes: int,
+                cap_chains: Optional[int] = None,
+                cap_nodes: Optional[int] = None):
     """(forwards, delivered_sum, t_stop, done_chains, done_steps, node_idx,
-    node_delta) from a packed flush buffer — the ONE host-side reader."""
+    node_delta) from a packed flush buffer — the ONE host-side reader.
+    Pass the caps the buffer was packed with (if any); callers must check
+    flush_overflowed FIRST — parsing an overflowed capped buffer would
+    silently drop completions/deltas."""
+    cc = n_chains if cap_chains is None else min(int(cap_chains), n_chains)
+    hh = n_nodes if cap_nodes is None else min(int(cap_nodes), n_nodes)
     base = FLUSH_HEADER
-    n_done = int(buf[2])
-    n_touch = int(buf[3])
+    n_done = min(int(buf[2]), cc)
+    n_touch = min(int(buf[3]), hh)
     return (int(buf[0]), int(buf[1]), int(buf[4]),
             buf[base:base + n_done],
-            buf[base + n_chains:base + n_chains + n_done],
-            buf[base + 2 * n_chains:base + 2 * n_chains + n_touch],
-            buf[base + 2 * n_chains + n_nodes:
-                base + 2 * n_chains + n_nodes + n_touch])
+            buf[base + cc:base + cc + n_done],
+            buf[base + 2 * cc:base + 2 * cc + n_touch],
+            buf[base + 2 * cc + hh:
+                base + 2 * cc + hh + n_touch])
 
 
 def _step_span_impl(t0, queued, ring, tokens, delivered, target,
@@ -479,10 +513,14 @@ def _step_span_flush_impl(t0, queued, ring, tokens, delivered, target,
                           done_tick, node_sent, inject, inject_target,
                           targets, idle_ticks, flow_node, flow_lat,
                           flow_succ, seg_start, refill, capacity,
-                          last_flow, ring_len: int):
+                          last_flow, ring_len: int,
+                          cap_chains: Optional[int] = None,
+                          cap_nodes: Optional[int] = None):
     """Superwindow step + packed flush in ONE dispatch: the 9-tuple of
     _step_span_impl with the packed flush buffer appended as [9].
-    ``last_flow`` [C] maps each chain to its exit flow row."""
+    ``last_flow`` [C] maps each chain to its exit flow row.  With caps
+    the flush is the capped (delta-compacted) buffer — see
+    _pack_flush_jnp."""
     done_in_last = done_tick[last_flow]
     node_sent_in = node_sent
     out = _step_span_impl(t0, queued, ring, tokens, delivered, target,
@@ -493,7 +531,8 @@ def _step_span_flush_impl(t0, queued, ring, tokens, delivered, target,
     done_last = out[6][last_flow]
     newly = (done_last >= 0) & (done_in_last < 0)
     flush = _pack_flush_jnp(out[8], jnp.sum(out[4][last_flow]), out[0],
-                            newly, done_last, out[7] - node_sent_in)
+                            newly, done_last, out[7] - node_sent_in,
+                            cap_chains, cap_nodes)
     return (*out, flush)
 
 
@@ -510,6 +549,16 @@ torcells_step_window_flush = partial(
 
 torcells_step_window_flush_nodonate = partial(
     jax.jit, static_argnames=("ring_len",))(_step_span_flush_impl)
+
+# Delta-compacted flush variant (ISSUE 16): same program with the flush
+# buffer capped to the tuned lane counts.  Non-donating ONLY — overflow
+# recovery re-runs the same inputs through the full-length kernel, which
+# requires the carried state to still be alive after the launch; that is
+# exactly the property the CPU dispatch path already has (see above), and
+# device_plane only engages caps on that path.
+torcells_step_window_flush_capped = partial(
+    jax.jit, static_argnames=("ring_len", "cap_chains", "cap_nodes"))(
+        _step_span_flush_impl)
 
 
 def step_window_flush_for_backend():

@@ -318,6 +318,44 @@ class DeviceTrafficPlane:
         else:
             self._costmodel, self._costmodel_status = None, "off"
         self._build_layout(engine)
+        # COSTMODEL auto-tuner (ISSUE 16, prof/autotune.py): with a
+        # loaded model covering this flow table, pick the effective
+        # superwindow depth and the delta-compacted flush from measured
+        # costs.  Digest-NEUTRAL by construction: K only merges rounds
+        # the halt rule maps back exactly, and the capped flush is a
+        # transport encoding (overflow re-reads full-length).  Cadence
+        # and granule are digest-BEARING and stay at contract values.
+        from ..prof.autotune import plan_dispatch
+        self._tune_plan = plan_dispatch(
+            self._costmodel, self._costmodel_status, engine.options,
+            self.n_flows, self.n_chains, self.n_nodes)
+        self._flush_caps = None      # (cap_chains, cap_nodes) when engaged
+        self._inflight_caps = None   # caps the IN-FLIGHT dispatch packed with
+        self._inflight_args = None   # its inputs (overflow re-run, nodonate)
+        self.flush_bytes_saved = 0
+        self.flush_overflows = 0
+        if self._tune_plan.source == "model":
+            self.superwindow_rounds = self._tune_plan.superwindow_rounds
+            if self.superwindow_rounds > getattr(engine, "_superwindow", 1):
+                engine._superwindow = self.superwindow_rounds
+            if self._tune_plan.flush_compact and mode == "device":
+                import jax
+                if jax.default_backend() == "cpu":
+                    # overflow recovery re-runs the SAME inputs through
+                    # the full-length kernel, which needs them alive
+                    # after the launch — exactly the non-donating CPU
+                    # dispatch path's property.  Donating backends keep
+                    # the full flush.
+                    self._flush_caps = (self._tune_plan.flush_cap_chains,
+                                        self._tune_plan.flush_cap_nodes)
+        engine.metrics.source("autotune", self._autotune_metrics)
+        # quiet-tick exchange-leg fusion (ISSUE 16): set by attach_mesh —
+        # per-chain leg bitmasks; dispatch picks a variant kernel with
+        # the quiet legs compiled out (superset masks are bit-identical)
+        self._chain_leg_bits = None
+        self._full_leg_bits = 0
+        self._active_leg_bits = 0
+        self._sharded_variants: Dict[int, object] = {}
         # multi-chip: shard the flow table over a device mesh (same
         # --tpu-devices axis the scheduler policy scales on).  Exact — see
         # parallel/mesh/ (partition + BvN exchange); state/API stay in the
@@ -328,6 +366,10 @@ class DeviceTrafficPlane:
                 import jax
                 n_dev = len(jax.devices())
             if n_dev > 1:
+                # the mesh path's launch cut is the exchange-leg mask;
+                # flush compaction stays single-device (the overflow
+                # re-run would need a per-variant full kernel here)
+                self._flush_caps = None
                 self._setup_sharding(n_dev)
         self._state = None           # lazy: built at first activation
         # processless flows (scale tier): (start_ns, circuit) ascending;
@@ -672,6 +714,17 @@ class DeviceTrafficPlane:
         self._inject_buf.append((2 * spec.circuit, down))
         if up:
             self._inject_buf.append((2 * spec.circuit + 1, up))
+        if self._chain_leg_bits is not None:
+            # quiet-tick fusion bookkeeping: the chains this injection
+            # activates may now carry cells over their exchange legs —
+            # the active-leg superset only ever GROWS (in-flight cells
+            # never migrate legs), which is what keeps every cached
+            # masked variant digest-identical to the full kernel
+            self._active_leg_bits |= int(self._chain_leg_bits[
+                2 * spec.circuit])
+            if up:
+                self._active_leg_bits |= int(self._chain_leg_bits[
+                    2 * spec.circuit + 1])
         self.total_injected_cells += down + up
         return spec.circuit
 
@@ -742,6 +795,18 @@ class DeviceTrafficPlane:
             self.seg_start, self.refill_step, self.capacity_step,
             self.last_flow, ring_len=self.ring_len)
         np.asarray(out[9])
+        if self._flush_caps is not None:
+            # the tuned dispatch runs the CAPPED flush kernel — compile
+            # it here too so the first timed dispatch pays no XLA wall
+            from ..ops.torcells_device import torcells_step_window_flush_capped
+            cc, hh = self._flush_caps
+            out = torcells_step_window_flush_capped(
+                *state, z, z, self._pad_targets([1]), np.int64(0),
+                self.flow_node, self.flow_lat_steps, self.flow_succ,
+                self.seg_start, self.refill_step, self.capacity_step,
+                self.last_flow, ring_len=self.ring_len,
+                cap_chains=cc, cap_nodes=hh)
+            np.asarray(out[9])
 
     def _pad_targets(self, targets: List[int]) -> np.ndarray:
         """Pad a superwindow's boundary list to the static kernel shape by
@@ -769,7 +834,11 @@ class DeviceTrafficPlane:
         a K-round launch produces the same dispatch bases/targets — and,
         with the kernel's halt-at-completion rule, the same wake barriers —
         as K separate rounds: digest parity K=1-vs-K is by construction
-        (tests/test_superwindow.py pins it)."""
+        (tests/test_superwindow.py pins it).  That construction is why the
+        auto-tuner (prof/autotune.py) may deepen K freely from measured
+        launch costs: quiet rounds — including the quiet ticks between
+        cross-shard exchange activity on a masked mesh variant — merge
+        into one span launch with bit-identical results at any depth."""
         if (max_rounds <= 1 or self._state is None or self._inflight
                 or self.superwindow_rounds <= 1):
             return None
@@ -909,7 +978,7 @@ class DeviceTrafficPlane:
         tvec = self._pad_targets(targets)
         if self._shard is not None:
             lay = self._shard
-            out = self._sharded_step(
+            out = self._pick_sharded_step()(
                 *state, inject, inject_target,
                 tvec, np.int64(idle), lay["flow_node_local"],
                 lay["succ_global"], lay["seg_start_local"],
@@ -920,10 +989,27 @@ class DeviceTrafficPlane:
                 from ..ops.torcells_device import (
                     step_window_flush_for_backend)
                 self._flush_step = step_window_flush_for_backend()
-            out = self._flush_step(*state, inject, inject_target,
-                                   tvec, np.int64(idle),
-                                   *self._flow_args(),
-                                   ring_len=self.ring_len)
+            if self._flush_caps is not None:
+                # delta-compacted flush (tuner decision): pack only the
+                # capped lane counts; stash the inputs so an overflowing
+                # window (true counts in the header exceed the caps) can
+                # re-run full-length at consume — legal because this
+                # path is non-donating, so the inputs stay alive
+                from ..ops.torcells_device import (
+                    torcells_step_window_flush_capped)
+                cc, hh = self._flush_caps
+                out = torcells_step_window_flush_capped(
+                    *state, inject, inject_target, tvec, np.int64(idle),
+                    *self._flow_args(), ring_len=self.ring_len,
+                    cap_chains=cc, cap_nodes=hh)
+                self._inflight_caps = (cc, hh)
+                self._inflight_args = (state, inject, inject_target,
+                                       tvec, np.int64(idle))
+            else:
+                out = self._flush_step(*state, inject, inject_target,
+                                       tvec, np.int64(idle),
+                                       *self._flow_args(),
+                                       ring_len=self.ring_len)
         else:
             from ..ops.torcells_device import torcells_step_window_numpy_flush
             out = torcells_step_window_numpy_flush(*state, inject,
@@ -976,11 +1062,12 @@ class DeviceTrafficPlane:
             else:
                 kernel_flows = self.n_flows
                 ex_us = 0.0
-            # only predict INSIDE the model's measured range: a table
-            # far below the smallest calibrated flow count would be
-            # judged by pure extrapolation and flood prof.model_stale
-            # with false positives on toy runs
-            if kernel_flows * 2 >= self._costmodel.min_flows:
+            # only predict INSIDE the model's measured range (the
+            # two-sided CostModel.covers guard): a table far below the
+            # smallest — or above the largest — calibrated flow count
+            # would be judged by pure extrapolation and flood
+            # prof.model_stale with false positives
+            if self._costmodel.covers(kernel_flows):
                 self._launch_pred = (
                     self._costmodel.step_us(kernel_flows)
                     + max(ex_us, 0.0),
@@ -1027,9 +1114,33 @@ class DeviceTrafficPlane:
                                   engine.scheduler.window_start)
         if self.mode == "device":
             self.device_calls += 1              # the flush read
-        from ..ops.torcells_device import parse_flush
+        from ..ops.torcells_device import (flush_len, flush_overflowed,
+                                           parse_flush)
+        caps, self._inflight_caps = self._inflight_caps, None
+        args, self._inflight_args = self._inflight_args, None
+        if caps is not None and self.mode != "device":
+            caps = None     # recovered on the twin: flush is full-length
+        if caps is not None:
+            if flush_overflowed(flush, *caps):
+                # a busy window outran the tuned caps: re-run the SAME
+                # inputs through the full-length kernel (bit-identical
+                # state math — only the flush encoding differs) and read
+                # the complete buffer.  Persistent overflow means the
+                # caps are mis-sized for this phase: stop paying the
+                # re-runs and revert to full flushes for the rest of
+                # the run.
+                flush = self._rerun_full_flush(args)
+                self.flush_overflows += 1
+                caps = None
+                if self.flush_overflows >= 8:
+                    self._flush_caps = None
+            else:
+                self.flush_bytes_saved += 8 * (
+                    flush_len(self.n_chains, self.n_nodes)
+                    - flush_len(self.n_chains, self.n_nodes, *caps))
         (forwards, delivered_sum, t_stop, done_chains, done_steps, node_idx,
-         node_delta) = parse_flush(flush, self.n_chains, self.n_nodes)
+         node_delta) = parse_flush(flush, self.n_chains, self.n_nodes,
+                                   *(caps or (None, None)))
         # launch attribution (ISSUE 15): predicted-vs-measured per-launch
         # gauges, the model-stale band check, and the sim-correlated
         # device track span — one call per collect, ~free when no model
@@ -1204,6 +1315,69 @@ class DeviceTrafficPlane:
         engine.supervision.overhead_ns += _wt.perf_counter_ns() - t_g
         return out
 
+    def _rerun_full_flush(self, args) -> np.ndarray:
+        """Overflow recovery for the delta-compacted flush: the capped
+        buffer's TRUE header counts exceeded its caps, so some
+        completions/node deltas were dropped from the ENCODING (never from
+        the state — the capped and full kernels run byte-identical tick
+        math).  Re-run the stashed inputs through the full-length kernel
+        and read its complete flush.  Only reachable on the non-donating
+        path, where the inputs survived the capped launch."""
+        assert args is not None, "flush overflow with no stashed inputs"
+        state, inject, inject_target, tvec, idle = args
+        if self._flush_step is None:
+            from ..ops.torcells_device import step_window_flush_for_backend
+            self._flush_step = step_window_flush_for_backend()
+        out = self._flush_step(*state, inject, inject_target, tvec, idle,
+                               *self._flow_args(), ring_len=self.ring_len)
+        self.device_calls += 1          # the recovery dispatch + read
+        return np.asarray(out[9])
+
+    def _pick_sharded_step(self):
+        """The sharded kernel variant for this dispatch (quiet-tick
+        exchange-leg fusion): when the active chains touch only a subset
+        of the schedule's legs, run a variant with the quiet legs
+        compiled out — each masked ppermute leg is one collective launch
+        saved per tick, and an all-masked span issues zero exchange
+        collectives.  The active-leg set only grows, every variant is a
+        superset of the cells actually in flight, and a full compile
+        cache falls back to the always-correct full kernel."""
+        if self._chain_leg_bits is None or self._full_leg_bits == 0:
+            return self._sharded_step
+        bits = self._active_leg_bits
+        full = self._full_leg_bits
+        if bits < 0 or full < 0 or bits == full:
+            if self._meshinfo is not None:
+                self._meshinfo.legs_active = full.bit_length() \
+                    if full >= 0 else self._meshinfo.legs
+            return self._sharded_step
+        step = self._sharded_variants.get(bits)
+        if step is None:
+            if len(self._sharded_variants) >= 4:
+                # compile budget spent: the full kernel is always right
+                if self._meshinfo is not None:
+                    self._meshinfo.legs_active = full.bit_length()
+                return self._sharded_step
+            n_legs = full.bit_length()
+            mask = tuple(bool(bits >> k & 1) for k in range(n_legs))
+            step = self._mesh_make_step(mask)
+            self._sharded_variants[bits] = step
+        if self._meshinfo is not None:
+            self._meshinfo.legs_active = bin(bits).count("1")
+        return step
+
+    def _autotune_metrics(self) -> Dict[str, object]:
+        """The ``prof.autotune_*`` registry source: the tuner's decision
+        plus its runtime outcomes.  flush_compact reports the caps
+        actually ENGAGED (the plan's choice can be overridden by the
+        backend gate, the mesh path, or the persistent-overflow
+        revert)."""
+        m = self._tune_plan.metrics()
+        m["prof.autotune_flush_compact"] = int(self._flush_caps is not None)
+        m["prof.flush_bytes_saved"] = self.flush_bytes_saved
+        m["prof.flush_overflows"] = self.flush_overflows
+        return m
+
     def _recover_dispatch(self, engine, exc: BaseException) -> np.ndarray:
         """Graceful device-plane degradation: the in-flight dispatch failed
         (exception or watchdog timeout), so rebuild the plane's state by
@@ -1228,7 +1402,14 @@ class DeviceTrafficPlane:
         self._mesh = None
         self._shard = None
         self._sharded_step = None
+        self._sharded_variants.clear()
+        self._chain_leg_bits = None
         self._flush_step = None
+        # the twin packs full-length flushes only; drop the capped-path
+        # bookkeeping with the device backend
+        self._flush_caps = None
+        self._inflight_caps = None
+        self._inflight_args = None
         # predictions are calibrated for the DEVICE kernels; the numpy
         # twin must not be judged (or scheduled) by them
         self._costmodel = None
@@ -1385,6 +1566,12 @@ class DeviceTrafficPlane:
             "superwindows": self.superwindows,
             "rounds_per_launch": round(
                 self._rounds_launched / max(self.dispatches, 1), 2),
+            # delta-compacted flush outcomes (ISSUE 16): readback bytes
+            # the capped encoding saved, and windows that outran the
+            # caps (each paid one full-length re-run; persistent
+            # overflow reverts the caps entirely)
+            "flush_bytes_saved": self.flush_bytes_saved,
+            "flush_overflows": self.flush_overflows,
             "mode": self.mode,
             # dispatch-guard outcomes: >0 recoveries means a dispatch
             # failed, the window history replayed on the numpy twin, and

@@ -172,6 +172,27 @@ def build_exchange(succ_global: np.ndarray, pad: int,
                             a2a_src.reshape(-1), a2a_dst.reshape(-1))
 
 
+def leg_of_edges(succ_global: np.ndarray, pad: int,
+                 schedule: ExchangeSchedule) -> np.ndarray:
+    """Per PADDED flow row: the index of the exchange leg its successor
+    edge rides, or -1 (no edge, intra-shard, or padding).  The quiet-tick
+    leg mask is built from this: OR each chain's rows' legs into a bitmask
+    and a span whose active chains touch only a subset of legs can compile
+    the rest out (make_mesh_span_raw's ``leg_mask``)."""
+    succ_global = np.asarray(succ_global, dtype=np.int64)
+    n_shards = schedule.n_shards
+    leg_of = np.full(len(succ_global), -1, dtype=np.int64)
+    lut = np.full(n_shards, -1, dtype=np.int64)
+    for k, r in enumerate(schedule.offsets):
+        lut[r] = k
+    rows = np.flatnonzero(succ_global >= 0)
+    s_src = rows // pad
+    s_dst = succ_global[rows] // pad
+    cross = s_src != s_dst
+    leg_of[rows[cross]] = lut[(s_dst[cross] - s_src[cross]) % n_shards]
+    return leg_of
+
+
 def choose_exchange_mode(schedule: ExchangeSchedule, model=None,
                          override: str = "auto"
                          ) -> Tuple[str, float, str]:
@@ -225,17 +246,35 @@ def choose_exchange_mode(schedule: ExchangeSchedule, model=None,
 
 def make_mesh_span_raw(mesh, axis: str, ring_len: int, pad: int,
                        schedule: ExchangeSchedule,
-                       mode: Optional[str] = None):
+                       mode: Optional[str] = None,
+                       leg_mask: Optional[Tuple[bool, ...]] = None):
     """The shard_map-ed SUPERWINDOW step with device-side cross-shard
     exchange.  Same argument list as the engine-facing flush kernel minus
     the flush packing; the arrival ring and arr_lat are SHARD-LOCAL
     (sharded in_specs), unlike the PR-7 kernel's replicated ring.  Returns
     the usual 9-tuple plus [9] = cross-shard cells exchanged this window
-    (psum'd, replicated)."""
+    (psum'd, replicated).
+
+    ``leg_mask`` (ISSUE 16 quiet-tick fusion) is a STATIC per-leg bool
+    tuple: a False leg issues NO collective this variant.  Safe whenever
+    the masked legs provably carry zeros — a chain whose specs are not yet
+    injected has queued=0 and an empty ring everywhere, so fwd=0 on all
+    its rows; meshplane tracks which legs the ACTIVE chains can touch and
+    compiles a variant per distinct superset mask.  Any SUPERSET of the
+    truly-needed legs is bit-identical (extra legs exchange zeros), so the
+    mask can only ever trade launches, never results.  With ``ppermute``
+    each masked leg is one launch saved per tick; with ``fused`` the
+    exchange is one launch regardless, so only the all-False mask (which
+    degrades to ``none``: zero exchange collectives, stats psum only)
+    changes the launch count."""
     from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     n_shards = schedule.n_shards
+    if leg_mask is None:
+        leg_mask = tuple(True for _ in range(schedule.legs))
+    assert len(leg_mask) == schedule.legs, (len(leg_mask), schedule.legs)
+    active_legs = [k for k in range(schedule.legs) if leg_mask[k]]
     # exchange tables are closed over as constants (the per-shard slice
     # is taken with dynamic_slice on the shard id).  Execution strategy
     # (``mode``; decided by choose_exchange_mode — measured cost model
@@ -252,7 +291,10 @@ def make_mesh_span_raw(mesh, axis: str, ring_len: int, pad: int,
     if mode is None:
         mode = "fused" if schedule.legs > 1 else (
             "ppermute" if schedule.legs == 1 else "none")
-    if schedule.legs == 0:
+    if schedule.legs == 0 or not active_legs:
+        # cross-free table OR every leg masked quiet this variant: the
+        # tick pays zero exchange collectives (stats psum still issues —
+        # it is the halt synchronizer, not exchange traffic)
         mode = "none"
     assert mode in ("fused", "ppermute", "none"), mode
     if mode == "fused":
@@ -263,10 +305,12 @@ def make_mesh_span_raw(mesh, axis: str, ring_len: int, pad: int,
         chunk = n_shards * pw
     elif mode == "ppermute":
         ex_mode = "ppermute"
+        # masked (quiet) legs compile out entirely: each is one saved
+        # collective launch per tick in this variant
         leg_tbls = [(schedule.offsets[k], schedule.widths[k],
                      jnp.asarray(schedule.send_src[k]),
                      jnp.asarray(schedule.recv_dst[k]))
-                    for k in range(schedule.legs)]
+                    for k in active_legs]
     else:
         ex_mode = "none"
 
@@ -428,17 +472,24 @@ def make_mesh_span_raw(mesh, axis: str, ring_len: int, pad: int,
 
 def make_mesh_span_flush(mesh, axis: str, ring_len: int, layout: dict,
                          last_flow_pad: np.ndarray, node_src: np.ndarray,
-                         n_nodes: int, mode: Optional[str] = None):
+                         n_nodes: int, mode: Optional[str] = None,
+                         leg_mask: Optional[Tuple[bool, ...]] = None,
+                         cap_chains: Optional[int] = None,
+                         cap_nodes: Optional[int] = None):
     """Mesh superwindow step + packed flush in ONE dispatch: the engine's
     sharded kernel (DeviceTrafficPlane._sharded_step contract — same
     argument list as the PR-7 kernel, so advance()/warmup() are layout-
     agnostic).  ``mode`` picks the exchange execution strategy
-    (choose_exchange_mode; None = the legacy heuristic).  The flush
-    buffer is the standard packed layout with ONE trailing slot appended:
-    [flush_len] = cross-shard cells exchanged this window (consume()
-    folds it into the mesh metrics with no extra device read)."""
+    (choose_exchange_mode; None = the legacy heuristic); ``leg_mask``
+    compiles quiet exchange legs out (make_mesh_span_raw); the caps pick
+    the delta-compacted flush layout (ops/torcells_device._pack_flush_jnp).
+    The flush buffer is the standard packed layout with ONE trailing slot
+    appended: [flush_len(..., caps)] = cross-shard cells exchanged this
+    window (consume() folds it into the mesh metrics with no extra device
+    read)."""
     raw = make_mesh_span_raw(mesh, axis, ring_len, layout["pad"],
-                             layout["exchange"], mode=mode)
+                             layout["exchange"], mode=mode,
+                             leg_mask=leg_mask)
     lf = np.asarray(last_flow_pad, dtype=np.int64)
     nsrc = np.asarray(node_src, dtype=np.int64)
 
@@ -461,16 +512,20 @@ def make_mesh_span_flush(mesh, axis: str, ring_len: int, layout: dict,
         done_last = out[6][lf]
         newly = (done_last >= 0) & (done_in_last < 0)
         flush = _pack_flush_jnp(out[8], jnp.sum(out[4][lf]), out[0], newly,
-                                done_last, global_sent(out[7]) - sent_in)
+                                done_last, global_sent(out[7]) - sent_in,
+                                cap_chains, cap_nodes)
         flush = jnp.concatenate([flush, out[9][None]])
         return (*out[:9], flush)
 
     return jax.jit(step_flush)
 
 
-def mesh_flush_extra(flush: np.ndarray, n_chains: int,
-                     n_nodes: int) -> int:
+def mesh_flush_extra(flush: np.ndarray, n_chains: int, n_nodes: int,
+                     cap_chains: Optional[int] = None,
+                     cap_nodes: Optional[int] = None) -> int:
     """The mesh flush buffer's trailing cross-shard cell count, or 0 for a
-    standard-length buffer (the numpy twin after a demotion)."""
-    base = flush_len(n_chains, n_nodes)
+    standard-length buffer (the numpy twin after a demotion).  Pass the
+    caps the buffer was packed with — the trailing slot rides at the end
+    of the CAPPED layout."""
+    base = flush_len(n_chains, n_nodes, cap_chains, cap_nodes)
     return int(flush[base]) if len(flush) > base else 0

@@ -32,7 +32,8 @@ import numpy as np
 
 from ...core.logger import get_logger
 from . import device_mesh
-from .exchange import choose_exchange_mode, make_mesh_span_flush
+from .exchange import (choose_exchange_mode, leg_of_edges,
+                       make_mesh_span_flush)
 from .partition import build_mesh_layout, chain_partition
 
 
@@ -42,7 +43,7 @@ class MeshPlaneInfo:
     __slots__ = ("n_devices", "legs", "cross_edges", "cut_fraction",
                  "occupancy", "cross_shard_cells", "host_bounces",
                  "flush_base", "exchange_mode", "predicted_us",
-                 "exchange_source", "model_status")
+                 "exchange_source", "model_status", "legs_active")
 
     def __init__(self, n_devices: int, legs: int, cross_edges: int,
                  cut_fraction: float, occupancy: np.ndarray,
@@ -64,6 +65,11 @@ class MeshPlaneInfo:
         self.exchange_source = exchange_source
         self.model_status = model_status
         self.cross_shard_cells = 0
+        # exchange legs the CURRENT kernel variant actually issues
+        # (quiet-tick fusion, ISSUE 16): starts at the full static
+        # schedule, drops to the active-chain superset once the plane
+        # picks a masked variant
+        self.legs_active = legs
         # dispatch windows whose cross-shard forwards were delivered
         # HOST-side.  No steady-state path does — the acceptance gate
         # asserts it stays 0 — and the counter is falsifiable: after a
@@ -91,6 +97,7 @@ class MeshPlaneInfo:
             "mesh.predicted_us": self.predicted_us,
             "mesh.exchange_source": self.exchange_source,
             "mesh.cost_model": self.model_status,
+            "mesh.legs_active": self.legs_active,
         }
 
 
@@ -119,10 +126,39 @@ def attach_mesh(plane, n_dev: int) -> None:
     override = getattr(plane.engine.options, "exchange_mode", "auto")
     ex_mode, predicted_us, source = choose_exchange_mode(
         sched, plane._costmodel, override)
-    plane._sharded_step = make_mesh_span_flush(
-        mesh, "flows", plane.ring_len, lay,
-        lay["inv"][plane.last_flow], lay["node_src"], plane.n_nodes,
-        mode=ex_mode)
+    # quiet-tick fusion support (ISSUE 16): per-chain exchange-leg
+    # bitmask, so a span whose ACTIVE chains touch only a subset of the
+    # legs can run a variant kernel with the quiet legs compiled out.
+    # Safe because an un-injected chain's rows forward zero cells — any
+    # SUPERSET of the active chains' legs is bit-identical (see
+    # make_mesh_span_raw).  >63 legs cannot happen (legs <= D-1 and the
+    # mesh caps out far below), but guard with the always-full sentinel.
+    leg_of = leg_of_edges(lay["succ_global"], lay["pad"], sched)
+    chain_bits = np.zeros(plane.n_chains, dtype=np.int64)
+    if sched.legs > 63:
+        chain_bits[:] = -1
+    else:
+        rows = np.flatnonzero((leg_of >= 0) & (lay["src"] >= 0))
+        if len(rows):
+            np.bitwise_or.at(
+                chain_bits, plane.flow_circ[lay["src"][rows]],
+                np.int64(1) << leg_of[rows])
+    plane._chain_leg_bits = chain_bits
+    plane._full_leg_bits = -1 if sched.legs > 63 \
+        else (1 << sched.legs) - 1
+    caps = getattr(plane, "_flush_caps", None)
+    cap_c, cap_h = caps if caps else (None, None)
+
+    def make_step(leg_mask=None, capped=True):
+        cc, hh = (cap_c, cap_h) if capped else (None, None)
+        return make_mesh_span_flush(
+            mesh, "flows", plane.ring_len, lay,
+            lay["inv"][plane.last_flow], lay["node_src"], plane.n_nodes,
+            mode=ex_mode, leg_mask=leg_mask,
+            cap_chains=cc, cap_nodes=hh)
+
+    plane._mesh_make_step = make_step
+    plane._sharded_step = make_step()
     edges_total = max(int(np.count_nonzero(plane.flow_succ >= 0)), 1)
     occupancy = lay["shard_sizes"].astype(np.float64) / max(lay["pad"], 1)
     plane._meshinfo = MeshPlaneInfo(

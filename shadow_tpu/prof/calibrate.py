@@ -42,8 +42,25 @@ DEVICES = (2, 3, 4, 8)
 WIDTHS = (24, 240, 4080)
 QUICK_DEVICES = (2, 8)
 QUICK_WIDTHS = (24, 960)
-FLOW_POINTS = (200, 1000, 4000)
+# step-kernel sweep in CIRCUITS (flow rows = 5x).  The top points exist
+# so the calibrated range covers flagship-scale tables (ISSUE 16: tor10k
+# dispatches ~100k flow rows; under the two-sided no-extrapolation guard
+# an uncovered table gets neither launch attribution NOR auto-tuning) —
+# 24k circuits = 120k flows, covering 240k under the 2x slack.  Large
+# points run proportionally fewer steps (_steps_for) so the sweep's wall
+# stays bounded.
+FLOW_POINTS = (200, 1000, 4000, 12000, 24000)
 QUICK_FLOW_POINTS = (200, 2000)
+
+
+def _steps_for(n_circ: int, steps: int) -> int:
+    """Scale the timed step count down for large tables (cost per step
+    grows ~linearly with flows; the per-step quotient stays accurate with
+    fewer, longer steps) — never below 60 steps so launch overhead stays
+    amortized out of the quotient."""
+    if n_circ <= 4000:
+        return steps
+    return max(60, steps * 4000 // n_circ)
 
 
 def _deadline_left(deadline: Optional[float]) -> float:
@@ -128,6 +145,7 @@ def measure_step_kernel(flow_points, steps: int,
         if _deadline_left(deadline) <= 0:
             truncated = True
             break
+        pt_steps = _steps_for(int(n_circ), steps)
         inst = DeviceTorCells(n_relays=max(8, n_circ // 10),
                               n_circuits=n_circ, seed=11,
                               relay_bw_kibps=4096, max_latency_ms=30)
@@ -148,7 +166,7 @@ def measure_step_kernel(flow_points, steps: int,
                 jnp.asarray(fl["flow_succ"]), jnp.asarray(fl["seg_start"]),
                 jnp.asarray(inst.refill), jnp.asarray(inst.capacity),
                 jnp.asarray(last_flow))
-        targets = np.array([steps], dtype=np.int64)
+        targets = np.array([pt_steps], dtype=np.int64)
         out = torcells_step_window_flush_nodonate(
             *state, queued0, target0, targets, np.int64(0), *args,
             ring_len=inst.ring_len)
@@ -160,15 +178,29 @@ def measure_step_kernel(flow_points, steps: int,
         jax.block_until_ready(out)
         t1 = _walltime.perf_counter()
         points.append({"flows": int(f),
-                       "us_per_step": round((t1 - t0) / steps * 1e6, 3)})
+                       "us_per_step": round((t1 - t0) / pt_steps * 1e6,
+                                            3)})
     return {"points": points}, truncated
 
 
-def measure_transfer(reps: int = 30, flows: int = 4096) -> Dict:
-    """Fixed per-launch transfer cost: inject upload + flush readback."""
+def measure_transfer(reps: int = 30, flows: int = 4096,
+                     big_flows: int = 65536) -> Dict:
+    """Fixed per-launch transfer cost: inject upload + flush readback.
+    The readback is measured at TWO buffer sizes; the slope
+    (``flush_us_per_mb``) is what prices the delta-compacted flush
+    (ISSUE 16, prof/autotune.py) — on a box where readback cost is
+    size-independent the slope is ~0 and compaction stays off."""
     import jax
     import jax.numpy as jnp
     import numpy as np
+
+    def readback_us(n: int) -> float:
+        dev = jnp.arange(n, dtype=jnp.int64)
+        np.asarray(dev)
+        t0 = _walltime.perf_counter()
+        for _ in range(reps):
+            np.asarray(dev + 1)  # +1: a fresh buffer per materialization
+        return (_walltime.perf_counter() - t0) / reps * 1e6
 
     host = np.zeros(flows, dtype=np.int64)
     jax.block_until_ready(jnp.asarray(host))          # warm the path
@@ -176,13 +208,12 @@ def measure_transfer(reps: int = 30, flows: int = 4096) -> Dict:
     for _ in range(reps):
         jax.block_until_ready(jnp.asarray(host))
     up_us = (_walltime.perf_counter() - t0) / reps * 1e6
-    dev = jnp.arange(flows, dtype=jnp.int64)
-    np.asarray(dev)
-    t0 = _walltime.perf_counter()
-    for _ in range(reps):
-        np.asarray(dev + 1)      # +1: a fresh buffer per materialization
-    down_us = (_walltime.perf_counter() - t0) / reps * 1e6
-    return {"dispatch_us": round(up_us, 2), "flush_us": round(down_us, 2)}
+    down_us = readback_us(flows)
+    down_big_us = readback_us(big_flows)
+    mb = (big_flows - flows) * 8 / 2 ** 20
+    slope = max((down_big_us - down_us) / mb, 0.0) if mb > 0 else 0.0
+    return {"dispatch_us": round(up_us, 2), "flush_us": round(down_us, 2),
+            "flush_us_per_mb": round(slope, 2)}
 
 
 def calibrate_child(out_path: str, quick: bool, wall_cap_sec: float,

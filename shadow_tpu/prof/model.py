@@ -253,6 +253,11 @@ class CostModel:
         # skips launch attribution there rather than raise false stale
         # flags on toy tables (tests craft models with tiny points)
         self.min_flows = pts[0][0] if pts else 0
+        # ... and the largest: predictions far ABOVE it are equally
+        # unmeasured (ISSUE 16: a flagship-scale table judged by pure
+        # upward extrapolation would mis-tune the dispatch loop the same
+        # way it would mis-flag prof.model_stale)
+        self.max_flows = pts[-1][0] if pts else 0
 
     # -- raw tables --------------------------------------------------------
     def collective_us(self, kind: str, n_dev: int, width: int) -> float:
@@ -280,10 +285,33 @@ class CostModel:
         """Step-kernel cost of ONE tick at ``flows`` table rows."""
         return max(self._step_a + self._step_b * max(int(flows), 0), 0.0)
 
+    def covers(self, flows: int) -> bool:
+        """True when ``flows`` sits inside the calibrated step-kernel
+        range (with 2x slack each way) — the no-extrapolation guard both
+        launch attribution AND the dispatch auto-tuner sit behind: a
+        prediction outside the measured points is a guess, and guesses
+        neither raise stale flags nor reshape the dispatch loop."""
+        if not self.max_flows:
+            return False
+        f = int(flows)
+        return f * 2 >= self.min_flows and f <= 2 * self.max_flows
+
     def transfer_us(self) -> float:
         tr = self.data["transfer"]
         return float(tr.get("dispatch_us", 0.0)) \
             + float(tr.get("flush_us", 0.0))
+
+    def flush_us_per_mb(self) -> float:
+        """Marginal flush readback cost per MiB of buffer (the measured
+        size slope, ISSUE 16); 0.0 on a pre-16 model that only measured
+        one flush size — delta-compaction then has no measured savings
+        to justify itself and stays off."""
+        return float(self.data["transfer"].get("flush_us_per_mb", 0.0))
+
+    def flush_savings_us(self, bytes_saved: int) -> float:
+        """Predicted per-launch readback saving of shrinking the flush
+        buffer by ``bytes_saved`` bytes."""
+        return self.flush_us_per_mb() * max(int(bytes_saved), 0) / 2 ** 20
 
     # -- scheduler/attribution queries ------------------------------------
     def exchange_tick_us(self, n_dev: int, mode: str, pair_width: int,

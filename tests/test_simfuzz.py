@@ -68,7 +68,8 @@ def test_mode_matrix_axes_all_engaged():
     axes = {"numpy": False, "k1": False, "k8": False, "table_off": False,
             "table_on": False, "mesh": False, "threaded": False,
             "device": False, "exchange_fused": False,
-            "exchange_ppermute": False}
+            "exchange_ppermute": False, "autotune_on": False,
+            "autotune_off": False}
     for seed in range(40):
         spec = draw_spec(seed)
         seen_fams.add(spec["family"])
@@ -98,6 +99,12 @@ def test_mode_matrix_axes_all_engaged():
                 axes["table_on"] = True
             if m["workers"]:
                 axes["threaded"] = True
+            # the auto-tuner axis (ISSUE 16): both sides of the
+            # tuned-vs-hand-defaults digest oracle must appear
+            if m.get("device_autotune", "on") == "off":
+                axes["autotune_off"] = True
+            elif m["device_plane"] == "device":
+                axes["autotune_on"] = True
     missing = sorted(k for k, v in axes.items() if not v)
     assert not missing, f"axes never engaged: {missing} ({seen_modes})"
     assert seen_fams == {"star", "tor", "cdn", "swarm", "phold", "appmix"}

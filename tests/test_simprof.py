@@ -282,11 +282,14 @@ def test_attribution_gauges_and_stale_counter(tmp_path):
     assert scrape["prof.launch_measured_us"]["count"] >= checked
     for key in ("p50", "p95", "p99"):
         assert key in scrape["prof.launch_predicted_us"]
-    # absurd model: predicts ~seconds per tick -> every launch violates
-    # the band -> the counter is LOUD
+    # absurd model: calibrated IN range (the 8-device pad puts 48 kernel
+    # flows on the wire) but predicts ~seconds per tick -> every launch
+    # violates the band -> the counter is LOUD.  (An out-of-range absurd
+    # model must NOT fire — that is the two-sided no-extrapolation guard
+    # pinned below.)
     absurd = _write_model(
         tmp_path, "absurd.json",
-        step_points=[{"flows": 1, "us_per_step": 5e6}], transfer=5e6)
+        step_points=[{"flows": 48, "us_per_step": 5e6}], transfer=5e6)
     ctrl = _run(STAR_XML, cost_model=absurd)
     scrape = ctrl.engine.metrics.scrape()
     assert scrape["prof.model_stale"] > 0
