@@ -9,7 +9,8 @@ PY ?= python
 BASE ?= HEAD
 
 .PHONY: lint lint-diff gen gen-check spec test bench-smoke bench-multichip \
-	fuzz-smoke profile-smoke check native sanitize sanitize-thread
+	fuzz-smoke profile-smoke fault-smoke check native sanitize \
+	sanitize-thread
 
 lint: gen-check
 	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu
@@ -83,8 +84,20 @@ profile-smoke:
 		--wall-cap-sec 240 --out /tmp/shadow-profile-smoke.json
 	JAX_PLATFORMS=cpu $(PY) -m shadow_tpu.prof check
 
-# the lint-adjacent gate set: static analysis + the fuzz + profile smokes
-check: lint fuzz-smoke profile-smoke
+# the self-healing drill sweep (ISSUE 17): every rung of the recovery
+# ladder — shard resurrection, mid-run device-loss re-shard, demote ->
+# probation -> re-promotion — run end to end on the 8-virtual-device CPU
+# mesh, gated BOTH ways (detour counted on the supervision ledger AND
+# the drilled run lands its fault-free twin's exact digest); drill rows
+# persist to BENCH_HISTORY.jsonl.
+fault-smoke:
+	JAX_PLATFORMS=cpu \
+	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		$(PY) bench.py --fault-smoke
+
+# the lint-adjacent gate set: static analysis + the fuzz/profile/fault
+# smokes
+check: lint fuzz-smoke profile-smoke fault-smoke
 
 native:
 	$(MAKE) -C native

@@ -318,6 +318,14 @@ def _run_sim(xml, policy: str, workers: int, stop: int, **opt_kw) -> dict:
         # cost) must stay pinned at ~0
         "recoveries": scrape["supervision.recoveries"],
         "watchdog_overhead_sec": scrape["supervision.watchdog_overhead_sec"],
+        # self-healing detour ledger (ISSUE 17): fail-closed — read
+        # straight from the scrape (a KeyError means the ledger
+        # regressed) and all 0 in a healthy bench run; `make fault-smoke`
+        # proves the nonzero side of each counter
+        "resurrections": scrape["supervision.shard_resurrections"],
+        "reshards": scrape["supervision.reshards"],
+        "repromotions": scrape["supervision.repromotions"],
+        "mttr_sec": scrape["supervision.mttr_sec"],
         # disabled-path cost of the observability plane (ISSUE 3),
         # measured in its two real forms: ~6 null-span engine hooks per
         # round, plus one bare enabled-check per event as an upper bound
@@ -1458,6 +1466,150 @@ def bench_smoke() -> int:
     return 0
 
 
+FAULT_SMOKE_XML = """<shadow stoptime="30">
+  <topology><![CDATA[<?xml version="1.0" encoding="UTF-8"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+<key id="d0" for="edge" attr.name="latency" attr.type="double"/>
+<key id="d1" for="edge" attr.name="packetloss" attr.type="double"/>
+<graph edgedefault="undirected">
+  <node id="n0" />
+  <edge source="n0" target="n0"><data key="d0">25.0</data><data key="d1">0.02</data></edge>
+</graph></graphml>]]></topology>
+  <plugin id="tgen" path="python:tgen" />
+  <plugin id="echo" path="python:echo" />
+  <host id="server"><process plugin="tgen" starttime="1" arguments="server 80" /></host>
+  <host id="c1"><process plugin="tgen" starttime="2" arguments="client server 80 1024:102400" /></host>
+  <host id="u1"><process plugin="echo" starttime="1" arguments="udp server 9000" /></host>
+  <host id="u2"><process plugin="echo" starttime="2" arguments="udp client u1 9000 10 700" /></host>
+</shadow>
+"""
+
+
+def bench_fault_smoke() -> int:
+    """``make fault-smoke`` (ISSUE 17): the self-healing drill sweep.
+    Runs each rung of the recovery ladder end to end — shard
+    resurrection, mid-run device-loss re-shard, demote -> probation ->
+    re-promotion — and fail-closed gates BOTH sides: every drilled
+    detour must be counted on the supervision ledger with a nonzero
+    MTTR, and every drilled run must land the exact digest of its
+    fault-free twin.  Drill rows survive in BENCH_HISTORY.jsonl.
+    Prints one JSON line; exits 1 on any gate miss."""
+    import sys
+
+    from shadow_tpu.core import configuration
+    from shadow_tpu.core.checkpoint import state_digest
+    from shadow_tpu.core.controller import Controller
+    from shadow_tpu.core.logger import SimLogger, set_logger
+    from shadow_tpu.core.options import Options
+    from shadow_tpu.parallel.procs import ProcsController
+    from shadow_tpu.tools import workloads
+
+    set_logger(SimLogger(level="warning"))
+    failures = []
+    out = {}
+
+    def _engine_run(xml, stop, **kw):
+        cfg = configuration.parse_xml(xml)
+        cfg.stop_time_sec = stop
+        ctrl = Controller(Options(scheduler_policy="global", workers=0,
+                                  seed=3, stop_time_sec=stop,
+                                  log_level="warning", **kw), cfg)
+        rc = ctrl.run()
+        return rc, ctrl.engine
+
+    # -- rung 1: shard resurrection --------------------------------------
+    t0 = time.perf_counter()
+    clean = ProcsController(
+        Options(scheduler_policy="global", workers=0, seed=7,
+                stop_time_sec=30, processes=2, log_level="warning"),
+        configuration.parse_xml(FAULT_SMOKE_XML))
+    rc_c = clean.run()
+    res = ProcsController(
+        Options(scheduler_policy="global", workers=0, seed=7,
+                stop_time_sec=30, processes=2, log_level="warning",
+                fault_inject="shard-exit-resurrect:1:3"),
+        configuration.parse_xml(FAULT_SMOKE_XML))
+    rc_r = res.run()
+    sup = res.supervision.summary()
+    out["resurrect"] = {
+        "rc": rc_r, "digest_match": res.digest == clean.digest,
+        "resurrections": sup["shard_resurrections"],
+        "mttr_sec": sup["mttr_sec"],
+        "wall_sec": round(time.perf_counter() - t0, 1)}
+    if rc_c != 0 or rc_r != 0:
+        failures.append(f"resurrection drill rc clean={rc_c} drilled={rc_r}")
+    elif not out["resurrect"]["digest_match"]:
+        failures.append("resurrected run digest != fault-free digest")
+    elif sup["shard_resurrections"] != 1 or sup["mttr_sec"] <= 0:
+        failures.append(f"resurrection not on the ledger: {sup}")
+
+    # -- rung 2: device-loss re-shard (needs a multi-device mesh) --------
+    import jax
+    n_dev = len(jax.devices())
+    star = workloads.star_bulk(6, stoptime=120,
+                               bulk_bytes=192 * 1024 * 1024,
+                               device_data=True)
+    if n_dev < 2:
+        # same contract as the multichip smoke: a single-chip environment
+        # is a fact to record, not a failure (the Makefile target forces
+        # the 8-virtual-device CPU mesh, so this is off-label use only)
+        out["device_lost"] = {"skipped": f"{n_dev} device(s) visible"}
+    else:
+        t0 = time.perf_counter()
+        d = min(n_dev, 8)
+        rc_c, eng_c = _engine_run(star, 120, device_plane="device",
+                                  superwindow_rounds=8, tpu_devices=d)
+        rc_l, eng_l = _engine_run(star, 120, device_plane="device",
+                                  superwindow_rounds=8, tpu_devices=d,
+                                  fault_inject="device-lost:3")
+        sup = eng_l.supervision.summary()
+        out["device_lost"] = {
+            "rc": rc_l, "n_devices": d,
+            "digest_match": state_digest(eng_l) == state_digest(eng_c),
+            "reshards": sup["reshards"], "mttr_sec": sup["mttr_sec"],
+            "wall_sec": round(time.perf_counter() - t0, 1)}
+        if rc_c != 0 or rc_l != 0:
+            failures.append(f"device-lost drill rc clean={rc_c} "
+                            f"drilled={rc_l}")
+        elif not out["device_lost"]["digest_match"]:
+            failures.append("re-sharded run digest != fault-free digest")
+        elif sup["reshards"] != 1 or sup["mttr_sec"] <= 0:
+            failures.append(f"re-shard not on the ledger: {sup}")
+
+    # -- rung 3: demote -> probation -> re-promotion ---------------------
+    t0 = time.perf_counter()
+    rc_c, eng_c = _engine_run(star, 120, device_plane="device")
+    rc_p, eng_p = _engine_run(star, 120, device_plane="device",
+                              fault_inject="demote-repromote:2",
+                              repromote_after=3)
+    sup = eng_p.supervision.summary()
+    plane = eng_p.device_plane
+    out["repromote"] = {
+        "rc": rc_p,
+        "digest_match": state_digest(eng_p) == state_digest(eng_c),
+        "repromotions": sup["repromotions"],
+        "back_on_device": plane.mode == "device" and not plane.demoted,
+        "wall_sec": round(time.perf_counter() - t0, 1)}
+    if rc_c != 0 or rc_p != 0:
+        failures.append(f"repromote drill rc clean={rc_c} drilled={rc_p}")
+    elif not out["repromote"]["digest_match"]:
+        failures.append("re-promoted run digest != fault-free digest")
+    elif sup["repromotions"] != 1 or not out["repromote"]["back_on_device"]:
+        failures.append(f"re-promotion did not climb back: {sup}")
+
+    # the trend ledger: drill rows survive pass or fail (the trajectory
+    # must record regressions, not only good rounds)
+    from shadow_tpu.prof.ledger import append_bench_rows
+    out["history_appended"] = append_bench_rows({"fault_drills": out})
+    print(json.dumps({"fault_smoke": out, "pass": not failures,
+                      "failures": failures}), flush=True)
+    if failures:
+        print("FAULT SMOKE FAILURES: " + "; ".join(failures),
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
 def main() -> None:
     import sys
 
@@ -1479,6 +1631,8 @@ def main() -> None:
         sys.exit(0 if (row.get("ok") or row.get("skipped")) else 1)
     if "--smoke" in sys.argv:
         sys.exit(bench_smoke())
+    if "--fault-smoke" in sys.argv:
+        sys.exit(bench_fault_smoke())
 
     import jax
 

@@ -11,16 +11,27 @@ protocol at analysis time instead:
    child side (``f`` plus the local functions it calls) and the parent
    side (the spawning function plus its local helpers) into small
    op-automata: SEND(tag, arity), RECV{tag -> branch, default}, END,
-   ABORT.  ``conn.send(("tag", ...))`` is a SEND; ``X = conn.recv()``
-   followed by ``if X[0] == "tag":`` chains compiles into the RECV's
-   branch table (the remaining statements are its default branch).
-   Calls to local functions/methods that (transitively) contain
-   protocol ops are inlined.  Fan-out over the connection list
-   (``for c in conns: c.send(...)``, ``[recv(c) for c in conns]``)
-   collapses to ONE logical peer — shards are symmetric.  ``raise`` /
-   ``os._exit`` are ABORT (crash states the shard supervision owns);
-   sends inside ``except`` handlers register in the sent-tag set but
-   stay out of the happy-path automaton.
+   ABORT.  ``conn.send(("tag", ...))`` is a SEND — and so is a literal
+   tuple routed through a local send wrapper (``self._send(sid,
+   ("tag", ...))`` where the wrapper's body sends a bound parameter:
+   the self-healing controller wraps every parent-side send for death
+   supervision); ``X = conn.recv()`` followed by ``if X[0] == "tag":``
+   chains compiles into the RECV's branch table (the remaining
+   statements are its default branch).  Calls to local
+   functions/methods that (transitively) contain protocol ops are
+   inlined; ``return`` is a function exit (jumping to the inline
+   continuation, never a loop backedge).  When the ``Process`` spawn
+   lives in a protocol-silent helper, the parent root hoists to the
+   outermost local caller — the drive loop, not the fork.  Crash-retry
+   guards (``if not sent[sid]: send; sent[sid] = True`` / ``if sid not
+   in outs: outs[sid] = recv``) compile happy-path-unconditional: the
+   flag starts false and flips only in the body, and the re-entry
+   where it holds arrives via an except handler.  Fan-out over the
+   connection list (``for c in conns: c.send(...)``, ``[recv(c) for c
+   in conns]``) collapses to ONE logical peer — shards are symmetric.
+   ``raise`` / ``os._exit`` are ABORT (crash states the shard
+   supervision owns); sends inside ``except`` handlers register in the
+   sent-tag set but stay out of the happy-path automaton.
 
 2. **model check** — explore the product of the two automata with
    bounded message queues (sends never block on a pipe this small).
@@ -83,11 +94,12 @@ class _Resume(ast.stmt):
     the post-dispatch tail it was cut out of."""
     _fields = ()
 
-    def __init__(self, rest, cont, loops):
+    def __init__(self, rest, cont, loops, ret):
         super().__init__()
         self.rest = rest
         self.cont = cont
         self.loops = loops
+        self.ret = ret
 
 
 # ---------------------------------------------------------------------------
@@ -115,10 +127,45 @@ class _SideExtractor:
                 call.func.attr == "send" and len(call.args) == 1):
             return None
         arg = call.args[0]
+        return _SideExtractor._literal_tag(arg)
+
+    @staticmethod
+    def _literal_tag(arg: ast.AST) -> Optional[Tuple[str, int]]:
         if isinstance(arg, ast.Tuple) and arg.elts and \
                 isinstance(arg.elts[0], ast.Constant) and \
                 isinstance(arg.elts[0].value, str):
             return arg.elts[0].value, len(arg.elts)
+        return None
+
+    def _wrapper_send_payload(self, call: ast.Call
+                              ) -> Optional[Tuple[str, int]]:
+        """(tag, arity) when ``call`` routes a literal tuple through a
+        local send wrapper: ``self._send(sid, ("tag", ...))`` where the
+        wrapper's body does ``X.send(msg)`` on a bound parameter (the
+        self-healing controller wraps every parent-side send so pipe
+        death is caught uniformly).  The literal payload is bound by
+        parameter position, so the automaton sees the real tag."""
+        qual = self._inlineable(call)
+        if qual is None:
+            return None
+        fn = self.funcs[qual]
+        params = [a.arg for a in fn.args.args]
+        sent_param = None
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "send" and len(node.args) == 1 and \
+                    isinstance(node.args[0], ast.Name) and \
+                    node.args[0].id in params:
+                sent_param = node.args[0].id
+                break
+        if sent_param is None:
+            return None
+        idx = params.index(sent_param)
+        if isinstance(call.func, ast.Attribute):
+            idx -= 1                       # self.X(...) binds `self`
+        if 0 <= idx < len(call.args):
+            return self._literal_tag(call.args[idx])
         return None
 
     @staticmethod
@@ -158,7 +205,8 @@ class _SideExtractor:
         for node in self._scope_walk(stmt):
             if not isinstance(node, ast.Call):
                 continue
-            if self._send_payload(node) is not None:
+            if self._send_payload(node) is not None or \
+                    self._wrapper_send_payload(node) is not None:
                 out.append(("send", node))
             elif self._is_recv_call(node) is not None:
                 out.append(("recv", node))
@@ -218,25 +266,36 @@ class _SideExtractor:
         self._inline_stack.append(qual)
         try:
             return self._compile_stmts(list(self.funcs[qual].body), cont,
-                                       [])
+                                       [], cont)
         finally:
             self._inline_stack.pop()
 
     def _compile_stmts(self, stmts: List[ast.stmt], cont: Node,
-                       loops: List[Tuple[Node, Node]]) -> Node:
+                       loops: List[Tuple[Node, Node]],
+                       ret: Optional[Node] = None) -> Node:
         """Compile a statement list; ``loops`` is the (continue_target,
-        break_target) stack."""
+        break_target) stack and ``ret`` the enclosing function's exit
+        continuation (``return`` jumps there — NOT the loop backedge;
+        an unmodeled return inside ``_recv_supervised``'s watchdog loop
+        would otherwise fall through into a phantom second recv)."""
         if not stmts:
             return cont
         stmt, rest = stmts[0], stmts[1:]
 
         if isinstance(stmt, _Resume):
-            return self._compile_stmts(stmt.rest, stmt.cont, stmt.loops)
+            return self._compile_stmts(stmt.rest, stmt.cont, stmt.loops,
+                                       stmt.ret)
+        if isinstance(stmt, ast.Return):
+            tail = ret if ret is not None else cont
+            actions = self._actions(stmt)
+            if actions:
+                return self._chain_actions(stmt, actions, tail)
+            return tail
         if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             # a nested def is a DEFINITION, not execution — its body only
             # enters the automaton where the function is called
-            return self._compile_stmts(rest, cont, loops)
+            return self._compile_stmts(rest, cont, loops, ret)
 
         # -- msg = conn.recv() followed by tag-dispatch ifs ----------------
         recv_assign = self._recv_assignment(stmt)
@@ -267,28 +326,29 @@ class _SideExtractor:
                     node.branch_use[tag] = self._max_use(list(body),
                                                          tagvars)
                     node.branches[tag] = self._compile_stmts(
-                        list(body) + [_Resume(rest[i + 1:], cont, loops)],
-                        cont, loops)
+                        list(body) + [_Resume(rest[i + 1:], cont, loops, ret)],
+                        cont, loops, ret)
                 i += 1
                 if else_body is not None:
                     break       # the else IS the unknown-tag path
             if else_body is not None:
                 node.default = self._compile_stmts(
-                    list(else_body) + [_Resume(rest[i:], cont, loops)],
-                    cont, loops)
+                    list(else_body) + [_Resume(rest[i:], cont, loops, ret)],
+                    cont, loops, ret)
                 node.use_idx = max(use, self._max_use(list(else_body),
                                                       tagvars))
             else:
-                node.default = self._compile_stmts(rest[i:], cont, loops)
+                node.default = self._compile_stmts(rest[i:], cont,
+                                                   loops, ret)
                 node.use_idx = max(use, self._max_use(rest[i:], tagvars))
             return node
 
         # -- control flow --------------------------------------------------
         if isinstance(stmt, ast.While):
-            after = self._compile_stmts(rest, cont, loops)
+            after = self._compile_stmts(rest, cont, loops, ret)
             header = Node("branch", stmt)
             body = self._compile_stmts(list(stmt.body), header,
-                                       loops + [(header, after)])
+                                       loops + [(header, after)], ret)
             # `while True:` only exits through break — a phantom exit
             # edge would let the model skip mandatory protocol turns
             infinite = isinstance(stmt.test, ast.Constant) and \
@@ -297,27 +357,44 @@ class _SideExtractor:
             return header
         if isinstance(stmt, (ast.For, ast.AsyncFor)):
             # fan-out loop over the symmetric peer set: body ONCE
-            after = self._compile_stmts(rest, cont, loops)
+            after = self._compile_stmts(rest, cont, loops, ret)
             return self._compile_stmts(list(stmt.body), after,
-                                       loops + [(after, after)])
+                                       loops + [(after, after)], ret)
         if isinstance(stmt, ast.If):
-            after = self._compile_stmts(rest, cont, loops)
+            if self._retry_guard(stmt):
+                # a crash-retry guard (`if not sent[sid]: send(...);
+                # sent[sid] = True` / `if sid not in outs: outs[sid] =
+                # recv(...)`) is ALWAYS taken on the happy path: its flag
+                # starts false and flips only inside the body, and the
+                # re-entry where it can be true arrives via an except
+                # handler — a path the automaton already scopes out as
+                # crash-state coverage.  Compiling it as a nondeterministic
+                # branch would let the model skip a mandatory send yet
+                # still reach the paired recv: a phantom mutual wait.
+                return self._compile_stmts(list(stmt.body) + rest, cont,
+                                           loops, ret)
+            after = self._compile_stmts(rest, cont, loops, ret)
             br = Node("branch", stmt)
-            br.succ = [self._compile_stmts(list(stmt.body), after, loops),
-                       self._compile_stmts(list(stmt.orelse), after, loops)]
+            br.succ = [self._compile_stmts(list(stmt.body), after, loops,
+                                           ret),
+                       self._compile_stmts(list(stmt.orelse), after, loops,
+                                           ret)]
             return br
         if isinstance(stmt, (ast.With, ast.AsyncWith)):
-            return self._compile_stmts(list(stmt.body) + rest, cont, loops)
+            return self._compile_stmts(list(stmt.body) + rest, cont,
+                                       loops, ret)
         if isinstance(stmt, ast.Try):
             # except-handler sends register as crash-path coverage only
             for h in stmt.handlers:
                 for sub in ast.walk(h):
                     if isinstance(sub, ast.Call):
-                        p = self._send_payload(sub)
+                        p = self._send_payload(sub) or \
+                            self._wrapper_send_payload(sub)
                         if p is not None:
                             self.sent.add(p)
             return self._compile_stmts(
-                list(stmt.body) + list(stmt.finalbody) + rest, cont, loops)
+                list(stmt.body) + list(stmt.finalbody) + rest, cont, loops,
+                ret)
         if isinstance(stmt, ast.Break):
             return loops[-1][1] if loops else cont
         if isinstance(stmt, ast.Continue):
@@ -334,8 +411,8 @@ class _SideExtractor:
         if actions:
             return self._chain_actions(stmt, actions,
                                        self._compile_stmts(rest, cont,
-                                                           loops))
-        return self._compile_stmts(rest, cont, loops)
+                                                           loops, ret))
+        return self._compile_stmts(rest, cont, loops, ret)
 
     def _chain_actions(self, stmt: ast.stmt,
                        actions: List[Tuple[str, ast.Call]],
@@ -343,7 +420,8 @@ class _SideExtractor:
         head = cont
         for kind, call in reversed(actions):
             if kind == "send":
-                payload = self._send_payload(call)
+                payload = self._send_payload(call) or \
+                    self._wrapper_send_payload(call)
                 n = Node("send", call)
                 n.tag, n.arity = payload
                 self.sent.add(payload)
@@ -448,6 +526,49 @@ class _SideExtractor:
             else:
                 break
         return (out, None) if out else None
+
+    @staticmethod
+    def _expr_key(node: ast.AST) -> str:
+        """Structural identity for guard/target matching, Load/Store
+        context ignored (``run_sent[sid]`` tested vs assigned)."""
+        import re
+        return re.sub(r",?\s*ctx=(Load|Store|Del)\(\)", "",
+                      ast.dump(node))
+
+    @staticmethod
+    def _retry_guard(stmt: ast.If) -> bool:
+        """``if not flag[i]: ...; flag[i] = True`` or ``if k not in d:
+        d[k] = ...`` with no else — the self-healing re-drive idiom (the
+        body sets the very condition it tested, so the first reach on the
+        happy path always executes it)."""
+        if stmt.orelse:
+            return False
+        t = stmt.test
+        key = _SideExtractor._expr_key
+        if isinstance(t, ast.UnaryOp) and isinstance(t.op, ast.Not):
+            flag = key(t.operand)
+            for sub in stmt.body:
+                for n in ast.walk(sub):
+                    if isinstance(n, ast.Assign) and \
+                            isinstance(n.value, ast.Constant) and \
+                            n.value.value is True and \
+                            any(key(tg) == flag for tg in n.targets):
+                        return True
+            return False
+        if isinstance(t, ast.Compare) and len(t.ops) == 1 and \
+                isinstance(t.ops[0], ast.NotIn) and \
+                isinstance(t.comparators[0], ast.Name):
+            needle, container = key(t.left), t.comparators[0].id
+            for sub in stmt.body:
+                for n in ast.walk(sub):
+                    if isinstance(n, ast.Assign):
+                        for tg in n.targets:
+                            if isinstance(tg, ast.Subscript) and \
+                                    isinstance(tg.value, ast.Name) and \
+                                    tg.value.id == container and \
+                                    key(tg.slice) == needle:
+                                return True
+        return False
 
     @staticmethod
     def _max_use(stmts: List[ast.stmt], tagvars: Set[str]) -> int:
@@ -651,8 +772,51 @@ class ShardProtocolRule:
                            if fi.node is fn), None)
             if parent is None:
                 continue
-            return parent, child
+            return ShardProtocolRule._hoist_root(mc, parent, child), child
         return None
+
+    @staticmethod
+    def _hoist_root(mc, parent: str, child: str) -> str:
+        """Root the parent automaton at the OUTERMOST local caller of the
+        spawning function.  The self-healing controller moved the
+        ``Process(...)`` call into a respawn helper (``_spawn``) that is
+        itself protocol-silent; the conversation lives in the drive loop
+        that (transitively) calls it.  Hoisting walks the local call
+        graph upward and picks the unique caller no other caller reaches;
+        when the spawn already sits in the driver (no local callers),
+        this is the identity."""
+        edges: Dict[str, Set[str]] = {}
+        for q, fi in mc.funcs.items():
+            out: Set[str] = set()
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                name = None
+                if isinstance(f, ast.Name):
+                    name = f.id
+                elif isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Name) and \
+                        f.value.id == "self":
+                    name = f.attr
+                if name is None:
+                    continue
+                for q2 in mc.funcs:
+                    if q2 == name or q2.endswith(f".{name}"):
+                        out.add(q2)
+            edges[q] = out
+        callers = {parent}
+        changed = True
+        while changed:
+            changed = False
+            for q, out in edges.items():
+                if q != child and q not in callers and out & callers:
+                    callers.add(q)
+                    changed = True
+        roots = [q for q in sorted(callers)
+                 if not any(q in edges[o] for o in sorted(callers)
+                            if o != q)]
+        return roots[0] if len(roots) == 1 else parent
 
     def check_module(self, ctx: ModuleContext, parent_qual: str,
                      child_qual: str) -> List[Finding]:

@@ -142,10 +142,13 @@ def save_state(state: Dict, path: str, options_info: Dict) -> str:
     """Stamp ``state`` with its digest + run options and pickle it to disk
     (shared by the engine-side writer and the procs parent).
 
-    The write is atomic (tmp + rename): a run SIGKILLed mid-write can never
-    leave a truncated file under the snapshot name, so every file a resume
-    scan sees is either complete or absent — the property crash recovery
-    leans on."""
+    The write is atomic (tmp + fsync + rename + DIRECTORY fsync): a run
+    SIGKILLed mid-write can never leave a truncated file under the snapshot
+    name, and the rename itself is made crash-durable — on ext4 and
+    friends, tmp+fsync+rename alone persists the bytes but not necessarily
+    the new NAME, so a power cut could forget the snapshot existed.  Every
+    file a resume scan sees is therefore complete, named, and durable —
+    the property crash recovery leans on."""
     state["digest"] = digest_of_state(state)
     state["options"] = options_info
     tmp = path + ".tmp"
@@ -154,6 +157,11 @@ def save_state(state: Dict, path: str, options_info: Dict) -> str:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    dirfd = os.open(os.path.dirname(os.path.abspath(path)), os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
     return state["digest"]
 
 
@@ -169,9 +177,27 @@ def save_snapshot(engine, path: str) -> str:
 def load_snapshot(path: str, verify: bool = False) -> Dict:
     """Load a snapshot; ``verify=True`` additionally recomputes the digest
     over the carried state and raises ``ValueError`` on mismatch — the
-    defense against a corrupt/tampered file silently seeding a resume."""
+    defense against a corrupt/tampered file silently seeding a resume.
+
+    Trailing garbage past the pickled payload is TOLERATED with a warning
+    (the BENCH_HISTORY.jsonl torn-final-entry pattern): a crash during an
+    append-style rewrite can leave a complete snapshot followed by a torn
+    partial write, and 'resume from the last GOOD state' means reading the
+    complete prefix, not refusing the file.  The digest verification below
+    still judges what was actually loaded, so a torn PREFIX (truncated
+    pickle) keeps failing loudly."""
     with open(path, "rb") as f:
         snap = pickle.load(f)
+        trailing = len(f.read())
+    if trailing:
+        from .logger import get_logger
+        get_logger().warning(
+            "checkpoint",
+            f"snapshot {path!r}: skipping {trailing} bytes of trailing "
+            "garbage after the payload (torn final write tolerated)")
+    if not isinstance(snap, dict):
+        raise ValueError(f"snapshot {path!r} is corrupt: payload is "
+                         f"{type(snap).__name__}, not a state dict")
     if verify:
         core = {k: v for k, v in snap.items()
                 if k not in ("digest", "options")}

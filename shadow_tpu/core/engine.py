@@ -410,6 +410,8 @@ class Engine:
                 # executor back to the per-event path
                 out["native.round_windows"] = pol.round_windows
                 out["native.round_demoted"] = int(pol.round_demoted)
+                out["native.round_repromoted"] = int(
+                    getattr(pol, "round_repromoted", False))
             # batched continuation plane (ISSUE 12): green-thread resumes
             # delivered per py_exec_batch call vs one-callback-each
             # (getattr: test stand-in planes predate the ledger)

@@ -131,6 +131,16 @@ class Options:
                                          # shard detection, always on)
     fault_inject: str = ""               # deterministic fault harness
                                          # (supervision.parse_fault_inject)
+    max_resurrections: int = 3           # --max-resurrections: dead-shard
+                                         # respawn budget per run (ISSUE 17);
+                                         # exceeded = abort loudly (the
+                                         # PR-2 diagnostic), 0 = never
+                                         # resurrect (PR-2 behavior)
+    repromote_after: int = 0             # --repromote-after R: after a
+                                         # demotion, re-attempt the faster
+                                         # rung ONCE after R clean rounds
+                                         # with the replay guard armed
+                                         # (0 = demotions stay permanent)
     # Observability (shadow_tpu/obs/): flight-recorder tracing + metrics
     trace_path: Optional[str] = None     # --trace: Chrome trace-event JSON
                                          # (Perfetto-loadable) written at
@@ -207,7 +217,24 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deterministic fault-injection harness (tests): "
                         "device-dispatch:N | device-dispatch-hang:N | "
                         "plugin-stall:NAME:NREQ | shard-exit:SID:ROUND | "
-                        "native-round:N")
+                        "native-round:N | continuation-batch:N | "
+                        "shard-exit-resurrect:SID:ROUND | device-lost:ROUND "
+                        "| demote-repromote:N")
+    p.add_argument("--max-resurrections", type=int, default=3,
+                   dest="max_resurrections",
+                   help="respawn a dead shard from the newest verifying "
+                        "snapshot (round-zero replay when none exists) up "
+                        "to N times per run, with exponential backoff "
+                        "between attempts; the budget exhausted aborts "
+                        "loudly (0 = never resurrect, abort on first death)")
+    p.add_argument("--repromote-after", type=int, default=0,
+                   dest="repromote_after",
+                   help="recovery-ladder probation: after a demotion "
+                        "(device plane -> numpy twin, native executor -> "
+                        "per-event), re-attempt the faster rung ONCE after "
+                        "R clean rounds with the window-replay guard armed; "
+                        "a repeat fault re-demotes permanently (0 = "
+                        "demotions stay permanent)")
     p.add_argument("--interface-batch", type=int, default=1, dest="interface_batch_ms")
     p.add_argument("--router-queue", choices=ROUTER_QUEUE_KINDS, default="codel",
                    dest="router_queue")

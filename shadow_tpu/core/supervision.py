@@ -33,13 +33,18 @@ class SupervisionStats:
 
     __slots__ = ("plugin_watchdog_kills", "dispatch_recoveries",
                  "shard_deaths_detected", "native_round_demotions",
-                 "overhead_ns", "resume_path", "resume_verified")
+                 "shard_resurrections", "reshards", "repromotions",
+                 "mttr_ns", "overhead_ns", "resume_path", "resume_verified")
 
     def __init__(self) -> None:
         self.plugin_watchdog_kills = 0
         self.dispatch_recoveries = 0
         self.shard_deaths_detected = 0
         self.native_round_demotions = 0
+        self.shard_resurrections = 0
+        self.reshards = 0
+        self.repromotions = 0
+        self.mttr_ns = 0
         self.overhead_ns = 0
         self.resume_path: Optional[str] = None
         self.resume_verified = False
@@ -47,7 +52,9 @@ class SupervisionStats:
     @property
     def recoveries(self) -> int:
         return (self.plugin_watchdog_kills + self.dispatch_recoveries
-                + self.shard_deaths_detected + self.native_round_demotions)
+                + self.shard_deaths_detected + self.native_round_demotions
+                + self.shard_resurrections + self.reshards
+                + self.repromotions)
 
     @staticmethod
     def _dump_flight_recorder(reason: str) -> None:
@@ -83,6 +90,45 @@ class SupervisionStats:
             "the per-event path — executor permanently demoted")
         self._dump_flight_recorder("native round executor demotion")
 
+    def count_shard_resurrection(self, sid: int, attempt: int,
+                                 mttr_ns: int) -> None:
+        """A dead shard was respawned, deterministically replayed to the
+        round barrier, digest-verified at the join boundary, and the run
+        CONTINUED (ISSUE 17) — a bounded, measured detour rather than an
+        abort.  ``mttr_ns`` is detection → rejoin wall time."""
+        self.shard_resurrections += 1
+        self.mttr_ns += mttr_ns
+        get_logger().warning(
+            "supervision",
+            f"shard {sid} resurrected (attempt {attempt}) and rejoined the "
+            f"round barrier after {mttr_ns / 1e9:.2f}s — run continues")
+        self._dump_flight_recorder(f"shard resurrection: {sid}")
+
+    def count_reshard(self, n_before: int, n_after: int,
+                      mttr_ns: int = 0) -> None:
+        """The sharded mesh lost a device mid-run and re-partitioned onto
+        the survivors at a quiesced boundary, with the state translation
+        digest-pinned before == after (ROADMAP 4(b))."""
+        self.reshards += 1
+        self.mttr_ns += mttr_ns
+        get_logger().warning(
+            "supervision",
+            f"mesh re-sharded {n_before} -> {n_after} devices at a "
+            "quiesced boundary; re-layout digest verified — run continues")
+        self._dump_flight_recorder(f"mesh re-shard: {n_before}->{n_after}")
+
+    def count_repromotion(self, rung: str, after_rounds: int) -> None:
+        """A demoted rung climbed back after its probation: ``after_rounds``
+        clean rounds passed, the faster path was re-attempted with the
+        replay guard armed, and it held.  One shot only — a second fault on
+        the same rung re-demotes permanently (ISSUE 17)."""
+        self.repromotions += 1
+        get_logger().warning(
+            "supervision",
+            f"{rung} re-promoted after {after_rounds} clean probation "
+            "rounds — replay guard stays armed; next fault is permanent")
+        self._dump_flight_recorder(f"re-promotion: {rung}")
+
     def summary(self) -> Dict:
         return {
             "recoveries": self.recoveries,
@@ -90,6 +136,10 @@ class SupervisionStats:
             "dispatch_recoveries": self.dispatch_recoveries,
             "shard_deaths_detected": self.shard_deaths_detected,
             "native_round_demotions": self.native_round_demotions,
+            "shard_resurrections": self.shard_resurrections,
+            "reshards": self.reshards,
+            "repromotions": self.repromotions,
+            "mttr_sec": round(self.mttr_ns / 1e9, 4),
             "watchdog_overhead_sec": round(self.overhead_ns / 1e9, 4),
         }
 
@@ -114,7 +164,18 @@ def parse_fault_inject(spec: str) -> Optional[Dict]:
     * ``continuation-batch:N``   — the Nth batched-continuation delivery
       (py_exec_batch) raises mid-window, exercising demotion to the
       per-event pop loop where continuations deliver one callback each
-      (ISSUE 12).
+      (ISSUE 12);
+    * ``shard-exit-resurrect:SID:ROUND`` — shard SID hard-exits at round
+      ROUND exactly like ``shard-exit``, but the parent is expected to
+      RESURRECT it (respawn + deterministic replay to the barrier) rather
+      than abort — the self-healing drill (ISSUE 17);
+    * ``device-lost:ROUND``      — the sharded mesh "loses" a device at
+      round ROUND: the plane re-partitions onto D-1 survivors at the next
+      quiesced boundary with the re-layout digest pinned (ISSUE 17);
+    * ``demote-repromote:N``     — the Nth device dispatch is poisoned like
+      ``device-dispatch:N`` but the demotion is expected to heal: after
+      ``--repromote-after`` clean rounds the plane re-attempts the device
+      rung once (ISSUE 17).
     """
     if not spec:
         return None
@@ -129,11 +190,21 @@ def parse_fault_inject(spec: str) -> Optional[Dict]:
             raise ValueError(
                 f"--fault-inject {spec!r}: expected plugin-stall:NAME:NREQ")
         return {"kind": kind, "name": parts[1], "nreq": int(parts[2])}
-    if kind == "shard-exit":
+    if kind in ("shard-exit", "shard-exit-resurrect"):
         if len(parts) != 3:
             raise ValueError(
-                f"--fault-inject {spec!r}: expected shard-exit:SID:ROUND")
+                f"--fault-inject {spec!r}: expected {kind}:SID:ROUND")
         return {"kind": kind, "shard": int(parts[1]), "round": int(parts[2])}
+    if kind == "device-lost":
+        if len(parts) != 2:
+            raise ValueError(f"--fault-inject {spec!r}: expected "
+                             "device-lost:ROUND")
+        return {"kind": kind, "round": int(parts[1])}
+    if kind == "demote-repromote":
+        if len(parts) != 2:
+            raise ValueError(f"--fault-inject {spec!r}: expected "
+                             "demote-repromote:N")
+        return {"kind": kind, "dispatch": int(parts[1])}
     if kind == "native-round":
         if len(parts) != 2:
             raise ValueError(f"--fault-inject {spec!r}: expected "

@@ -113,6 +113,18 @@ def flow_modes(rng) -> List[Dict]:
     # Appended AFTER all rng draws — the draw stream (and thus every
     # historical seed's scenario) is unchanged.
     modes.append(_mode("autotune-off", device_autotune="off"))
+    # recovery axes (ISSUE 17), appended after all draws for the same
+    # reason (the mesh-lost leg reuses the d drawn above — no new draw):
+    # checkpoint+--resume faces the digest-parity oracle, and the
+    # self-healing drills (mid-run device loss re-shard, demote ->
+    # probation -> re-promotion) must land the SAME digest as the
+    # fault-free base — recovery is a detour, never a different simulation
+    modes.append(_mode("resume", resume=True))
+    modes.append(_mode("mesh-lost", tpu_devices=d,
+                       engine_fault="device-lost:3"))
+    modes.append(_mode("demote-repromote",
+                       engine_fault="demote-repromote:2",
+                       repromote_after=3))
     return modes
 
 
@@ -131,6 +143,16 @@ def app_modes(rng, n_hosts: int) -> List[Dict]:
     ]
     if n_hosts >= 4 and rng.integers(0, 2):
         modes.append(_mode("procs", processes=2, events_comparable=False))
+    # recovery axes (ISSUE 17), appended AFTER all rng draws so every
+    # historical seed's scenario replays unchanged: checkpoint+--resume
+    # parity, and — when the host count supports sharding — a SIGKILL'd
+    # shard resurrected mid-run that must still land the base digest.
+    modes.append(_mode("resume", resume=True))
+    if n_hosts >= 4:
+        modes.append(_mode("procs-resurrect", processes=2,
+                           events_comparable=False,
+                           engine_fault="shard-exit-resurrect:1:2",
+                           max_resurrections=3))
     return modes
 
 

@@ -125,8 +125,10 @@ def oracle_events(spec: Dict, results: List[Dict]) -> List[Violation]:
 @oracle("supervision")
 def oracle_supervision(spec: Dict, results: List[Dict]) -> List[Violation]:
     """engine.supervision stays clean: zero watchdog fires, demotions, or
-    recoveries in a healthy run (an ``engine:*`` fault spec flips the
-    expectation: the drilled recovery MUST be counted)."""
+    recoveries in a healthy run (an ``engine:*`` fault spec — or a mode
+    carrying its own ``engine_fault`` recovery drill, ISSUE 17 — flips
+    the expectation: the drilled detour is judged by the parity oracle
+    landing the base digest, not by a zero-recoveries ledger)."""
     fault = (spec.get("fault_inject") or {})
     expect_recoveries = fault.get("kind") == "engine"
     out = []
@@ -135,7 +137,7 @@ def oracle_supervision(spec: Dict, results: List[Dict]) -> List[Violation]:
         if sup is None:
             continue
         n = sup.get("recoveries", 0)
-        if expect_recoveries:
+        if expect_recoveries or r.get("engine_fault"):
             continue            # drills are judged by their own tests
         if n:
             out.append(_v("supervision",
@@ -147,9 +149,14 @@ def oracle_supervision(spec: Dict, results: List[Dict]) -> List[Violation]:
 @oracle("mesh")
 def oracle_mesh(spec: Dict, results: List[Dict]) -> List[Violation]:
     """Sharded-mesh invariants: cross-shard forwards never transit the
-    host, the plane never silently demotes, occupancy stays sane."""
+    host, the plane never silently demotes, occupancy stays sane.  Modes
+    drilling their own engine fault (ISSUE 17) are exempt — a drilled
+    device loss legitimately reshapes the mesh mid-run, and the parity
+    oracle already pins its end digest against the fault-free base."""
     out = []
     for r in _live(results):
+        if r.get("engine_fault"):
+            continue
         sc = r.get("scrape") or {}
         if "mesh.host_bounces" not in sc:
             continue

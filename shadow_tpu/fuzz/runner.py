@@ -58,6 +58,16 @@ def _mode_options(spec: Dict, mode: Dict):
     fault = spec.get("fault_inject") or {}
     if fault.get("kind") == "engine":
         opts.fault_inject = fault["spec"]
+    # per-MODE recovery drills (ISSUE 17): the mode itself carries an
+    # engine fault (device-lost, demote-repromote, shard-exit-resurrect)
+    # plus the healing knobs — the run must self-heal back to rc 0 and
+    # the base digest, which the ordinary parity oracle then pins.
+    if mode.get("engine_fault"):
+        opts.fault_inject = mode["engine_fault"]
+    if mode.get("max_resurrections") is not None:
+        opts.max_resurrections = int(mode["max_resurrections"])
+    if mode.get("repromote_after"):
+        opts.repromote_after = int(mode["repromote_after"])
     return opts
 
 
@@ -71,6 +81,40 @@ def _mesh_skip_reason(mode: Dict) -> Optional[str]:
     return None
 
 
+def _run_resume_mode(spec: Dict, opts, out: Dict) -> None:
+    """The checkpoint+``--resume`` leg (ISSUE 17): a writer pass
+    snapshots every few rounds into a scratch dir, then a FRESH
+    controller resumes from the newest good snapshot.  Resume is
+    replay-based and digest-verified at the snapshot boundary, so the
+    resumed run's digest/events face the ordinary parity oracles — no
+    special-casing.  If the run is too short to land a snapshot the
+    second pass simply replays plain (still a valid parity sample)."""
+    import glob
+    import tempfile
+
+    from ..core.checkpoint import state_digest
+    from ..core.controller import Controller
+
+    with tempfile.TemporaryDirectory(prefix="simfuzz-ck-") as ckdir:
+        opts.checkpoint_every_rounds = 4
+        opts.checkpoint_dir = ckdir
+        writer = Controller(opts, build_config(spec))
+        rc = writer.run()
+        if rc != 0:
+            out["rc"] = rc
+            return
+        opts.checkpoint_every_rounds = 0
+        if glob.glob(os.path.join(ckdir, "checkpoint_r*.ckpt")):
+            opts.resume_path = ckdir
+        ctrl = Controller(opts, build_config(spec))
+        out["rc"] = ctrl.run()
+        eng = ctrl.engine
+        out["digest"] = state_digest(eng)
+        out["events"] = eng.events_executed
+        out["rounds"] = eng.rounds_executed
+        out["supervision"] = eng.supervision.summary()
+
+
 def run_one_mode(spec: Dict, mode: Dict) -> Dict:
     """Run the spec under one mode.  Never raises: harness errors land in
     the result as rc=-1 + traceback (the rc/log oracle fails them)."""
@@ -82,6 +126,7 @@ def run_one_mode(spec: Dict, mode: Dict) -> Dict:
                  "repeat_of": mode.get("repeat_of"),
                  "events_comparable": bool(
                      mode.get("events_comparable", True)),
+                 "engine_fault": mode.get("engine_fault"),
                  "skipped": None, "rc": None, "digest": None,
                  "events": None, "rounds": None, "supervision": None,
                  "scrape": {}, "log_tail": "", "wall_sec": None}
@@ -95,12 +140,15 @@ def run_one_mode(spec: Dict, mode: Dict) -> Dict:
     try:
         cfg = build_config(spec)
         opts = _mode_options(spec, mode)
-        if opts.processes >= 2:
+        if mode.get("resume"):
+            _run_resume_mode(spec, opts, out)
+        elif opts.processes >= 2:
             from ..parallel.procs import ProcsController
             pc = ProcsController(opts, cfg)
             out["rc"] = pc.run()
             out["digest"] = pc.digest
             out["events"] = pc.events_executed
+            out["supervision"] = pc.supervision.summary()
         else:
             ctrl = Controller(opts, cfg)
             out["rc"] = ctrl.run()

@@ -446,6 +446,23 @@ class DeviceTrafficPlane:
                                        "device-dispatch-hang"):
             self._fault_dispatch = fault["dispatch"]
             self._fault_hang = fault["kind"] == "device-dispatch-hang"
+        # self-healing (ISSUE 17): an injected device loss re-shards the
+        # mesh onto D-1 devices at the next quiesced round boundary; a
+        # demote-repromote poison fails like device-dispatch:N but the
+        # demotion serves a probation (--repromote-after clean collects)
+        # and then climbs back to the device rung once, replay guard armed
+        self._fault_device_lost = 0
+        if fault and fault["kind"] == "device-lost":
+            self._fault_device_lost = fault["round"]
+        if fault and fault["kind"] == "demote-repromote":
+            self._fault_dispatch = fault["dispatch"]
+        self._repromote_after = int(
+            getattr(engine.options, "repromote_after", 0) or 0)
+        self._probation_clean = 0
+        self._repromoted = False
+        self._replay_base = None   # state stash at re-promotion: a second
+                                   # failure replays base + log, then the
+                                   # numpy demotion is permanent
 
     # -- static layout ----------------------------------------------------
     def _build_layout(self, engine) -> None:
@@ -637,6 +654,117 @@ class DeviceTrafficPlane:
         owns partition, exchange schedule, kernel, and metrics."""
         from .mesh.meshplane import attach_mesh
         attach_mesh(self, n_dev)
+
+    def _unshard_state(self, lay) -> tuple:
+        """Translate the live padded state back to the ORIGINAL flow/node
+        space under layout ``lay`` — the inverse of the pad_state
+        translation: flow arrays gather through ``inv``, node arrays
+        scatter through ``node_src`` (each global node lives on exactly
+        one shard, so the scatter is an assignment)."""
+        t, queued, ring, tokens, delivered, target, done_tick, node_sent = \
+            (np.asarray(a) for a in self._state)
+        inv = lay["inv"]
+        node_src = lay["node_src"]
+        valid = node_src >= 0
+        tok = np.zeros(self.n_nodes, dtype=np.int64)
+        sent = np.zeros(self.n_nodes, dtype=np.int64)
+        tok[node_src[valid]] = tokens[valid]
+        sent[node_src[valid]] = node_sent[valid]
+        return (np.int64(t), queued[inv], np.ascontiguousarray(ring[:, inv]),
+                tok, delivered[inv], target[inv], done_tick[inv], sent)
+
+    @staticmethod
+    def _state_digest(state) -> str:
+        """Canonical digest of an original-space state tuple (dtype, shape,
+        bytes per tensor) — the re-layout pin: translating state between
+        device layouts must be the identity in the original space."""
+        import hashlib
+        h = hashlib.sha256()
+        for a in state:
+            arr = np.asarray(a)
+            h.update(str(arr.dtype).encode())
+            h.update(str(arr.shape).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        return h.hexdigest()
+
+    def _reshard(self, engine) -> None:
+        """Mid-run device loss on the sharded mesh (ROADMAP 4(b)): at a
+        quiesced round boundary (no dispatch in flight), translate the
+        live padded state back to the original flow space, re-run the
+        chain partitioner and BvN exchange schedule for the surviving
+        D-1 devices, translate the state into the new layout, and PIN the
+        round trip — the original-space digest before the re-layout must
+        equal the digest read back through the new layout, or the run
+        aborts loudly.  The plane's mode, pipeline, superwindow and
+        checkpoint contracts are untouched; only the layout moved.
+        D=2 loses the mesh entirely and continues on the single-device
+        kernel (same digest pin, identity translation)."""
+        import time as _wt
+        t0 = _wt.perf_counter_ns()
+        old = self._shard
+        n_old = int(old["n_shards"])
+        n_new = n_old - 1
+        old_info = self._meshinfo
+        orig = self._unshard_state(old)
+        digest_before = self._state_digest(orig)
+        # old-layout kernels and caches die with the lost device
+        self._sharded_variants.clear()
+        self._flow_args_cached = None
+        self._zero_inject_cached = None
+        if n_new < 2:
+            self._mesh = None
+            self._shard = None
+            self._sharded_step = None
+            self._mesh_make_step = None
+            self._chain_leg_bits = None
+            self._full_leg_bits = 0
+            self._active_leg_bits = 0
+            state = orig
+            if old_info is not None:
+                old_info.n_devices = 1
+                old_info.exchange_mode = "single"
+            digest_after = self._state_digest(state)
+        else:
+            self._setup_sharding(n_new)
+            # the new schedule's leg numbering shares nothing with the old
+            # mask bookkeeping: run the always-correct full kernel from
+            # here on (-1 is the full-kernel sentinel; future activations
+            # OR into it harmlessly)
+            self._active_leg_bits = -1
+            lay = self._shard
+            from .mesh.partition import pad_state
+            keep, src = lay["keep"], lay["src"]
+            ring_o = orig[2]
+            ring_p = np.zeros((self.ring_len, len(src)), dtype=ring_o.dtype)
+            ring_p[:, keep] = ring_o[:, src[keep]]
+            node_src = lay["node_src"]
+            valid = node_src >= 0
+            tok_p = np.zeros(len(node_src), dtype=np.int64)
+            sent_p = np.zeros(len(node_src), dtype=np.int64)
+            tok_p[valid] = orig[3][node_src[valid]]
+            sent_p[valid] = orig[7][node_src[valid]]
+            state = (orig[0], pad_state(lay, orig[1]), ring_p, tok_p,
+                     pad_state(lay, orig[4]), pad_state(lay, orig[5]),
+                     pad_state(lay, orig[6], fill=-1), sent_p)
+            self._state = state
+            digest_after = self._state_digest(self._unshard_state(lay))
+            # runtime counters survive the re-layout (the schedule-shape
+            # fields are the NEW mesh's, by design)
+            self._meshinfo.cross_shard_cells += old_info.cross_shard_cells
+            self._meshinfo.host_bounces += old_info.host_bounces
+        if digest_after != digest_before:
+            raise RuntimeError(
+                f"device plane re-shard {n_old}->{n_new}: state digest "
+                f"changed across the re-layout ({digest_before[:12]} != "
+                f"{digest_after[:12]}) — the translation is not the "
+                "identity; aborting rather than continuing on corrupt "
+                "state")
+        if self.mode == "device":
+            import jax.numpy as jnp
+            state = tuple(jnp.asarray(a) for a in state)
+        self._state = state
+        engine.supervision.count_reshard(
+            n_old, n_new, mttr_ns=_wt.perf_counter_ns() - t0)
 
     def _read_summaries(self):
         """(delivered, done_tick, node_sent) in the ORIGINAL flow/node
@@ -894,6 +1022,14 @@ class DeviceTrafficPlane:
         t0 = _wt.perf_counter_ns()
         assert not self._inflight, \
             "device plane: launch with an uncollected dispatch in flight"
+        if self._fault_device_lost and self._shard is not None \
+                and self._state is not None \
+                and engine.rounds_executed + 1 >= self._fault_device_lost:
+            # injected device loss: the plane is quiesced here (no dispatch
+            # in flight — the assert above IS the boundary condition), so
+            # re-partition onto the survivors before this round's launch
+            self._fault_device_lost = 0
+            self._reshard(engine)
         if self._auto_pos < len(self._auto):
             ws = engine.scheduler.window_start
             if self._state is None and not self._inject_buf \
@@ -1262,6 +1398,14 @@ class DeviceTrafficPlane:
                     engine.counters.count_new("event", len(items))
                     engine.scheduler.policy.push_batch(
                         items, 0, engine.scheduler.window_end)
+        # probation clock (ISSUE 17): each clean collect on the demoted
+        # twin counts toward re-promotion; the threshold re-attempts the
+        # device rung once (permanent-on-repeat preserved via _repromoted)
+        if (self.demoted and self.mode == "numpy"
+                and self._repromote_after > 0 and not self._repromoted):
+            self._probation_clean += 1
+            if self._probation_clean >= self._repromote_after:
+                self._repromote(engine)
         self.host_ns += _wt.perf_counter_ns() - t1
 
     def _collect_flush(self, engine, handle) -> np.ndarray:
@@ -1420,11 +1564,19 @@ class DeviceTrafficPlane:
         from ..ops.torcells_device import (RING_DTYPE,
                                            torcells_step_window_numpy_flush)
         f, h = self.n_flows, self.n_nodes
-        state = (np.int64(0), np.zeros(f, dtype=np.int64),
-                 np.zeros((self.ring_len, f), dtype=RING_DTYPE),
-                 self.capacity_step.copy(),
-                 np.zeros(f, dtype=np.int64), np.zeros(f, dtype=np.int64),
-                 np.full(f, -1, dtype=np.int64), np.zeros(h, dtype=np.int64))
+        if self._replay_base is not None:
+            # the window-replay guard armed at re-promotion: this is the
+            # re-promoted rung failing AGAIN — replay from the stashed
+            # probation-exit state plus the log since, then the demotion
+            # is permanent (self._repromoted blocks another probation)
+            state = tuple(np.asarray(a).copy() for a in self._replay_base[1])
+        else:
+            state = (np.int64(0), np.zeros(f, dtype=np.int64),
+                     np.zeros((self.ring_len, f), dtype=RING_DTYPE),
+                     self.capacity_step.copy(),
+                     np.zeros(f, dtype=np.int64), np.zeros(f, dtype=np.int64),
+                     np.full(f, -1, dtype=np.int64),
+                     np.zeros(h, dtype=np.int64))
         args = self._flow_args()        # plain numpy now that mode flipped
         flush = None
         for base, pairs, targets, idle in self._dispatch_log:
@@ -1442,7 +1594,35 @@ class DeviceTrafficPlane:
         self._state = state
         assert flush is not None, "recovery with an empty dispatch log"
         self._dispatch_log.clear()      # demoted: the log has no future use
+        self._replay_base = None
+        # arm the probation clock (ISSUE 17): after --repromote-after
+        # clean collects on the twin, consume() re-attempts the device
+        # rung once.  A rung that already climbed back stays down for good.
+        self._probation_clean = 0
         return flush
+
+    def _repromote(self, engine) -> None:
+        """Climb back up the recovery ladder (ISSUE 17): the numpy
+        demotion served its probation, so re-attempt the device rung ONCE
+        with the window-replay guard re-armed — the current twin state is
+        stashed as the replay base, so a second dispatch failure rebuilds
+        from it (base + log replay) and re-demotes permanently.  Single-
+        device rung only: a mesh lost to a real fault re-enters through
+        the re-shard path, not here."""
+        import jax.numpy as jnp
+        self._replay_base = (int(self._ticks_synced),
+                             tuple(np.asarray(a).copy()
+                                   for a in self._state))
+        self._dispatch_log.clear()
+        self.mode = "device"
+        self.demoted = False
+        self._repromoted = True
+        self._flush_step = None
+        self._flow_args_cached = None
+        self._zero_inject_cached = None
+        self._state = tuple(jnp.asarray(a) for a in self._state)
+        engine.supervision.count_repromotion("device plane backend",
+                                             self._probation_clean)
 
     def _make_wake_event(self, engine, circuit: int,
                          when: int) -> Optional[Event]:
@@ -1578,6 +1758,10 @@ class DeviceTrafficPlane:
             # the backend was demoted for the rest of the run
             "recoveries": self.recoveries,
             "demoted": self.demoted,
+            # recovery-ladder introspection (ISSUE 17): whether the rung
+            # climbed back after its probation (one shot; a repeat fault
+            # re-demotes for good)
+            "repromoted": self._repromoted,
             # the plane's own wall split (VERDICT r4 weak #2: this was
             # tracked but never exported, hiding ~half the flagship wall):
             # host_sec = advance() dispatch prep + wake bookkeeping;

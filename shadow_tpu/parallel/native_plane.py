@@ -457,6 +457,13 @@ class NativeGlobalPolicy(GlobalSinglePolicy):
         # (--fault-inject native-round:N)
         self.round_windows = 0
         self.round_demoted = False
+        # recovery-ladder re-promotion (ISSUE 17): after --repromote-after
+        # clean per-event windows the executor is re-attempted ONCE; a
+        # second failure re-demotes permanently (the one-shot latch)
+        self._repromote_after = int(
+            getattr(plane.engine.options, "repromote_after", 0) or 0)
+        self._probation_clean = 0
+        self.round_repromoted = False
         self._py_exc = None
         from ..core.supervision import parse_fault_inject
         fault = parse_fault_inject(
@@ -486,8 +493,23 @@ class NativeGlobalPolicy(GlobalSinglePolicy):
         """Execute the whole window via the C round executor.  Returns
         False when demoted (caller falls back to the per-event loop, which
         also FINISHES a window the executor failed partway through)."""
-        if self.round_demoted or worker.id != 0:
+        if worker.id != 0:
             return False
+        if self.round_demoted:
+            # probation clock (ISSUE 17): each window the per-event loop
+            # completes cleanly counts; at the threshold the executor is
+            # re-attempted once — the hand-off is exact in both
+            # directions (both paths execute the identical total order),
+            # so the climb back is as safe as the demotion was
+            if self._repromote_after > 0 and not self.round_repromoted \
+                    and self._probation_clean >= self._repromote_after:
+                self.round_demoted = False
+                self.round_repromoted = True
+                self._plane.engine.supervision.count_repromotion(
+                    "native round executor", self._probation_clean)
+            else:
+                self._probation_clean += 1
+                return False
         q = self.queue
         we = int(window_end)
         counters = worker.counters

@@ -40,6 +40,23 @@ plus ("collect" -> "hosts") for assembled checkpoints and
 ("stop" -> "final") at the end.  Checkpoints taken by the parent merge the
 shards' per-host states through the same ``assemble_state`` the serial
 writer uses, so snapshot digests are comparable across process counts.
+
+Self-healing (ISSUE 17): a shard that dies mid-protocol (SIGKILL, OOM,
+``os._exit``) no longer ends the run.  The surviving shards are already
+quiesced at the round barrier (they park in ``conn.recv`` until the parent
+routes their inbox — the barrier IS the checkpoint boundary), so the parent
+respawns the dead shard and drives it through a deterministic replay of the
+recorded protocol history: the identical ("run", ws, we) windows and
+("in", inbox) payloads, with every replayed round's outbox signature and
+min-report cross-checked against the first life, and the shard's host-state
+digest verified at the newest recorded snapshot boundary (the join-boundary
+digest check; pure round-zero replay when no checkpoint was written).  Any
+divergence aborts loudly — a resurrection may never silently simulate
+something else.  Bounded by ``--max-resurrections`` with exponential
+backoff; each detour is counted in ``SupervisionStats`` with its MTTR.
+The replay history (window list + per-shard inboxes) is retained in the
+parent for the life of the run — the price of being able to rebuild any
+shard from round zero, same as the determinism-kernel resume contract.
 """
 
 from __future__ import annotations
@@ -92,7 +109,7 @@ def _shard_body(conn, options, config) -> None:
     from ..core.supervision import parse_fault_inject
     fault = parse_fault_inject(getattr(options, "fault_inject", "") or "")
     fault_exit_round = 0
-    if fault and fault["kind"] == "shard-exit" \
+    if fault and fault["kind"] in ("shard-exit", "shard-exit-resurrect") \
             and fault["shard"] == engine.shard_id:
         fault_exit_round = fault["round"]
 
@@ -251,16 +268,25 @@ def _shard_body(conn, options, config) -> None:
 class ShardDeadError(RuntimeError):
     """A shard process died (or went watchdog-silent) mid-protocol — the
     distinguished failure the supervision ledger counts, as opposed to a
-    shard that REPORTED an error before exiting."""
+    shard that REPORTED an error before exiting.
+
+    ``sid`` names the dead shard; ``resurrectable`` is False for the
+    live-but-silent watchdog case (killing and replaying a shard that may
+    still be computing is not a recovery, it is a race — that path stays a
+    diagnostic abort)."""
+
+    sid: int = -1
+    resurrectable: bool = True
 
 
 def _recv_supervised(conn, proc, sid: int, watchdog_sec: float):
     """Shard supervision: a ``recv`` that polls in short slices and checks
     the shard process between them.  A shard that died without reporting
-    (SIGKILL, OOM, os._exit) surfaces as a diagnostic RuntimeError within
+    (SIGKILL, OOM, os._exit) surfaces as a diagnostic ShardDeadError within
     ~a poll slice instead of parking the parent in ``Connection.recv``
-    forever; ``watchdog_sec > 0`` additionally bounds how long a LIVE but
-    silent shard may stall a round barrier."""
+    forever — the parent decides whether to resurrect or abort;
+    ``watchdog_sec > 0`` additionally bounds how long a LIVE but silent
+    shard may stall a round barrier."""
     waited = 0.0
     while True:
         if conn.poll(0.5):
@@ -269,7 +295,7 @@ def _recv_supervised(conn, proc, sid: int, watchdog_sec: float):
             except EOFError:
                 raise ShardDeadError(
                     f"shard {sid} closed its pipe mid-message "
-                    f"(exit code {proc.exitcode}) — aborting cleanly")
+                    f"(exit code {proc.exitcode})")
             if msg[0] == "error":
                 raise RuntimeError(f"shard failed:\n{msg[1]}")
             return msg
@@ -278,13 +304,14 @@ def _recv_supervised(conn, proc, sid: int, watchdog_sec: float):
                 continue        # final message raced the death check
             raise ShardDeadError(
                 f"shard {sid} died (exit code {proc.exitcode}) without "
-                "reporting an error — aborting cleanly (dead-shard "
-                "detection)")
+                "reporting an error (dead-shard detection)")
         waited += 0.5
         if watchdog_sec > 0 and waited >= watchdog_sec:
-            raise ShardDeadError(
+            err = ShardDeadError(
                 f"shard {sid} alive but silent for {waited:.0f}s "
                 "(--shard-watchdog-sec) — aborting with diagnostics")
+            err.resurrectable = False
+            raise err
 
 
 class ProcsController:
@@ -306,8 +333,32 @@ class ProcsController:
         self.digest: Optional[str] = None
         self.checkpoints: List[str] = []
         self.resume_verified = False
-        from ..core.supervision import SupervisionStats
+        from ..core.supervision import SupervisionStats, parse_fault_inject
         self.supervision = SupervisionStats()
+        # self-healing state (ISSUE 17): the recorded protocol history a
+        # resurrected shard replays, per-shard snapshot-boundary digests
+        # for the join verification, and the respawn budget.  The legacy
+        # ``shard-exit`` drill keeps PR-2 abort semantics (it exists to
+        # drill dead-shard DETECTION); real deaths and the
+        # ``shard-exit-resurrect`` drill take the resurrection path.
+        fault = parse_fault_inject(getattr(options, "fault_inject", "")
+                                   or "")
+        self._legacy_abort = bool(fault and fault["kind"] == "shard-exit")
+        self.max_resurrections = int(
+            getattr(options, "max_resurrections", 3))
+        self._history: List[tuple] = []       # (ws, we, inboxes, out_sigs,
+                                              #  mins) per completed round
+        self._ck_verify: Dict[int, List[str]] = {}   # rounds -> per-sid
+                                                     # host-state digests
+        self._initial: Optional[tuple] = None  # (readies, first mins)
+        self._resurrections_used = 0
+        self._death_wall = 0.0
+        self._last_collect_sid_digests: List[str] = []
+        self._shard_wd = float(getattr(options, "shard_watchdog_sec", 0)
+                               or 0)
+        self._ctx = None
+        self.conns: List = []
+        self.procs: List = []
         # parent-side observability: the parent owns the merged trace file
         # (per-shard tracks) and the metrics summary; its own track is
         # labeled 'parent' on a pid past the shard range
@@ -338,6 +389,214 @@ class ProcsController:
         opt.data_template = None
         return opt
 
+    # -- self-healing plumbing (ISSUE 17) ----------------------------------
+
+    def _spawn(self, sid: int, clear_fault: bool = False) -> None:
+        """Spawn (or respawn) shard ``sid``.  A resurrection spawns with
+        the shard-exit fault harness CLEARED: the drill simulates ONE
+        SIGKILL, and a replacement that re-dies at the same round would
+        only drain the budget without testing anything new.  Every other
+        fault kind is kept — the replacement must replay its first life
+        exactly, demotions included."""
+        opt = self._child_options(sid)
+        if clear_fault and (opt.fault_inject or "").startswith("shard-exit"):
+            opt.fault_inject = ""
+        parent_conn, child_conn = self._ctx.Pipe()
+        p = self._ctx.Process(target=_shard_main,
+                              args=(child_conn, opt, self.config),
+                              daemon=True, name=f"shard-{sid}")
+        p.start()
+        child_conn.close()
+        if sid < len(self.conns):
+            self.conns[sid] = parent_conn
+            self.procs[sid] = p
+        else:
+            self.conns.append(parent_conn)
+            self.procs.append(p)
+
+    def _recv(self, sid: int):
+        try:
+            return _recv_supervised(self.conns[sid], self.procs[sid], sid,
+                                    self._shard_wd)
+        except ShardDeadError as e:
+            # the ledger records the detection regardless of what the
+            # parent does next (resurrect or abort), and the timeline
+            # rides along like every other recovery seam
+            self.supervision.shard_deaths_detected += 1
+            self.supervision._dump_flight_recorder(
+                f"shard {sid} death detected")
+            self._death_wall = _walltime.monotonic()
+            e.sid = sid
+            raise
+
+    def _send(self, sid: int, msg) -> None:
+        try:
+            self.conns[sid].send(msg)
+        except (BrokenPipeError, OSError):
+            self.supervision.shard_deaths_detected += 1
+            self.supervision._dump_flight_recorder(
+                f"shard {sid} death detected (send)")
+            self._death_wall = _walltime.monotonic()
+            e = ShardDeadError(
+                f"shard {sid} pipe closed on send "
+                f"(exit code {self.procs[sid].exitcode})")
+            e.sid = sid
+            raise e
+
+    def _heal_or_raise(self, e: ShardDeadError) -> int:
+        """Decide a dead shard's fate: resurrect within budget, or abort
+        loudly.  Returns the shard id after a successful resurrection."""
+        if self._legacy_abort or not getattr(e, "resurrectable", True):
+            raise e
+        if self._resurrections_used >= self.max_resurrections:
+            raise RuntimeError(
+                f"resurrection budget exhausted (--max-resurrections "
+                f"{self.max_resurrections}, used "
+                f"{self._resurrections_used}): {e} — aborting")
+        self._resurrect(e.sid)
+        return e.sid
+
+    def _resurrect(self, sid: int) -> None:
+        """Respawn shard ``sid`` and replay it to the current round
+        barrier.  The surviving shards are quiesced (parked in their
+        ``conn.recv`` at the barrier) for the duration; they never see the
+        detour.  Replay is the determinism-kernel resume contract applied
+        to one shard: identical windows + identical inboxes => identical
+        state, cross-checked per round (outbox signature, min report) and
+        digest-verified at the newest recorded snapshot boundary.  Any
+        mismatch aborts loudly — a genuinely corrupt or divergent replay
+        may never rejoin the barrier."""
+        import hashlib
+
+        from ..core.checkpoint import digest_of_state
+        log = get_logger()
+        self._resurrections_used += 1
+        attempt = self._resurrections_used
+        backoff = 0.05 * (2 ** (attempt - 1))
+        log.warning(
+            "procs",
+            f"shard {sid} died mid-protocol; resurrecting (attempt "
+            f"{attempt}/{self.max_resurrections}) after {backoff:.2f}s "
+            "backoff — survivors stay quiesced at the round barrier")
+        # real wall-clock backoff by design: the corpse's OS resources
+        # (pipes, memory) need releasing before the respawn, and repeated
+        # crash loops must decelerate — nothing here advances virtual time
+        _walltime.sleep(backoff)  # simlint: disable=SIM005 -- supervision backoff is wall time by definition
+        old = self.procs[sid]
+        try:
+            self.conns[sid].close()
+        except Exception:
+            pass
+        old.join(timeout=5)
+        if old.is_alive():
+            old.terminate()
+            old.join(timeout=5)
+        if old.is_alive():
+            old.kill()
+            old.join(timeout=5)
+        self._spawn(sid, clear_fault=True)
+        ready = self._recv(sid)
+        m0 = self._recv(sid)
+        if self._initial is not None:
+            exp_ready, exp_min = self._initial
+            if tuple(ready[1:]) != tuple(exp_ready[sid][1:]) or \
+                    (m0[1], m0[2]) != (exp_min[sid][1], exp_min[sid][2]):
+                raise RuntimeError(
+                    f"shard {sid} resurrection diverged at boot: the "
+                    "replacement's ready/min report does not match its "
+                    "first life — config/seed drifted; aborting")
+        for r, (ws, we, inboxes, out_sigs, mins_r) in \
+                enumerate(self._history):
+            self._send(sid, ("run", ws, we))
+            out = self._recv(sid)[1]
+            sig = hashlib.sha256(repr(out).encode()).hexdigest()
+            if sig != out_sigs[sid]:
+                raise RuntimeError(
+                    f"shard {sid} resurrection diverged at round {r}: "
+                    "replayed outbox does not match the recorded one — "
+                    "aborting (a resurrection may never silently simulate "
+                    "something else)")
+            self._send(sid, ("in", inboxes[sid]))
+            m = self._recv(sid)
+            if (m[1], m[2]) != (mins_r[sid][1], mins_r[sid][2]):
+                raise RuntimeError(
+                    f"shard {sid} resurrection diverged at round {r}: "
+                    "replayed min report does not match the recorded one "
+                    "— aborting")
+            if r + 1 in self._ck_verify:
+                # the join-boundary digest gate: at every boundary the
+                # parent snapshotted, the replayed shard's own host states
+                # must digest to exactly what it contributed then
+                self._send(sid, ("collect",))
+                states = self._recv(sid)[1]
+                if digest_of_state(states) != self._ck_verify[r + 1][sid]:
+                    raise RuntimeError(
+                        f"shard {sid} resurrection diverged at the round-"
+                        f"{r + 1} snapshot boundary: replayed host-state "
+                        "digest does not match the checkpointed one — "
+                        "aborting")
+        mttr = int((_walltime.monotonic() - self._death_wall) * 1e9)
+        self.supervision.count_shard_resurrection(sid, attempt, mttr)
+
+    def _drive_round(self, ws: int, we: int) -> List[tuple]:
+        """One conservative round with self-healing: run -> out gather ->
+        inbox route -> min gather, any phase surviving a shard death by
+        resurrecting and re-driving that shard through the round.  A shard
+        whose outbox was already received before it died must reproduce it
+        bit-identically after resurrection (the determinism pin).  Records
+        the round in the replay history on success."""
+        import hashlib
+        n = self.n_shards
+        run_sent = [False] * n
+        outs: Dict[int, list] = {}
+        expect_outs: Dict[int, list] = {}
+        inboxes: Optional[List[list]] = None
+        in_sent = [False] * n
+        mins: Dict[int, tuple] = {}
+        while True:
+            try:
+                for sid in range(n):
+                    if not run_sent[sid]:
+                        self._send(sid, ("run", ws, we))
+                        run_sent[sid] = True
+                for sid in range(n):
+                    if sid not in outs:
+                        outs[sid] = self._recv(sid)[1]
+                        if sid in expect_outs \
+                                and outs[sid] != expect_outs[sid]:
+                            raise RuntimeError(
+                                f"shard {sid} resurrection diverged: the "
+                                "re-driven round's outbox does not match "
+                                "what the first life sent — aborting")
+                if inboxes is None:
+                    inboxes = [[] for _ in range(n)]
+                    for s in range(n):
+                        for d in range(n):
+                            inboxes[d].extend(outs[s][d])
+                with self.tracer.span("exchange", "procs", sim_ns=ws):
+                    for sid in range(n):
+                        if not in_sent[sid]:
+                            self._send(sid, ("in", inboxes[sid]))
+                            in_sent[sid] = True
+                    for sid in range(n):
+                        if sid not in mins:
+                            mins[sid] = self._recv(sid)
+                break
+            except ShardDeadError as e:
+                sid = self._heal_or_raise(e)
+                # re-drive the resurrected shard through THIS round from
+                # the top; everything it already delivered is cross-checked
+                run_sent[sid] = False
+                if sid in outs:
+                    expect_outs[sid] = outs.pop(sid)
+                in_sent[sid] = False
+                mins.pop(sid, None)
+        out_sigs = [hashlib.sha256(repr(outs[s]).encode()).hexdigest()
+                    for s in range(n)]
+        mins_list = [mins[s] for s in range(n)]
+        self._history.append((ws, we, inboxes, out_sigs, mins_list))
+        return mins_list
+
     def run(self) -> int:
         from ..core.checkpoint import assemble_state, digest_of_state
 
@@ -347,45 +606,22 @@ class ProcsController:
         if template and not os.path.exists(self.options.data_directory):
             import shutil
             shutil.copytree(template, self.options.data_directory)
-        ctx = mp.get_context("spawn")
-        conns = []
-        procs = []
+        self._ctx = mp.get_context("spawn")
         t_start = _walltime.monotonic()
         for sid in range(n):
-            parent_conn, child_conn = ctx.Pipe()
-            p = ctx.Process(target=_shard_main,
-                            args=(child_conn, self._child_options(sid),
-                                  self.config),
-                            daemon=True, name=f"shard-{sid}")
-            p.start()
-            child_conn.close()
-            conns.append(parent_conn)
-            procs.append(p)
-
-        sid_of = {id(c): i for i, c in enumerate(conns)}
-        shard_wd = float(getattr(self.options, "shard_watchdog_sec", 0) or 0)
-
-        def recv(c):
-            sid = sid_of[id(c)]
-            try:
-                return _recv_supervised(c, procs[sid], sid, shard_wd)
-            except ShardDeadError:
-                # the ledger records the detection (it aborts the run, but
-                # distinguishes 'we caught a dead shard cleanly' from 'a
-                # shard reported its own error'); the abort carries the
-                # parent's recent timeline, like every other recovery seam
-                self.supervision.shard_deaths_detected += 1
-                self.supervision._dump_flight_recorder(
-                    f"shard {sid} death detected")
-                raise
+            self._spawn(sid)
+        conns, procs = self.conns, self.procs
 
         try:
-            readies = [recv(c) for c in conns]
+            # boot-phase deaths stay aborts: a shard that cannot even
+            # reach its first barrier would die again on respawn
+            readies = [self._recv(sid) for sid in range(n)]
             lookahead = readies[0][1]
             end_time = readies[0][2]
             assert all(r[1] == lookahead and r[2] == end_time
                        for r in readies), "shards disagree on lookahead/end"
-            mins = [recv(c) for c in conns]
+            mins = [self._recv(sid) for sid in range(n)]
+            self._initial = (readies, mins)
             log.message(
                 "procs",
                 f"starting sharded simulation: {readies[0][3]} hosts over "
@@ -427,20 +663,11 @@ class ProcsController:
                 ws, we = nxt, min(nxt + lookahead, end_time)
                 with self.tracer.span("round", "procs", sim_ns=ws,
                                       args={"round": self.rounds_executed}):
-                    for c in conns:
-                        c.send(("run", ws, we))
-                    outs = [recv(c)[1] for c in conns]
-                    with self.tracer.span("exchange", "procs", sim_ns=ws):
-                        for sid, c in enumerate(conns):
-                            inbox = []
-                            for o in outs:
-                                inbox.extend(o[sid])
-                            c.send(("in", inbox))
-                        mins = [recv(c) for c in conns]
+                    mins = self._drive_round(ws, we)
                 last_ws = ws
                 if resume_snap is not None \
                         and ws >= resume_snap["sim_time_ns"]:
-                    self._verify_resume(conns, recv, ws, resume_snap,
+                    self._verify_resume(ws, resume_snap,
                                         sum(m[2] for m in mins))
                     resume_snap = None
                 # parent-assembled checkpoint at the same boundaries the
@@ -451,8 +678,7 @@ class ProcsController:
                         and writer.due(ws, self.rounds_executed):
                     with self.tracer.span("checkpoint.write", "procs",
                                           sim_ns=ws):
-                        self._write_checkpoint(conns, recv, ws,
-                                               sum(m[2] for m in mins),
+                        self._write_checkpoint(ws, sum(m[2] for m in mins),
                                                writer)
                 self.rounds_executed += 1
                 if self._metrics_writer is not None:
@@ -462,9 +688,7 @@ class ProcsController:
             if resume_snap is not None:
                 from ..core.checkpoint import warn_resume_unreached
                 warn_resume_unreached(resume_snap, "procs")
-            for c in conns:
-                c.send(("stop",))
-            finals = [recv(c)[1] for c in conns]
+            finals = self._gather_finals()
         except BaseException:
             # abnormal termination (shard death, protocol error): export
             # the parent's own flight-recorder events best-effort so the
@@ -484,11 +708,22 @@ class ProcsController:
             # conn.recv() (EOFError -> exit), so a mid-run failure tears
             # down immediately instead of waiting out join timeouts
             for c in conns:
-                c.close()
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            # straggler sweep: escalate terminate -> grace -> kill and
+            # REAP after each step, so a shard that died during quiesce
+            # (or wedged ignoring SIGTERM) cannot leave a zombie racing
+            # the checkpoint barrier of a subsequent run
             for p in procs:
                 p.join(timeout=60)
                 if p.is_alive():
                     p.terminate()
+                    p.join(timeout=10)
+                if p.is_alive():
+                    p.kill()
+                    p.join(timeout=10)
 
         host_states: Dict = {}
         for f in finals:
@@ -549,34 +784,81 @@ class ProcsController:
                 f"metrics written: {self._metrics_writer.path} "
                 f"({self._metrics_writer.records_written} records)")
 
-    def _collect_assembled(self, conns, recv, ws: int, pending: int) -> Dict:
+    def _collect_assembled(self, ws: int, pending: int) -> Dict:
         """Gather every shard's host states and assemble the canonical
-        digestible state (shared by checkpoint writes and resume verify)."""
-        from ..core.checkpoint import assemble_state
-        for c in conns:
-            c.send(("collect",))
+        digestible state (shared by checkpoint writes and resume verify).
+        Heal-aware: a shard dying mid-collect is resurrected and re-asked
+        (collect is state-neutral, so a re-ask is exact).  Records each
+        shard's own host-state digest so a later resurrection replay can
+        be digest-verified at this exact boundary."""
+        from ..core.checkpoint import assemble_state, digest_of_state
+        n = self.n_shards
+        sent = [False] * n
+        by_sid: Dict[int, Dict] = {}
+        while True:
+            try:
+                for sid in range(n):
+                    if not sent[sid]:
+                        self._send(sid, ("collect",))
+                        sent[sid] = True
+                for sid in range(n):
+                    if sid not in by_sid:
+                        by_sid[sid] = self._recv(sid)[1]
+                break
+            except ShardDeadError as e:
+                sid = self._heal_or_raise(e)
+                sent[sid] = False
+                by_sid.pop(sid, None)
+        self._last_collect_sid_digests = [digest_of_state(by_sid[s])
+                                          for s in range(n)]
         host_states: Dict = {}
-        for c in conns:
-            host_states.update(recv(c)[1])
+        for s in range(n):
+            host_states.update(by_sid[s])
         return assemble_state(ws, self.rounds_executed, host_states, pending)
 
-    def _verify_resume(self, conns, recv, ws: int, snap: Dict,
-                       pending: int) -> None:
+    def _gather_finals(self) -> List[Dict]:
+        """Heal-aware stop/final gather: a shard dying at the very last
+        barrier is resurrected (full-history replay) and re-stopped — its
+        final payload is deterministic, so the run still ends digest-clean
+        (wall-clock fields differ but are never digested)."""
+        n = self.n_shards
+        sent = [False] * n
+        by_sid: Dict[int, Dict] = {}
+        while True:
+            try:
+                for sid in range(n):
+                    if not sent[sid]:
+                        self._send(sid, ("stop",))
+                        sent[sid] = True
+                for sid in range(n):
+                    if sid not in by_sid:
+                        by_sid[sid] = self._recv(sid)[1]
+                break
+            except ShardDeadError as e:
+                sid = self._heal_or_raise(e)
+                sent[sid] = False
+                by_sid.pop(sid, None)
+        return [by_sid[s] for s in range(n)]
+
+    def _verify_resume(self, ws: int, snap: Dict, pending: int) -> None:
         """--resume under --processes: the shared boundary gate computed
         over the parent-assembled state."""
         from ..core.checkpoint import digest_of_state, verify_resume_boundary
         verify_resume_boundary(
             snap, ws,
-            digest_of_state(self._collect_assembled(conns, recv, ws,
-                                                    pending)),
+            digest_of_state(self._collect_assembled(ws, pending)),
             "procs")
         self.resume_verified = True
         self.supervision.resume_verified = True
 
-    def _write_checkpoint(self, conns, recv, ws: int, pending: int,
-                          writer) -> None:
+    def _write_checkpoint(self, ws: int, pending: int, writer) -> None:
         from ..core.checkpoint import save_state
-        state = self._collect_assembled(conns, recv, ws, pending)
+        state = self._collect_assembled(ws, pending)
+        # arm the join-boundary gate: len(self._history) rounds are
+        # complete at this barrier; a future resurrection replaying past
+        # it must reproduce each shard's digest recorded here
+        self._ck_verify[len(self._history)] = \
+            list(self._last_collect_sid_digests)
         os.makedirs(self.options.checkpoint_dir, exist_ok=True)
         path = writer.path_for(ws, self.rounds_executed)
         save_state(state, path, {

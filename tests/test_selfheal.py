@@ -1,0 +1,237 @@
+"""Self-healing runs (ISSUE 17): shard resurrection, mid-run device-loss
+re-sharding, and the recovery ladder's climb back up.
+
+1. Shard resurrection: a shard hard-killed mid-run is respawned from the
+   newest verifying snapshot (round-zero deterministic replay when none),
+   digest-verified at the join boundary, and the run finishes rc 0 with
+   the digest of a fault-free run — `supervision` counts the death, the
+   resurrection, and a nonzero MTTR.  The budget (`--max-resurrections`)
+   exhausting aborts loudly instead of looping.
+2. Device-loss re-shard: an injected device loss on the sharded mesh
+   re-partitions onto D-1 devices at a quiesced boundary — digest pinned
+   against the fault-free baseline at K=1 AND K=8 (mid-superwindow), and
+   D=2 collapses to the single-device plane rather than a 1-way mesh.
+3. Re-promotion: with --repromote-after R, a demotion (device-plane
+   dispatch drill, native round executor drill) is probational — R clean
+   rounds climb back up the ladder, counted in supervision.repromotions,
+   digest unchanged; without the flag demotions stay permanent (the
+   PR-2/PR-10 contract).
+"""
+
+import textwrap
+
+import pytest
+
+from shadow_tpu.core import configuration
+from shadow_tpu.core.checkpoint import state_digest
+from shadow_tpu.core.controller import Controller
+from shadow_tpu.core.options import Options
+from shadow_tpu.parallel.procs import ProcsController
+from shadow_tpu.tools import workloads
+
+# -- shard-resurrection harness: the lossy 7-host mix test_procs.py uses
+# (cross-shard flows in both directions under any 2-way partition) -------
+
+LOSSY_TOPO = """<topology><![CDATA[<?xml version="1.0" encoding="UTF-8"?>
+<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+<key id="d0" for="edge" attr.name="latency" attr.type="double"/>
+<key id="d1" for="edge" attr.name="packetloss" attr.type="double"/>
+<key id="d2" for="node" attr.name="bandwidthdown" attr.type="int"/>
+<key id="d3" for="node" attr.name="bandwidthup" attr.type="int"/>
+<graph edgedefault="undirected">
+  <node id="n0"><data key="d2">10240</data><data key="d3">10240</data></node>
+  <edge source="n0" target="n0"><data key="d0">25.0</data><data key="d1">0.02</data></edge>
+</graph></graphml>]]></topology>"""
+
+XML = textwrap.dedent("""\
+    <shadow stoptime="60">
+      {topo}
+      <plugin id="tgen" path="python:tgen" />
+      <plugin id="echo" path="python:echo" />
+      <host id="server"><process plugin="tgen" starttime="1" arguments="server 80" /></host>
+      <host id="c1"><process plugin="tgen" starttime="2" arguments="client server 80 1024:204800" /></host>
+      <host id="c2"><process plugin="tgen" starttime="3" arguments="client server 80 2048:102400" /></host>
+      <host id="u1"><process plugin="echo" starttime="1" arguments="udp server 9000" /></host>
+      <host id="u2"><process plugin="echo" starttime="2" arguments="udp client u1 9000 12 700" /></host>
+    </shadow>
+""").format(topo=LOSSY_TOPO)
+
+
+def _cfg(stop=60):
+    cfg = configuration.parse_xml(XML)
+    cfg.stop_time_sec = stop
+    return cfg
+
+
+def _sharded(stop=60, **opt_kw):
+    ctrl = ProcsController(Options(scheduler_policy="global", workers=0,
+                                   seed=7, stop_time_sec=stop, processes=2,
+                                   **opt_kw), _cfg(stop))
+    assert ctrl.run() == 0
+    return ctrl
+
+
+_CLEAN: dict = {}
+
+
+def _clean_sharded_digest():
+    if "procs" not in _CLEAN:
+        _CLEAN["procs"] = _sharded().digest
+    return _CLEAN["procs"]
+
+
+def test_shard_killed_midrun_resurrected_digest_identical():
+    """The headline acceptance: shard 1 hard-exits at round 3 (the
+    supervisor sees exactly what a SIGKILL produces — a dead pipe), is
+    respawned and replayed to the barrier, and the run finishes rc 0
+    with the fault-free digest.  Every detour is on the ledger."""
+    res = _sharded(fault_inject="shard-exit-resurrect:1:3")
+    assert res.digest == _clean_sharded_digest()
+    s = res.supervision.summary()
+    assert s["shard_deaths_detected"] == 1
+    assert s["shard_resurrections"] == 1
+    assert s["mttr_sec"] > 0
+    assert s["recoveries"] >= 2       # the death + the resurrection
+
+
+def test_resurrection_verified_at_checkpoint_boundary(tmp_path):
+    """A death AFTER snapshots exist: the replayed shard must pass the
+    join-boundary digest gate recorded at each checkpoint round."""
+    res = _sharded(fault_inject="shard-exit-resurrect:0:20",
+                   checkpoint_every_rounds=8,
+                   checkpoint_dir=str(tmp_path / "ck"))
+    assert res.digest == _clean_sharded_digest()
+    assert res.supervision.shard_resurrections == 1
+
+
+def test_resurrection_budget_exhaustion_aborts_loudly():
+    """--max-resurrections 0: the drill's death must abort the run with
+    a diagnosable error, never silently retry forever."""
+    with pytest.raises(RuntimeError, match="resurrection budget exhausted"):
+        _sharded(fault_inject="shard-exit-resurrect:1:3",
+                 max_resurrections=0)
+
+
+# -- device-loss re-shard: the sharded mesh on the 8-virtual-device CPU
+# mesh (conftest forces xla_force_host_platform_device_count=8) ----------
+
+STAR_XML = workloads.star_bulk(6, stoptime=120,
+                               bulk_bytes=192 * 1024 * 1024,
+                               device_data=True)
+
+
+def _mesh(n_dev=8, k=1, **opt_kw):
+    cfg = configuration.parse_xml(STAR_XML)
+    cfg.stop_time_sec = 120
+    ctrl = Controller(Options(scheduler_policy="global", workers=0, seed=3,
+                              stop_time_sec=120, log_level="warning",
+                              device_plane="device", superwindow_rounds=k,
+                              tpu_devices=n_dev, **opt_kw), cfg)
+    assert ctrl.run() == 0
+    return ctrl
+
+
+def _clean_mesh_digest(n_dev, k):
+    key = ("mesh", n_dev, k)
+    if key not in _CLEAN:
+        _CLEAN[key] = state_digest(_mesh(n_dev, k).engine)
+    return _CLEAN[key]
+
+
+def test_device_loss_reshards_8_to_7_digest_pinned():
+    lost = _mesh(8, 1, fault_inject="device-lost:4")
+    assert state_digest(lost.engine) == _clean_mesh_digest(8, 1)
+    s = lost.engine.supervision.summary()
+    assert s["reshards"] == 1
+    assert s["mttr_sec"] > 0
+    assert lost.engine.device_plane._meshinfo.n_devices == 7
+    # re-sharded exchange still never transits the host
+    assert lost.engine.metrics.scrape()["mesh.host_bounces"] == 0
+
+
+def test_device_loss_mid_superwindow_k8_digest_pinned():
+    """The hard case: the loss lands inside a K=8 superwindow — the
+    re-shard must happen at a quiesced boundary, not mid-kernel."""
+    lost = _mesh(8, 8, fault_inject="device-lost:3")
+    assert state_digest(lost.engine) == _clean_mesh_digest(8, 8)
+    assert lost.engine.supervision.reshards == 1
+    assert lost.engine.device_plane._meshinfo.n_devices == 7
+
+
+def test_device_loss_on_two_devices_falls_to_single_plane():
+    """D=2 minus one is not a mesh: the survivor runs the single-device
+    plane (no exchange at all), digest unchanged."""
+    lost = _mesh(2, 1, fault_inject="device-lost:4")
+    assert state_digest(lost.engine) == _clean_mesh_digest(2, 1)
+    assert lost.engine.supervision.reshards == 1
+    assert lost.engine.device_plane._shard is None
+
+
+# -- the ladder climbs back up: device-plane re-promotion ----------------
+
+def test_demote_probation_repromote_roundtrip():
+    """A drilled dispatch failure demotes to the numpy twin; after
+    --repromote-after clean rounds the plane climbs back to the device
+    rung — counted, digest identical to the fault-free run."""
+    rp = _mesh(1, 1, fault_inject="demote-repromote:2", repromote_after=3)
+    plane = rp.engine.device_plane
+    s = rp.engine.supervision.summary()
+    assert s["dispatch_recoveries"] == 1
+    assert s["repromotions"] == 1
+    assert plane.mode == "device" and not plane.demoted
+    assert plane.stats()["repromoted"]
+    assert state_digest(rp.engine) == _clean_mesh_digest(1, 1)
+
+
+def test_demotion_stays_permanent_without_repromote_after():
+    """The ladder's default is unchanged: no --repromote-after, no climb
+    back (the PR-2 permanent-demotion contract)."""
+    perm = _mesh(1, 1, fault_inject="demote-repromote:2")
+    plane = perm.engine.device_plane
+    assert plane.mode == "numpy" and plane.demoted
+    assert not plane.stats()["repromoted"]
+    assert perm.engine.supervision.repromotions == 0
+    assert state_digest(perm.engine) == _clean_mesh_digest(1, 1)
+
+
+# -- and the native round executor rung ----------------------------------
+
+TOR_KW = dict(n_relays=40, n_clients=25, n_servers=3, stoptime=30,
+              stream_spec="512:20480")
+
+
+def _native(**opt_kw):
+    cfg = configuration.parse_xml(workloads.tor_network(**TOR_KW))
+    cfg.stop_time_sec = 30
+    ctrl = Controller(Options(scheduler_policy="global", workers=0, seed=3,
+                              stop_time_sec=30, log_level="warning",
+                              **opt_kw), cfg)
+    assert ctrl.run() == 0
+    return ctrl.engine
+
+
+def _clean_native_digest():
+    if "native" not in _CLEAN:
+        _CLEAN["native"] = state_digest(_native())
+    return _CLEAN["native"]
+
+
+def test_native_round_executor_repromotes_after_probation():
+    eng = _native(fault_inject="native-round:4", repromote_after=5)
+    pol = eng.scheduler.policy
+    s = eng.supervision.summary()
+    assert s["native_round_demotions"] == 1
+    assert s["repromotions"] == 1
+    assert not pol.round_demoted and pol.round_repromoted
+    assert pol.round_windows > 4, "executor never re-engaged after probation"
+    assert state_digest(eng) == _clean_native_digest()
+    scrape = eng.metrics.scrape()
+    assert scrape["native.round_repromoted"] == 1
+    assert scrape["native.round_demoted"] == 0
+
+
+def test_native_round_demotion_permanent_without_flag():
+    eng = _native(fault_inject="native-round:4")
+    assert eng.scheduler.policy.round_demoted
+    assert not eng.scheduler.policy.round_repromoted
+    assert state_digest(eng) == _clean_native_digest()

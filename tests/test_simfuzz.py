@@ -69,7 +69,9 @@ def test_mode_matrix_axes_all_engaged():
             "table_on": False, "mesh": False, "threaded": False,
             "device": False, "exchange_fused": False,
             "exchange_ppermute": False, "autotune_on": False,
-            "autotune_off": False}
+            "autotune_off": False, "resume": False,
+            "fault_resurrect": False, "fault_device_lost": False,
+            "fault_repromote": False}
     for seed in range(40):
         spec = draw_spec(seed)
         seen_fams.add(spec["family"])
@@ -105,6 +107,20 @@ def test_mode_matrix_axes_all_engaged():
                 axes["autotune_off"] = True
             elif m["device_plane"] == "device":
                 axes["autotune_on"] = True
+            # the recovery axes (ISSUE 17): checkpoint+--resume and the
+            # three self-healing drills each face the parity oracle
+            if m.get("resume"):
+                axes["resume"] = True
+            ef = m.get("engine_fault", "") or ""
+            if ef.startswith("shard-exit-resurrect:"):
+                axes["fault_resurrect"] = True
+                assert int(m.get("processes", 0)) >= 2
+            if ef.startswith("device-lost:"):
+                axes["fault_device_lost"] = True
+                assert int(m.get("tpu_devices", 1)) > 1
+            if ef.startswith("demote-repromote:"):
+                axes["fault_repromote"] = True
+                assert int(m.get("repromote_after", 0)) > 0
     missing = sorted(k for k, v in axes.items() if not v)
     assert not missing, f"axes never engaged: {missing} ({seen_modes})"
     assert seen_fams == {"star", "tor", "cdn", "swarm", "phold", "appmix"}
@@ -197,6 +213,26 @@ def test_oracle_supervision_and_mesh():
                            "mesh.occupancy_min": 0.5,
                            "mesh.occupancy_mean": 0.6})]
     assert _oracle_names(check(spec, res)) == ["mesh"]
+
+
+def test_oracle_recovery_drill_modes_exempt():
+    """A mode carrying its own engine_fault (ISSUE 17) legitimately
+    counts recoveries and may reshape the mesh — the supervision and
+    mesh oracles stand down for it, while parity still judges its
+    digest against the fault-free base."""
+    spec = {"fault_inject": None}
+    res = [_result(),
+           _result(mode="procs-resurrect",
+                   engine_fault="shard-exit-resurrect:1:2",
+                   supervision={"recoveries": 2}),
+           _result(mode="mesh-lost", engine_fault="device-lost:3",
+                   scrape={"mesh.host_bounces": 0, "mesh.demoted": 1,
+                           "mesh.occupancy_min": 0.5,
+                           "mesh.occupancy_mean": 0.6})]
+    assert check(spec, res) == []
+    # but a drilled mode's digest drift is STILL a parity violation
+    res[1]["digest"] = "dX"
+    assert _oracle_names(check(spec, res)) == ["parity"]
 
 
 def test_oracle_completion():

@@ -52,8 +52,16 @@ def test_fault_inject_spec_parsing():
         "kind": "plugin-stall", "name": "victim", "nreq": 6}
     assert parse_fault_inject("shard-exit:1:3") == {
         "kind": "shard-exit", "shard": 1, "round": 3}
+    # the self-healing drills (ISSUE 17)
+    assert parse_fault_inject("shard-exit-resurrect:1:3") == {
+        "kind": "shard-exit-resurrect", "shard": 1, "round": 3}
+    assert parse_fault_inject("device-lost:4") == {
+        "kind": "device-lost", "round": 4}
+    assert parse_fault_inject("demote-repromote:2") == {
+        "kind": "demote-repromote", "dispatch": 2}
     for bad in ("nope", "device-dispatch", "plugin-stall:x",
-                "shard-exit:1"):
+                "shard-exit:1", "shard-exit-resurrect:1",
+                "device-lost", "demote-repromote"):
         with pytest.raises(ValueError):
             parse_fault_inject(bad)
 
