@@ -9,8 +9,8 @@ PY ?= python
 BASE ?= HEAD
 
 .PHONY: lint lint-diff gen gen-check spec test bench-smoke bench-multichip \
-	fuzz-smoke profile-smoke fault-smoke check native sanitize \
-	sanitize-thread
+	fuzz-smoke profile-smoke fault-smoke fleet-smoke check native \
+	sanitize sanitize-thread
 
 lint: gen-check
 	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu
@@ -95,9 +95,17 @@ fault-smoke:
 	XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		$(PY) bench.py --fault-smoke
 
-# the lint-adjacent gate set: static analysis + the fuzz/profile/fault
-# smokes
-check: lint fuzz-smoke profile-smoke fault-smoke
+# the fleet-plane smoke (ISSUE 18): a bounded N=8 mixed fleet (drawn
+# from the fuzz generator) run twice — serially (the reference) and as
+# concurrent lanes over ONE shared vmapped device program — digest-gated
+# bit for bit, and fail-closed on a fleet that never fired a batched
+# launch.  `simfleet smoke` prints one JSON summary line, like bench.py.
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) -m shadow_tpu.fleet smoke --lanes 8 --seeds 8
+
+# the lint-adjacent gate set: static analysis + the fuzz/profile/fault/
+# fleet smokes
+check: lint fuzz-smoke profile-smoke fault-smoke fleet-smoke
 
 native:
 	$(MAKE) -C native

@@ -1132,6 +1132,68 @@ def bench_fuzz(n_seeds: int = 4, timeout_sec: int = 600) -> dict:
     return out
 
 
+def bench_fleet(n_seeds: int = 4, lanes: int = 8,
+                timeout_sec: int = 480) -> dict:
+    """ISSUE 18: the fleet-plane columns — the SAME bounded simfuzz
+    sweep as bench_fuzz but over ``--batched`` (one in-process fleet:
+    batchable modes ride concurrent vmapped lanes, one launch advances
+    all of them).  Fail-closed: a crashed/hung leg, a missing summary,
+    or a fleet that never fired a batched launch all land a
+    ``fleet_error`` the gate turns into a failure — never a silent
+    pass.  Verdict parity with the subprocess path is gated separately
+    (``make fleet-smoke`` digest-gates, tests/test_fleet.py pins it);
+    this leg records the N-up THROUGHPUT the plane actually bought."""
+    import subprocess
+    import sys
+
+    cmd = [sys.executable, "-m", "shadow_tpu.fuzz", "--batched",
+           "--lanes", str(lanes), "--seeds", str(n_seeds),
+           "--timeout-sec", "240",
+           "--wall-cap-sec", str(timeout_sec - 120),
+           "--shrink-budget", "8",
+           "--repro-dir", "simfuzz-repros"]
+    t0 = time.perf_counter()
+    try:
+        proc = subprocess.run(cmd, timeout=timeout_sec,
+                              capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return {"fleet_lanes": 0, "fleet_seeds_per_sec": None,
+                "fleet_launches_amortized": None,
+                "fleet_sec": timeout_sec,
+                "fleet_error": f"batched simfuzz exceeded the "
+                               f"{timeout_sec}s bound and was killed"}
+    row = _last_json_row(proc.stdout)
+    out = {"fleet_sec": round(time.perf_counter() - t0, 1)}
+    if proc.returncode not in (0, 1):
+        out.update(fleet_lanes=0, fleet_seeds_per_sec=None,
+                   fleet_launches_amortized=None,
+                   fleet_error=f"batched simfuzz exited "
+                               f"rc={proc.returncode}",
+                   fleet_tail=(proc.stdout + proc.stderr)[-600:])
+        return out
+    fleet = (row or {}).get("simfuzz", {}).get("fleet")
+    if not fleet:
+        out.update(fleet_lanes=0, fleet_seeds_per_sec=None,
+                   fleet_launches_amortized=None,
+                   fleet_error="batched simfuzz produced no fleet stats",
+                   fleet_tail=(proc.stdout + proc.stderr)[-600:])
+        return out
+    out.update(fleet_lanes=fleet.get("fleet.lanes"),
+               fleet_seeds_per_sec=fleet.get("seeds_per_sec"),
+               fleet_launches_amortized=fleet.get(
+                   "fleet.launches_amortized"),
+               fleet_occupancy=fleet.get("fleet.lane_occupancy"),
+               fleet_compiles=fleet.get("fleet.compiles"),
+               fleet_batched_modes=fleet.get("batched_modes"))
+    if not fleet.get("fleet.launches"):
+        out["fleet_error"] = ("the fleet plane never fired a batched "
+                              "launch — the vmapped path was not "
+                              "exercised")
+    if (row or {}).get("simfuzz", {}).get("violations"):
+        out["fleet_violations"] = row["simfuzz"]["violations"]
+    return out
+
+
 def bench_prof(timeout_sec: int = 420) -> dict:
     """ISSUE 15: the cost-observatory columns — a bounded QUICK
     calibration (subprocess, temp output path: the checked-in per-box
@@ -1643,6 +1705,7 @@ def main() -> None:
     sims = bench_full_sims()
     sims.update(bench_scale())
     fuzz_cols = bench_fuzz()
+    fleet_cols = bench_fleet()
     prof_cols = bench_prof()
     # model-stale evidence across every flagship/device row this round
     # (prof.model_stale is 0 when no model loaded — the gate is on
@@ -1848,6 +1911,12 @@ def main() -> None:
         "fuzz_seeds": fuzz_cols.get("fuzz_seeds"),
         "fuzz_violations": fuzz_cols.get("fuzz_violations"),
         "fuzz_sec": fuzz_cols.get("fuzz_sec"),
+        # fleet plane (ISSUE 18): the batched N-up sweep must really
+        # batch (launches_amortized > 1 on a healthy mixed draw) and its
+        # throughput column is the tracked seeds/sec number
+        "fleet_lanes": fleet_cols.get("fleet_lanes"),
+        "fleet_seeds_per_sec": fleet_cols.get("fleet_seeds_per_sec"),
+        "launches_amortized": fleet_cols.get("fleet_launches_amortized"),
         "scen_cdn_pass": sims.get("scen_cdn_pass"),
         "scen_swarm_pass": sims.get("scen_swarm_pass"),
         # cost observatory (ISSUE 15): the bounded quick-calibrate leg
@@ -1868,6 +1937,7 @@ def main() -> None:
         "tor10k_device_plane_long", "tor10k_device_plane_native_long",
         "scale_star10k", "scale_star100k", "scale_tor100k",
         "scen_cdn", "scen_swarm") if isinstance(sims.get(k), dict)}
+    hist_rows["fleet"] = fleet_cols
     hist_rows["headline"] = summary
     append_bench_rows(hist_rows)
     # The gate GATES (VERDICT r4 weak #3: it used to record and exit 0):
@@ -1905,6 +1975,14 @@ def main() -> None:
             f"repros: {fuzz_cols.get('fuzz_repros')}")
     elif fuzz_cols.get("fuzz_error"):
         failures.append(f"fuzz leg failed: {fuzz_cols['fuzz_error']}")
+    # ISSUE 18 (fail-closed): the batched leg must produce fleet stats
+    # with real launches; violations on it are the same gate as fuzz
+    if fleet_cols.get("fleet_error"):
+        failures.append(f"fleet leg failed: {fleet_cols['fleet_error']}")
+    elif fleet_cols.get("fleet_violations"):
+        failures.append(
+            f"batched simfuzz found {fleet_cols['fleet_violations']} "
+            "violation(s)")
     for key in ("scen_cdn_pass", "scen_swarm_pass"):
         if sims.get(key) is False:
             failures.append(f"{key} failed: {sims.get(key[:-5])}")

@@ -112,8 +112,19 @@ class SimLogger:
 
 _default: Optional[SimLogger] = None
 
+# Fleet lanes (ISSUE 18) run one engine per THREAD in a shared process;
+# the process-global default would interleave every lane's records into
+# one stream (and one lane's log_tail would leak into another's fuzz
+# verdict).  A thread sets its own logger here and get_logger() prefers
+# it — the process-global behavior is unchanged for every existing
+# single-engine caller.
+_tls = threading.local()
+
 
 def get_logger() -> SimLogger:
+    overlay = getattr(_tls, "logger", None)
+    if overlay is not None:
+        return overlay
     global _default
     if _default is None:
         _default = SimLogger()
@@ -123,3 +134,9 @@ def get_logger() -> SimLogger:
 def set_logger(logger: SimLogger) -> None:
     global _default
     _default = logger
+
+
+def set_thread_logger(logger: Optional[SimLogger]) -> None:
+    """Route this THREAD's get_logger() to ``logger`` (None clears the
+    overlay, falling back to the process-global default)."""
+    _tls.logger = logger

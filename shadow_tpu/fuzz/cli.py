@@ -108,7 +108,26 @@ def fuzz(args, runner) -> int:
         targets = [(int(pinned.get("seed", 0)), pinned)]
     else:
         targets = [(args.seed_base + i, None) for i in range(args.seeds)]
-    for seed, pinned in targets:
+    prefetched = None
+    if hasattr(runner, "run_specs") and not args.spec_only:
+        # --batched (ISSUE 18): draw the WHOLE seed list up front and
+        # fleet-run every spec's mode matrix in one two-phase pass (the
+        # batchable modes as concurrent vmapped lanes, the rest warm and
+        # serial); the judge/shrink loop below then reads the prefetched
+        # results instead of running per seed.  Verdicts are
+        # digest-identical to the subprocess path — same specs, same
+        # run_one_mode, same oracles.
+        drawn = []
+        for seed, pinned in targets:
+            spec = pinned if pinned is not None else draw_spec(seed)
+            if fault:
+                spec["fault_inject"] = fault
+            drawn.append(spec)
+        _say(f"batched: {len(drawn)} specs over the fleet plane")
+        prefetched = runner.run_specs(drawn)
+        targets = [(seed, spec)
+                   for (seed, _), spec in zip(targets, drawn)]
+    for idx, (seed, pinned) in enumerate(targets):
         if args.wall_cap_sec and \
                 _walltime.monotonic() - t0 > args.wall_cap_sec:
             wall_capped = True
@@ -116,13 +135,14 @@ def fuzz(args, runner) -> int:
                  f"{seeds_run} seeds; stopping early (honestly reported)")
             break
         spec = pinned if pinned is not None else draw_spec(seed)
-        if fault:
+        if fault and prefetched is None:
             spec["fault_inject"] = fault
         if args.spec_only:
             print(json.dumps(spec))
             seeds_run += 1
             continue
-        results = runner.run(spec)
+        results = prefetched[idx] if prefetched is not None \
+            else runner.run(spec)
         viols = check(spec, results)
         seeds_run += 1
         modes_run = sum(1 for r in results if not r.get("skipped"))
@@ -155,15 +175,24 @@ def fuzz(args, runner) -> int:
              f"(replay: simfuzz --repro {path})")
         if args.stop_on_violation:
             break
+    wall = _walltime.monotonic() - t0
     summary = {"simfuzz": {"seeds": seeds_run,
                            "requested_seeds": len(targets),
                            "wall_capped": wall_capped,
                            "violations": len(all_violations),
                            "repros": repros,
                            "fault_inject": args.fault_inject or None,
-                           "wall_sec": round(_walltime.monotonic() - t0,
-                                             1)},
+                           "wall_sec": round(wall, 1)},
                "pass": not all_violations}
+    if prefetched is not None:
+        # fleet attribution (ISSUE 18): N-up plane throughput plus the
+        # plane's own launch-amortization/occupancy/compile counters
+        summary["simfuzz"]["fleet"] = dict(
+            runner.plane_stats(),
+            lanes_requested=getattr(args, "lanes", 0),
+            batched_modes=runner.batched_modes,
+            serial_modes=runner.serial_modes,
+            seeds_per_sec=round(seeds_run / wall, 3) if wall else 0.0)
     if args.out:
         with open(args.out, "w") as f:
             json.dump(dict(summary, violations=all_violations), f,
@@ -207,6 +236,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--in-process", action="store_true", dest="in_process",
                    help="run scenarios in this process (tests/corpus; "
                         "production fuzzing uses bounded children)")
+    p.add_argument("--batched", action="store_true",
+                   help="run the whole seed list in-process over the "
+                        "fleet plane (ISSUE 18): batchable modes as "
+                        "concurrent vmapped lanes, the rest warm and "
+                        "serial — digest-identical to the subprocess "
+                        "path, >= 5x the seeds/sec")
+    p.add_argument("--lanes", type=int, default=8,
+                   help="concurrent fleet lanes with --batched")
     p.add_argument("--spec-only", action="store_true", dest="spec_only",
                    help="print the drawn specs as JSON, run nothing")
     p.add_argument("--out", default=None,
@@ -230,8 +267,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     if args.child:
         return child_main(args.child[0], args.child[1])
-    runner = InProcessRunner() if args.in_process \
-        else SubprocessRunner(timeout_sec=args.timeout_sec)
+    if args.batched:
+        # env must be pinned before jax initializes (the fleet cli owns
+        # the one shared helper) so phase-2 mesh modes see the virtual
+        # device mesh in-process, exactly like subprocess children do
+        from ..fleet.cli import setup_fleet_env
+        setup_fleet_env()
+        from .runner import BatchedRunner
+        runner = BatchedRunner(lanes=args.lanes)
+    elif args.in_process:
+        runner = InProcessRunner()
+    else:
+        runner = SubprocessRunner(timeout_sec=args.timeout_sec)
     if args.repro:
         return replay_file(args.repro, runner)
     if args.corpus is not None:

@@ -115,12 +115,20 @@ def _run_resume_mode(spec: Dict, opts, out: Dict) -> None:
         out["supervision"] = eng.supervision.summary()
 
 
-def run_one_mode(spec: Dict, mode: Dict) -> Dict:
+def run_one_mode(spec: Dict, mode: Dict, lane=None) -> Dict:
     """Run the spec under one mode.  Never raises: harness errors land in
-    the result as rc=-1 + traceback (the rc/log oracle fails them)."""
+    the result as rc=-1 + traceback (the rc/log oracle fails them).
+
+    With ``lane`` (a :class:`shadow_tpu.fleet.FleetLane`, ISSUE 18) the
+    mode runs as a fleet batch lane: the engine's device dispatches ride
+    the shared vmapped plane and the log capture moves to a THREAD-local
+    logger so concurrent lanes keep separate tails.  Everything else —
+    digest, events, supervision, scrape — is the identical code path,
+    which is what makes batched verdicts digest-identical to the
+    subprocess path."""
     from ..core.checkpoint import state_digest
     from ..core.controller import Controller
-    from ..core.logger import SimLogger, set_logger
+    from ..core.logger import SimLogger, set_logger, set_thread_logger
 
     out: Dict = {"mode": mode["name"],
                  "repeat_of": mode.get("repeat_of"),
@@ -135,11 +143,16 @@ def run_one_mode(spec: Dict, mode: Dict) -> Dict:
         out["skipped"] = reason
         return out
     buf = io.StringIO()
-    set_logger(SimLogger(stream=buf, level="warning"))
+    if lane is not None:
+        set_thread_logger(SimLogger(stream=buf, level="warning"))
+    else:
+        set_logger(SimLogger(stream=buf, level="warning"))
     t0 = _walltime.perf_counter()
     try:
         cfg = build_config(spec)
         opts = _mode_options(spec, mode)
+        if lane is not None:
+            opts._fleet_lane = lane
         if mode.get("resume"):
             _run_resume_mode(spec, opts, out)
         elif opts.processes >= 2:
@@ -165,6 +178,9 @@ def run_one_mode(spec: Dict, mode: Dict) -> Dict:
     except Exception:
         out["rc"] = -1
         buf.write("\n" + traceback.format_exc())
+    finally:
+        if lane is not None:
+            set_thread_logger(None)
     out["wall_sec"] = round(_walltime.perf_counter() - t0, 3)
     out["log_tail"] = buf.getvalue()[-2000:]
     return out
@@ -221,6 +237,26 @@ def run_modes(spec: Dict, modes: Optional[List[Dict]] = None) -> List[Dict]:
     return results
 
 
+def mode_batchable(spec: Dict, mode: Dict) -> bool:
+    """Modes the fleet plane can carry as a batch lane (ISSUE 18):
+    single-process, single-threaded, single-device python-dataplane runs
+    with no engine fault — the shapes whose device dispatches are plain
+    span/flush kernel calls the vmapped program reproduces bit-exactly.
+    Everything else (mesh, procs, threaded, native, engine-fault drills)
+    runs in phase 2, sequentially in the same process, still sharing the
+    warm jit cache.  ``resume`` modes ARE batchable: both controller
+    passes ride the same lane back to back."""
+    fault = spec.get("fault_inject") or {}
+    if fault.get("kind") == "engine" or mode.get("engine_fault"):
+        return False
+    return (int(mode.get("workers", 0)) == 0
+            and int(mode.get("processes", 0)) == 0
+            and int(mode.get("tpu_devices", 1)) == 1
+            and mode.get("device_plane", "device") == "device"
+            and mode.get("dataplane", "python") == "python"
+            and mode.get("policy", "global") == "global")
+
+
 # ---------------------------------------------------------------------------
 # bounded subprocess execution
 # ---------------------------------------------------------------------------
@@ -229,6 +265,8 @@ def child_env(n_dev: int = 8) -> Dict[str, str]:
     """Child env: CPU-pinned with the virtual device mesh (the same mesh
     the test suite and bench-multichip use), so mesh modes run anywhere;
     a pre-pinned accelerator environment is left alone."""
+    import tempfile
+
     env = os.environ.copy()
     if env.get("JAX_PLATFORMS", "").strip() in ("", "cpu"):
         env["JAX_PLATFORMS"] = "cpu"
@@ -238,6 +276,22 @@ def child_env(n_dev: int = 8) -> Dict[str, str]:
                 flags + f" --xla_force_host_platform_device_count={n_dev}"
             ).strip()
     env.pop("PALLAS_AXON_POOL_IPS", None)
+    # ONE persistent XLA compile cache shared by every child (ISSUE 18):
+    # without it each child re-compiles the identical span/flush kernels
+    # from scratch, which dominated the 25-seeds/374s subprocess wall.
+    # Thresholds at 0 so even the fast CPU compiles are cached; a caller
+    # that already pinned a cache dir keeps it.
+    if "JAX_COMPILATION_CACHE_DIR" not in env:
+        cache = os.path.join(tempfile.gettempdir(), "shadow-tpu-xla-cache")
+        try:
+            os.makedirs(cache, exist_ok=True)
+            env["JAX_COMPILATION_CACHE_DIR"] = cache
+            env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                           "0")
+            env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES",
+                           "0")
+        except OSError:
+            pass    # an unwritable tmpdir just means no cache sharing
     return env
 
 
@@ -311,3 +365,54 @@ class InProcessRunner:
 
     def run(self, spec: Dict) -> List[Dict]:
         return run_modes(spec)
+
+
+class BatchedRunner:
+    """``simfuzz --batched`` (ISSUE 18): the whole seed list's mode
+    matrices in ONE process over the fleet plane.
+
+    Two phases.  Phase 1 fans every spec's *batchable* modes (see
+    :func:`mode_batchable`) out as fleet lanes — N concurrent engines
+    whose device dispatches merge into vmapped launches, sharing one
+    compiled program per shape class.  Phase 2 runs the remaining modes
+    (mesh/procs/threaded/native/fault drills) sequentially, still inside
+    the warm process so nothing recompiles.  Per-spec result lists come
+    back in mode order with fault drift applied — byte-for-byte the
+    shape SubprocessRunner returns, so the oracle set and the shrinker
+    are reused unchanged."""
+
+    def __init__(self, lanes: int = 8, use_numpy: bool = False):
+        from ..fleet.driver import FleetDriver
+        self.driver = FleetDriver(lanes=lanes, use_numpy=use_numpy)
+        self.batched_modes = 0
+        self.serial_modes = 0
+
+    def plane_stats(self) -> Dict:
+        return self.driver.plane.metrics()
+
+    def run_specs(self, specs: List[Dict]) -> List[List[Dict]]:
+        jobs = []
+        slots = []
+        table: List[List[Optional[Dict]]] = [
+            [None] * len(spec["modes"]) for spec in specs]
+        for si, spec in enumerate(specs):
+            for mi, mode in enumerate(spec["modes"]):
+                if mode_batchable(spec, mode):
+                    jobs.append(lambda lane, s=spec, m=mode:
+                                run_one_mode(s, m, lane=lane))
+                    slots.append((si, mi))
+        for (si, mi), result in zip(slots, self.driver.run(jobs)):
+            table[si][mi] = result
+        self.batched_modes += len(jobs)
+        for si, spec in enumerate(specs):
+            for mi, mode in enumerate(spec["modes"]):
+                if table[si][mi] is None:
+                    table[si][mi] = run_one_mode(spec, mode)
+                    self.serial_modes += 1
+        return [[apply_fault(spec, r) for r in rows]
+                for spec, rows in zip(specs, table)]
+
+    def run(self, spec: Dict) -> List[Dict]:
+        """Single-spec entry (shrink candidates, --repro, --corpus):
+        the same two-phase path at fleet width 1."""
+        return self.run_specs([spec])[0]

@@ -317,6 +317,24 @@ def read_metrics_file(path: str) -> List[Dict]:
     return out
 
 
+def fleet_source(fleet_plane) -> Callable[[], Dict]:
+    """Scrape-time provider for the shared fleet plane (ISSUE 18).
+
+    The ``fleet.*`` namespace an engine run as a batch lane exposes:
+    ``fleet.lanes`` (peak concurrent lanes), ``fleet.lane_occupancy``
+    (mean filled fraction of the batched launches), ``fleet.launches`` /
+    ``fleet.lane_dispatches`` / ``fleet.launches_amortized`` (how many
+    per-lane dispatches each device launch carried), and
+    ``fleet.shape_classes`` / ``fleet.compiles`` (how many programs XLA
+    actually built — the re-arm-without-recompile proof).  The values
+    are PLANE-global (every lane of one fleet scrapes the same numbers),
+    which is why the fuzz oracles' scrape filter deliberately excludes
+    the namespace: it describes the co-schedule, not the scenario."""
+    def _scrape() -> Dict:
+        return fleet_plane.metrics()
+    return _scrape
+
+
 _default: Optional[MetricsRegistry] = None
 
 

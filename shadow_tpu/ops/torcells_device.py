@@ -569,6 +569,55 @@ def step_window_flush_for_backend():
     return torcells_step_window_flush
 
 
+# Fleet plane (ISSUE 18): the SAME span/flush program vmapped over a
+# leading batch axis so one launch advances W independent simulations.
+# Every operand — carried state, injections, superwindow targets, AND the
+# static flow tables — carries its own lane row (lanes are independent
+# scenarios padded to a shared shape class; tables differ per lane).  The
+# batching rules keep per-lane semantics exact: the while_loop's cond
+# becomes "any lane still below its span end" with finished lanes
+# select()-frozen at their halt state, and every body op is int64
+# cumsum/min/clip/segment arithmetic — bit-identical per lane to the
+# unbatched kernel, which is what lets the fleet digest-gate against the
+# serial path.  Never donating: the fleet runs on the CPU dispatch path
+# (see the backend note above) and the driver re-pads carried real-shaped
+# state per dispatch.
+@partial(jax.jit, static_argnames=("ring_len",))
+def torcells_step_span_flush_batched(t0, queued, ring, tokens, delivered,
+                                     target, done_tick, node_sent, inject,
+                                     inject_target, targets, idle_ticks,
+                                     flow_node, flow_lat, flow_succ,
+                                     seg_start, refill, capacity, last_flow,
+                                     ring_len: int):
+    """[W]-leading-axis twin of torcells_step_window_flush: 10-tuple with
+    every element batched ([W] t_stop/forwards scalars, [W, F] columns,
+    [W, L, F] rings, [W, flush_len] flush buffers)."""
+    fn = partial(_step_span_flush_impl, ring_len=ring_len)
+    return jax.vmap(fn)(t0, queued, ring, tokens, delivered, target,
+                        done_tick, node_sent, inject, inject_target,
+                        targets, idle_ticks, flow_node, flow_lat,
+                        flow_succ, seg_start, refill, capacity, last_flow)
+
+
+def torcells_step_span_batched_numpy(t0, queued, ring, tokens, delivered,
+                                     target, done_tick, node_sent, inject,
+                                     inject_target, targets, idle_ticks,
+                                     flow_node, flow_lat, flow_succ,
+                                     seg_start, refill, capacity, last_flow,
+                                     ring_len: int):
+    """Host twin of torcells_step_span_flush_batched: lanes looped through
+    the unbatched numpy flush twin and re-stacked (same 10-tuple/leading-
+    axis contract) — the parity oracle for the vmapped program."""
+    outs = [torcells_step_window_numpy_flush(
+        np.int64(t0[w]), queued[w], ring[w], tokens[w], delivered[w],
+        target[w], done_tick[w], node_sent[w], inject[w], inject_target[w],
+        targets[w], int(idle_ticks[w]), flow_node[w], flow_lat[w],
+        flow_succ[w], seg_start[w], refill[w], capacity[w], last_flow[w],
+        ring_len) for w in range(len(t0))]
+    return tuple(np.stack([np.asarray(o[i]) for o in outs])
+                 for i in range(10))
+
+
 def torcells_step_span_numpy(t0, queued, ring, tokens, delivered, target,
                              done_tick, node_sent, inject, inject_target,
                              targets, idle_ticks, flow_node, flow_lat,

@@ -463,6 +463,24 @@ class DeviceTrafficPlane:
         self._replay_base = None   # state stash at re-promotion: a second
                                    # failure replays base + log, then the
                                    # numpy demotion is permanent
+        # fleet lane (ISSUE 18): an engine run as a fleet batch lane
+        # carries a FleetLane on its options; this plane's device
+        # dispatches then ride the shared vmapped program (lane.dispatch
+        # pads to the shape class, the batched launch advances every
+        # parked lane at once, the lane unpads this plane's row).  The
+        # lane path is synchronous (the digest-pinned --device-plane-sync
+        # shape) and single-device only — sharded meshes keep their own
+        # program.  Flush caps stay off: the lane's flush section is
+        # always full-length (repacked host-side), so the capped variant
+        # would only add an overflow path the batch cannot re-run.
+        self._lane = None
+        lane = getattr(engine.options, "_fleet_lane", None)
+        if lane is not None and mode == "device" and self._shard is None:
+            self._flush_caps = None
+            self._lane = lane
+            lane.attach_plane(self)
+            from ..obs.metrics import fleet_source
+            engine.metrics.source("fleet", fleet_source(lane.plane))
 
     # -- static layout ----------------------------------------------------
     def _build_layout(self, engine) -> None:
@@ -889,6 +907,11 @@ class DeviceTrafficPlane:
         bench excludes them from timed walls).  No plane state is touched."""
         if self.mode != "device":
             return
+        if self._lane is not None:
+            # fleet lanes share the batched program, compiled once per
+            # (shape class, width) at the first launch — a per-lane
+            # warmup would compile the UNBATCHED kernel nobody calls
+            return
         import jax.numpy as jnp
         from ..ops.torcells_device import (RING_DTYPE,
                                            step_window_flush_for_backend)
@@ -1120,6 +1143,16 @@ class DeviceTrafficPlane:
                 lay["succ_global"], lay["seg_start_local"],
                 lay["refill"], lay["capacity"], lay["arr_lat"],
                 lay["shard_base"])
+        elif self.mode == "device" and self._lane is not None:
+            # fleet lane (ISSUE 18): the dispatch parks at the shared
+            # plane's barrier and returns this lane's row of the vmapped
+            # launch — a real-shaped, already-materialized numpy
+            # 10-tuple, so consume() runs unchanged (the collect is a
+            # no-op np.asarray).  Synchronous by construction: the
+            # digest-pinned --device-plane-sync shape.
+            out = self._lane.dispatch(state, np.asarray(inject),
+                                      np.asarray(inject_target), tvec,
+                                      int(idle))
         elif self.mode == "device":
             if self._flush_step is None:
                 from ..ops.torcells_device import (

@@ -80,17 +80,23 @@ class TunePlan:
     __slots__ = ("source", "superwindow_rounds", "min_dispatch_steps",
                  "granule_source", "flush_compact", "flush_cap_chains",
                  "flush_cap_nodes", "predicted_step_us",
-                 "predicted_fixed_us", "flush_bytes_cap_saved")
+                 "predicted_fixed_us", "flush_bytes_cap_saved", "k_would")
 
     def __init__(self, source: str, superwindow_rounds: int,
                  min_dispatch_steps: int, flush_compact: bool = False,
                  flush_cap_chains: int = 0, flush_cap_nodes: int = 0,
                  predicted_step_us: float = 0.0,
                  predicted_fixed_us: float = 0.0,
-                 flush_bytes_cap_saved: int = 0):
+                 flush_bytes_cap_saved: int = 0,
+                 k_would: Optional[int] = None):
         self.source = source
         self.superwindow_rounds = superwindow_rounds
         self.min_dispatch_steps = min_dispatch_steps
+        # what the model WOULD have chosen for K had nothing pinned it —
+        # equals superwindow_rounds when the tuner actually decided (or
+        # had no model to decide with); diverges when a user-set K or
+        # ``--device-autotune off`` overrode a live model's preference
+        self.k_would = superwindow_rounds if k_would is None else k_would
         # cadence + granule are digest-bearing: always contract values
         self.granule_source = "contract"
         self.flush_compact = flush_compact
@@ -107,6 +113,7 @@ class TunePlan:
         return {
             "prof.autotune_source": self.source,
             "prof.autotune_k": self.superwindow_rounds,
+            "prof.autotune_k_would": self.k_would,
             "prof.autotune_cadence": self.min_dispatch_steps,
             "prof.autotune_granule": self.granule_source,
             "prof.autotune_flush_compact": int(self.flush_compact),
@@ -150,16 +157,27 @@ def plan_dispatch(model, model_status: str, options,
     cadence = max(1, int(getattr(options, "device_plane_batch_steps",
                                  DEFAULT_CADENCE)))
     autotune = str(getattr(options, "device_autotune", "on") or "on")
+    usable = (model is not None and model_status == "loaded"
+              and model.covers(n_flows))
     if autotune == "off":
-        return TunePlan("off", k_opt, cadence)
-    if model is None or model_status != "loaded" \
-            or not model.covers(n_flows):
+        # still RECORD what the model would have chosen (ISSUE 18): a
+        # pinned run's metrics carry the counterfactual K, so perf
+        # triage can see how far the hand value sits from the tuned one
+        k_would = None
+        if usable:
+            per_step = model.step_us(n_flows) + max(exchange_tick_us, 0.0)
+            k_would = _tuned_k(model, per_step, cadence)
+        return TunePlan("off", k_opt, cadence, k_would=k_would)
+    if not usable:
         # no measured basis on this box (or the table is outside the
         # calibrated range): hand defaults, exactly the pre-16 loop
         return TunePlan("defaults", k_opt, cadence)
     per_step = model.step_us(n_flows) + max(exchange_tick_us, 0.0)
-    # a knob the user moved off its hand default is theirs, not ours
-    k = _tuned_k(model, per_step, cadence) if k_opt == DEFAULT_K else k_opt
+    # a knob the user moved off its hand default is theirs, not ours —
+    # but the preference is computed regardless, so the audit trail
+    # records the would-have-chosen K even when the knob is pinned
+    k_model = _tuned_k(model, per_step, cadence)
+    k = k_model if k_opt == DEFAULT_K else k_opt
     # delta-compacted flush: ON only when the measured size slope says
     # the readback bytes saved beat the compaction's extra kernel work
     from ..ops.torcells_device import flush_len
@@ -174,4 +192,5 @@ def plan_dispatch(model, model_status: str, options,
                     flush_cap_nodes=cap_h if compact else 0,
                     predicted_step_us=per_step,
                     predicted_fixed_us=model.transfer_us(),
-                    flush_bytes_cap_saved=bytes_saved if compact else 0)
+                    flush_bytes_cap_saved=bytes_saved if compact else 0,
+                    k_would=k_model)

@@ -183,6 +183,70 @@ def measure_step_kernel(flow_points, steps: int,
     return {"points": points}, truncated
 
 
+def measure_batched_step_kernel(widths=(1, 2, 4, 8), n_circ: int = 1000,
+                                steps: int = 200,
+                                deadline: Optional[float] = None
+                                ) -> Tuple[Dict, bool]:
+    """Fleet-plane width sweep (ISSUE 18): per-lane per-tick cost of the
+    VMAPPED span-flush kernel at widths 1..W — the measured answer to
+    "how many co-resident simulations does one ~320 us launch amortize
+    over before the compute wall bites".  Reported in the calibrate
+    status row ONLY; the stamped COSTMODEL stays the single-lane model
+    every existing consumer (autotune, launch attribution) is keyed by."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..ops.torcells_device import (
+        RING_DTYPE, DeviceTorCells, torcells_step_span_flush_batched)
+
+    inst = DeviceTorCells(n_relays=max(8, n_circ // 10),
+                          n_circuits=n_circ, seed=11,
+                          relay_bw_kibps=4096, max_latency_ms=30)
+    fl = inst.flows
+    f = inst.n_flows
+    h = len(inst.refill)
+    last_flow = np.flatnonzero(fl["flow_succ"] < 0)
+    queued0 = (fl["flow_stage"] == 0).astype("int64") * 50
+    target0 = (fl["flow_succ"] < 0).astype("int64") * 50
+    lane_state = (np.int64(0), np.zeros(f, np.int64),
+                  np.zeros((inst.ring_len, f), RING_DTYPE),
+                  np.asarray(inst.capacity), np.zeros(f, np.int64),
+                  np.zeros(f, np.int64), np.full(f, -1, np.int64),
+                  np.zeros(h, np.int64))
+    tables = (np.asarray(fl["flow_node"]), np.asarray(fl["flow_lat"]),
+              np.asarray(fl["flow_succ"]), np.asarray(fl["seg_start"]),
+              np.asarray(inst.refill), np.asarray(inst.capacity),
+              np.asarray(last_flow))
+    points: List[Dict] = []
+    truncated = False
+    base_us = None
+    for w in widths:
+        if _deadline_left(deadline) <= 0:
+            truncated = True
+            break
+        lane = (*lane_state, queued0, target0,
+                np.array([steps], dtype=np.int64), np.int64(0), *tables)
+        batch = tuple(jnp.asarray(np.stack([np.asarray(a)] * w))
+                      for a in lane)
+        out = torcells_step_span_flush_batched(
+            *batch, ring_len=inst.ring_len)
+        jax.block_until_ready(out)                    # compile
+        t0 = _walltime.perf_counter()
+        out = torcells_step_span_flush_batched(
+            *batch, ring_len=inst.ring_len)
+        jax.block_until_ready(out)
+        t1 = _walltime.perf_counter()
+        lane_us = (t1 - t0) / steps / w * 1e6
+        if base_us is None:
+            base_us = lane_us
+        points.append({"width": int(w), "flows": int(f),
+                       "us_per_lane_step": round(lane_us, 3),
+                       "speedup_vs_serial": round(base_us / lane_us, 2)
+                       if lane_us > 0 else 0.0})
+    return {"points": points}, truncated
+
+
 def measure_transfer(reps: int = 30, flows: int = 4096,
                      big_flows: int = 65536) -> Dict:
     """Fixed per-launch transfer cost: inject upload + flush readback.
@@ -217,7 +281,8 @@ def measure_transfer(reps: int = 30, flows: int = 4096,
 
 
 def calibrate_child(out_path: str, quick: bool, wall_cap_sec: float,
-                    devices: Optional[List[int]] = None) -> int:
+                    devices: Optional[List[int]] = None,
+                    batched: bool = False) -> int:
     """The in-subprocess half: run every probe under the wall deadline
     and write raw measurements (+ truncated flag + wall) as JSON."""
     t0 = _walltime.monotonic()
@@ -238,6 +303,12 @@ def calibrate_child(out_path: str, quick: bool, wall_cap_sec: float,
         "truncated": bool(trunc_c or trunc_s),
         "wall_sec": round(_walltime.monotonic() - t0, 2),
     }
+    if batched:
+        fleet, trunc_b = measure_batched_step_kernel(
+            n_circ=200 if quick else 1000,
+            steps=100 if quick else 200, deadline=deadline)
+        fleet["truncated"] = trunc_b
+        payload["fleet_batched"] = fleet
     tmp = out_path + ".tmp"
     with open(tmp, "w") as f:
         json.dump(payload, f)
@@ -248,7 +319,7 @@ def calibrate_child(out_path: str, quick: bool, wall_cap_sec: float,
 def run_calibration(out_path: str, quick: bool = False,
                     wall_cap_sec: float = 600.0,
                     devices: Optional[List[int]] = None,
-                    n_dev_env: int = 8) -> Dict:
+                    n_dev_env: int = 8, batched: bool = False) -> Dict:
     """Parent orchestration: spawn the bounded child with the virtual
     device mesh forced on CPU, wrap its measurements into the stamped
     model, write ``out_path`` atomically.  Returns a status row
@@ -268,6 +339,8 @@ def run_calibration(out_path: str, quick: bool = False,
                 "--child", mpath, "--wall-cap-sec", str(wall_cap_sec)]
         if quick:
             args.append("--quick")
+        if batched:
+            args.append("--batched")
         if devices:
             args += ["--devices", ",".join(str(d) for d in devices)]
         try:
@@ -285,6 +358,10 @@ def run_calibration(out_path: str, quick: bool = False,
                     "tail": (proc.stdout + proc.stderr)[-800:]}
         with open(mpath) as f:
             meas = json.load(f)
+    # the fleet width sweep rides in the STATUS ROW only — popped before
+    # build_model so the stamped COSTMODEL stays the single-lane model
+    # (its digest/schema consumers are all keyed by one-lane costs)
+    fleet_batched = meas.pop("fleet_batched", None)
     data = _model.build_model(
         meas, wall_sec=_walltime.monotonic() - t0,
         truncated=bool(meas.get("truncated")))
@@ -294,6 +371,7 @@ def run_calibration(out_path: str, quick: bool = False,
     _model.save_model(out_path, data)
     n_coll = sum(len(t) for t in data["collectives"].values())
     return {"ok": True, "path": out_path,
+            **({"fleet_batched": fleet_batched} if fleet_batched else {}),
             "wall_sec": round(_walltime.monotonic() - t0, 1),
             "collective_points": n_coll,
             "step_points": len(data["step_kernel"]["points"]),

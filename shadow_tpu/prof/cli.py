@@ -3,9 +3,11 @@
 Subcommands:
 
 * ``simprof calibrate [--out PATH] [--quick] [--wall-cap-sec N]
-  [--devices 2,3,4,8]`` — microbenchmark this box into a stamped
-  ``COSTMODEL.json`` (bounded subprocess; see calibrate.py).  The
-  hidden ``--child`` form is the in-subprocess half.
+  [--devices 2,3,4,8] [--batched]`` — microbenchmark this box into a
+  stamped ``COSTMODEL.json`` (bounded subprocess; see calibrate.py).
+  ``--batched`` additionally sweeps the vmapped fleet kernel at widths
+  1/2/4/8, reported in the status row only.  The hidden ``--child``
+  form is the in-subprocess half.
 * ``simprof check [PATH]`` — validate a checked-in model: schema,
   digest currency, and the REFUSAL drills (a fingerprint-mutated and a
   measurement-tampered copy must both refuse to load) — the CI gate
@@ -40,11 +42,13 @@ def cmd_calibrate(args) -> int:
 
     if args.child:
         return calibrate_child(args.child, args.quick, args.wall_cap_sec,
-                               _parse_devices(args.devices))
+                               _parse_devices(args.devices),
+                               batched=args.batched)
     out = args.out or _default_path()
     row = run_calibration(out, quick=args.quick,
                           wall_cap_sec=args.wall_cap_sec,
-                          devices=_parse_devices(args.devices))
+                          devices=_parse_devices(args.devices),
+                          batched=args.batched)
     print(json.dumps({"simprof_calibrate": row}), flush=True)
     return 0 if row.get("ok") else 1
 
@@ -189,6 +193,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                    dest="wall_cap_sec")
     c.add_argument("--devices", default=None,
                    help="comma-separated mesh sizes (default 2,3,4,8)")
+    c.add_argument("--batched", action="store_true",
+                   help="also sweep the vmapped fleet kernel at widths "
+                        "1/2/4/8 (ISSUE 18) — reported in the status "
+                        "row only, never stamped into the COSTMODEL")
     c.add_argument("--child", default=None, metavar="OUT",
                    help=argparse.SUPPRESS)   # in-subprocess half
     c.set_defaults(fn=cmd_calibrate)
