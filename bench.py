@@ -435,11 +435,12 @@ def _run_procs(xml, n_procs: int, stop: int, policy: str = "global") -> dict:
     }
 
 
-def bench_cubic_parity():
-    """ISSUE 11 payoff gate: the spec-defined CUBIC variant (cubicx),
-    materialized by simgen on the Python and C planes, must produce
-    bit-identical state digests at runtime.  Small lossy two-host echo —
-    enough loss events that the variant's (C, beta) actually engage.
+def bench_cc_parity(cc: str = "cubicx"):
+    """ISSUE 11/19 payoff gate: a spec-defined CC family (cubicx's
+    coefficients, bbrx's generated logic surface), materialized by simgen
+    on the Python and C planes, must produce bit-identical state digests
+    at runtime.  Small lossy two-host echo — enough loss events that the
+    variant's coefficients/logic actually engage.
 
     Tri-state so the column can't lie: True = parity held, False = the
     planes DIVERGED, and a string names why the gate could not run
@@ -492,7 +493,7 @@ def bench_cubic_parity():
             ctrl = Controller(
                 Options(scheduler_policy="global", workers=0,
                         stop_time_sec=300, seed=42, dataplane=plane,
-                        tcp_congestion_control="cubicx"), cfg)
+                        tcp_congestion_control=cc), cfg)
             rc = ctrl.run()
             if rc != 0:
                 return f"error: {plane} plane run exited rc={rc}"
@@ -869,7 +870,12 @@ def bench_scale() -> dict:
         "genscen.build('cdn20k')", prefix="bench-cdn-")
     out["scen_swarm"] = _sharded_scenario_row(
         "genscen.build('swarm2k')", prefix="bench-swarm-")
-    for key in ("scen_cdn", "scen_swarm"):
+    # the onion-route + constant-rate-cover shape (ISSUE 19): highest
+    # chain count per host in the family set — the device plane's best
+    # case, judged by the same >=90%-on-device gate
+    out["scen_mixnet"] = _sharded_scenario_row(
+        "genscen.build('mixnet2k')", prefix="bench-mixnet-")
+    for key in ("scen_cdn", "scen_swarm", "scen_mixnet"):
         row = out[key]
         out[f"{key}_pass"] = bool(
             row.get("ok") and row.get("flows_completed") == row.get("flows")
@@ -1756,7 +1762,13 @@ def main() -> None:
     simgen_sec = round(time.perf_counter() - _gen_t0, 3)
     simgen_surfaces = len({_simgen.SURFACE_OF_REGION[n]
                            for _, n, _, _ in _simgen.REGIONS})
-    cubic_parity_pass = bench_cubic_parity()
+    # the logic surface (ISSUE 19): regions carrying spec-IR-emitted
+    # update expressions, SIM206-verified on all three planes
+    simgen_logic_surfaces = sum(
+        1 for _, n, _, _ in _simgen.REGIONS
+        if _simgen.SURFACE_OF_REGION.get(n) == "logic")
+    cubic_parity_pass = bench_cc_parity("cubicx")
+    bbrx_parity_pass = bench_cc_parity("bbrx")
     out = {
         "metric": "tor200_sim_sec_per_wall_sec",
         "value": tor200,
@@ -1792,8 +1804,10 @@ def main() -> None:
         "simtwin_sec": simtwin_sec,
         "simgen_problems": len(_gen_diags),
         "simgen_surfaces": simgen_surfaces,
+        "simgen_logic_surfaces": simgen_logic_surfaces,
         "simgen_sec": simgen_sec,
         "cubic_parity_pass": cubic_parity_pass,
+        "bbrx_parity_pass": bbrx_parity_pass,
         **fuzz_cols,
         **prof_cols,
         "kernel_transfer_inclusive_mpkts": round(dev_rate / 1e6, 3),
@@ -1899,13 +1913,16 @@ def main() -> None:
         "simrace_sec": simrace_sec,
         "simtwin_findings": out["simtwin_findings"],
         "simtwin_sec": simtwin_sec,
-        # simgen spec-authoritative codegen gates (ISSUE 11): problems
-        # must be 0, surfaces 4, and the spec-defined CUBIC variant must
-        # hold python-vs-native digest parity at runtime
+        # simgen spec-authoritative codegen gates (ISSUE 11/19): problems
+        # must be 0, surfaces 5 (incl. the logic surface), and the
+        # spec-defined CC families (cubicx, bbrx) must hold
+        # python-vs-native digest parity at runtime
         "simgen_problems": out["simgen_problems"],
         "simgen_surfaces": simgen_surfaces,
+        "simgen_logic_surfaces": simgen_logic_surfaces,
         "simgen_sec": simgen_sec,
         "cubic_parity_pass": cubic_parity_pass,
+        "bbrx_parity_pass": bbrx_parity_pass,
         # scenario fuzzing (ISSUE 13): violations must be 0; the fleet
         # rows must complete >= 90% on-device through the sharded mesh
         "fuzz_seeds": fuzz_cols.get("fuzz_seeds"),
@@ -1919,6 +1936,7 @@ def main() -> None:
         "launches_amortized": fleet_cols.get("fleet_launches_amortized"),
         "scen_cdn_pass": sims.get("scen_cdn_pass"),
         "scen_swarm_pass": sims.get("scen_swarm_pass"),
+        "scen_mixnet_pass": sims.get("scen_mixnet_pass"),
         # cost observatory (ISSUE 15): the bounded quick-calibrate leg
         # must succeed and no run may accumulate model-stale evidence
         "prof_calibrate_sec": prof_cols.get("prof_calibrate_sec"),
@@ -1936,7 +1954,8 @@ def main() -> None:
         "tor200_serial", "tor200_device_plane",
         "tor10k_device_plane_long", "tor10k_device_plane_native_long",
         "scale_star10k", "scale_star100k", "scale_tor100k",
-        "scen_cdn", "scen_swarm") if isinstance(sims.get(k), dict)}
+        "scen_cdn", "scen_swarm", "scen_mixnet")
+        if isinstance(sims.get(k), dict)}
     hist_rows["fleet"] = fleet_cols
     hist_rows["headline"] = summary
     append_bench_rows(hist_rows)
@@ -1983,9 +2002,21 @@ def main() -> None:
         failures.append(
             f"batched simfuzz found {fleet_cols['fleet_violations']} "
             "violation(s)")
-    for key in ("scen_cdn_pass", "scen_swarm_pass"):
+    for key in ("scen_cdn_pass", "scen_swarm_pass", "scen_mixnet_pass"):
         if sims.get(key) is False:
             failures.append(f"{key} failed: {sims.get(key[:-5])}")
+    # ISSUE 19 (fail-closed): the emitted logic surface must be present
+    # and the spec-only CC families must hold cross-plane digest parity
+    # (a skip-string reason — native plane missing — is recorded, not
+    # conflated with a divergence)
+    if simgen_logic_surfaces != 5:
+        failures.append(
+            f"simgen_logic_surfaces={simgen_logic_surfaces}, expected 5 — "
+            "a logic region vanished from the emission table")
+    for name, val in (("cubic_parity_pass", cubic_parity_pass),
+                      ("bbrx_parity_pass", bbrx_parity_pass)):
+        if val is False:
+            failures.append(f"{name}: the generated planes DIVERGED")
     # ISSUE 15 (fail-closed): the calibrate leg must produce a model and
     # the checked-in model must pass simprof check; accumulated
     # model-stale evidence means the scheduler ran on drifted numbers

@@ -35,7 +35,7 @@
 
 namespace {
 
-// >>> simgen:begin region=c-protocol-constants spec=f421682bce6f body=79a2955fdd12
+// >>> simgen:begin region=c-protocol-constants spec=293c930bb679 body=79a2955fdd12
 // ---- constants (mirror core/defs.py / descriptor/tcp.py) ------------------
 constexpr int64_t SIM_MS = 1000000LL;
 constexpr int64_t SIM_SEC = 1000000000LL;
@@ -66,7 +66,7 @@ enum { S_ACTIVE = 1, S_READABLE = 2, S_WRITABLE = 4, S_CLOSED = 8 };
 enum { F_RST = 2, F_SYN = 4, F_ACK = 8, F_FIN = 16 };
 // <<< simgen:end region=c-protocol-constants
 
-// >>> simgen:begin region=c-epoll-bits spec=f421682bce6f body=fc15dfac4ddd
+// >>> simgen:begin region=c-epoll-bits spec=293c930bb679 body=fc15dfac4ddd
 // epoll readiness bits (descriptor/epoll.py) — the C-side
 // readiness cache (ISSUE 12) computes revents for epoll-watched
 // native sockets with these
@@ -74,7 +74,7 @@ enum { EPOLLIN = 0x001, EPOLLOUT = 0x004, EPOLLERR = 0x008, EPOLLHUP = 0x010 };
 // <<< simgen:end region=c-epoll-bits
 constexpr unsigned EPOLLET = 1u << 31;
 
-// >>> simgen:begin region=c-tcp-states spec=f421682bce6f body=bd57e0fc733c
+// >>> simgen:begin region=c-tcp-states spec=293c930bb679 body=bd57e0fc733c
 enum TcpState {
   ST_CLOSED = 0, ST_LISTEN, ST_SYN_SENT, ST_SYN_RECEIVED, ST_ESTABLISHED,
   ST_FIN_WAIT_1, ST_FIN_WAIT_2, ST_CLOSING, ST_TIME_WAIT, ST_CLOSE_WAIT,
@@ -249,8 +249,8 @@ struct Tally {
 };
 
 // ---- congestion control (descriptor/tcp_cong.py) ---------------------------
-// >>> simgen:begin region=c-congestion-params spec=f421682bce6f body=8264260e3de1
-enum CcKind { CC_RENO = 0, CC_AIMD = 1, CC_CUBIC = 2, CC_CUBICX = 3 };
+// >>> simgen:begin region=c-congestion-params spec=293c930bb679 body=dfda84ad0ffd
+enum CcKind { CC_RENO = 0, CC_AIMD = 1, CC_CUBIC = 2, CC_CUBICX = 3, CC_BBRX = 4 };
 // CUBIC coefficient families (RFC 9438 §4.1 / §4.6)
 constexpr double CUBIC_C = 0.4;
 constexpr double CUBIC_BETA = 0.7;
@@ -260,6 +260,82 @@ inline bool cc_is_cubic(int kind) { return kind == CC_CUBIC || kind == CC_CUBICX
 inline double cc_c(int kind) { return kind == CC_CUBICX ? CUBICX_C : CUBIC_C; }
 inline double cc_beta(int kind) { return kind == CC_CUBICX ? CUBICX_BETA : CUBIC_BETA; }
 // <<< simgen:end region=c-congestion-params
+
+// >>> simgen:begin region=c-protocol-logic spec=293c930bb679 body=271c0b7f0b55
+// generated int64 protocol-update logic (spec 'logic' IR); SIM206
+// parses each body back to the IR and compares it to the spec.
+static inline int64_t gen_i64_min(int64_t a, int64_t b) { return a < b ? a : b; }
+static inline int64_t gen_i64_max(int64_t a, int64_t b) { return a > b ? a : b; }
+// bbrx estimator parameters (spec surface: congestion)
+constexpr int64_t BBRX_BETA_DEN = 8LL;
+constexpr int64_t BBRX_BETA_NUM = 7LL;
+constexpr int64_t BBRX_BW_CAP_BPS = 1000000000000LL;
+constexpr int64_t BBRX_CYCLE_LEN = 8LL;
+constexpr int64_t BBRX_CYCLE_NS = 25000000LL;
+constexpr int64_t BBRX_GAIN_CRUISE_NUM = 4LL;
+constexpr int64_t BBRX_GAIN_DEN = 4LL;
+constexpr int64_t BBRX_GAIN_DOWN_NUM = 3LL;
+constexpr int64_t BBRX_GAIN_UP_NUM = 5LL;
+constexpr int64_t BBRX_MIN_CWND_SEGMENTS = 4LL;
+constexpr int64_t BBRX_RTT_CAP_NS = 1000000000LL;
+constexpr int64_t BBRX_RTT_FLOOR_NS = 100000LL;
+// bandwidth-delay product; the /1000 then /1e6 split keeps the intermediate below 2**63 at the bw/rtt caps
+static inline int64_t gen_bbrx_bdp_bytes(int64_t btl_bw_bps, int64_t min_rtt_ns) {
+  return (((btl_bw_bps / 1000) * gen_i64_min(min_rtt_ns, 1000000000)) / 1000000);
+}
+// bottleneck-bandwidth max filter
+static inline int64_t gen_bbrx_btl_bw(int64_t btl_bw_bps, int64_t bw_sample_bps) {
+  return gen_i64_max(btl_bw_bps, bw_sample_bps);
+}
+// multiplicative bandwidth-estimate decay on loss
+static inline int64_t gen_bbrx_bw_decay(int64_t btl_bw_bps) {
+  return ((btl_bw_bps * 7) / 8);
+}
+// delivery-rate sample in bytes/sec from one ACK's bytes over the inter-ACK interval, capped
+static inline int64_t gen_bbrx_bw_sample(int64_t acked_bytes, int64_t interval_ns) {
+  return gen_i64_min(((acked_bytes * 1000000000) / gen_i64_max(interval_ns, 1)), 1000000000000LL);
+}
+// gain numerator for the cycle phase: probe up, drain down, then cruise (BBR's 5/4, 3/4, 1.0 x6 over BBRX_GAIN_DEN)
+static inline int64_t gen_bbrx_gain_num(int64_t cycle_idx) {
+  return ((cycle_idx == 0) ? 5 : ((cycle_idx == 1) ? 3 : 4));
+}
+// cwnd = max(gain * bdp, floor segments)
+static inline int64_t gen_bbrx_inflight_cap(int64_t bdp_bytes, int64_t gain_num, int64_t mss) {
+  return gen_i64_max(((bdp_bytes * gain_num) / 4), (4 * mss));
+}
+// min-RTT filter over floored inter-ACK intervals
+static inline int64_t gen_bbrx_min_rtt(int64_t min_rtt_ns, int64_t interval_ns) {
+  return gen_i64_min(min_rtt_ns, gen_i64_max(interval_ns, 100000));
+}
+// pacing-gain cycle advance
+static inline int64_t gen_bbrx_next_cycle(int64_t cycle_idx) {
+  return ((cycle_idx + 1) % 8);
+}
+// fast-recovery window inflation (ssthresh + 3*mss)
+static inline int64_t gen_recovery_cwnd(int64_t ssthresh, int64_t mss) {
+  return (ssthresh + (3 * mss));
+}
+// exponential backoff on retransmission timeout
+static inline int64_t gen_rto_backoff(int64_t rto_ns) {
+  return gen_i64_min((rto_ns * 2), 120000000000LL);
+}
+// RTO = clamp(srtt + 4*rttvar) into [RTO_MIN, RTO_MAX]
+static inline int64_t gen_rto_from_estimate(int64_t srtt_ns, int64_t rttvar_ns) {
+  return gen_i64_max(200000000, gen_i64_min((srtt_ns + (4 * rttvar_ns)), 120000000000LL));
+}
+// RFC 6298 RTT variance over the PRE-update srtt; |err| spelled max-min so every plane stays in non-negative int64
+static inline int64_t gen_rttvar_update(int64_t srtt_ns, int64_t rttvar_ns, int64_t sample_ns) {
+  return ((srtt_ns == 0) ? (sample_ns / 2) : (((3 * rttvar_ns) + (gen_i64_max(sample_ns, srtt_ns) - gen_i64_min(sample_ns, srtt_ns))) / 4));
+}
+// RFC 6298 smoothed RTT; first sample seeds the filter
+static inline int64_t gen_srtt_update(int64_t srtt_ns, int64_t sample_ns) {
+  return ((srtt_ns == 0) ? sample_ns : (((7 * srtt_ns) + sample_ns) / 8));
+}
+// ssthresh = max(cwnd/2, 2*mss) on loss (RFC 5681)
+static inline int64_t gen_ssthresh_after_loss(int64_t cwnd, int64_t mss) {
+  return gen_i64_max((cwnd / 2), (2 * mss));
+}
+// <<< simgen:end region=c-protocol-logic
 
 struct Cong {
   int kind = CC_RENO;
@@ -285,6 +361,7 @@ struct Cong {
     w_max = 0.0;
     epoch_start_ns = 0;
     k = 0.0;
+    gen_init();
   }
 
   void enter_recovery(int64_t snd_nxt) {
@@ -298,8 +375,8 @@ struct Cong {
       epoch_start_ns = 0;
       return;
     }
-    ssthresh = std::max<int64_t>(cwnd / 2, 2 * mss);
-    cwnd = ssthresh + 3 * mss;
+    ssthresh = gen_ssthresh_after_loss(cwnd, mss);
+    cwnd = gen_recovery_cwnd(ssthresh, mss);
     in_fast_recovery = true;
     recovery_point = snd_nxt;
   }
@@ -337,6 +414,7 @@ struct Cong {
   }
 
   void on_new_ack(int64_t acked_bytes, int64_t snd_una, int64_t now_ns) {
+    if (gen_on_new_ack(acked_bytes, snd_una, now_ns)) return;
     if (in_fast_recovery) {
       if (snd_una >= recovery_point) exit_recovery();
       else return;  // partial ACK: stay in recovery
@@ -346,6 +424,8 @@ struct Cong {
   }
 
   bool on_duplicate_ack(int count, int64_t snd_nxt) {
+    bool gen_rtx = false;
+    if (gen_on_duplicate_ack(count, snd_nxt, &gen_rtx)) return gen_rtx;
     if (kind == CC_AIMD) {
       if (count == 3 && !in_fast_recovery) {
         enter_recovery(snd_nxt);
@@ -363,13 +443,83 @@ struct Cong {
   }
 
   void on_timeout() {
+    if (gen_on_timeout()) return;
     if (cc_is_cubic(kind)) w_max = (double)cwnd;
-    ssthresh = std::max<int64_t>(cwnd / 2, 2 * mss);
+    ssthresh = gen_ssthresh_after_loss(cwnd, mss);
     cwnd = mss;
     in_fast_recovery = false;
     avoid_acc = 0;
     if (cc_is_cubic(kind)) epoch_start_ns = 0;
   }
+
+  // >>> simgen:begin region=c-congestion-logic spec=293c930bb679 body=eced006873f0
+  // generated 'bbrx' estimator state + dispatch (spec congestion.families)
+  int64_t gx_btl_bw_bps = 0;
+  int64_t gx_min_rtt_ns = BBRX_RTT_CAP_NS;
+  int64_t gx_last_ack_ns = 0;
+  int64_t gx_cycle_idx = 0;
+  int64_t gx_cycle_start_ns = 0;
+
+  void gen_init() {
+    gx_btl_bw_bps = 0;
+    gx_min_rtt_ns = BBRX_RTT_CAP_NS;
+    gx_last_ack_ns = 0;
+    gx_cycle_idx = 0;
+    gx_cycle_start_ns = 0;
+  }
+
+  // each hook returns true when a generated family handled the event
+  bool gen_on_new_ack(int64_t acked_bytes, int64_t snd_una, int64_t now_ns) {
+    if (kind != CC_BBRX) return false;
+    if (in_fast_recovery) {
+      if (snd_una >= recovery_point) exit_recovery();
+      else return true;  // partial ACK: stay in recovery
+    }
+    if (gx_last_ack_ns > 0) {
+      int64_t interval_ns = now_ns - gx_last_ack_ns;
+      gx_btl_bw_bps = gen_bbrx_btl_bw(
+          gx_btl_bw_bps, gen_bbrx_bw_sample(acked_bytes, interval_ns));
+      gx_min_rtt_ns = gen_bbrx_min_rtt(gx_min_rtt_ns, interval_ns);
+    }
+    gx_last_ack_ns = now_ns;
+    if (now_ns - gx_cycle_start_ns >= BBRX_CYCLE_NS) {
+      gx_cycle_idx = gen_bbrx_next_cycle(gx_cycle_idx);
+      gx_cycle_start_ns = now_ns;
+    }
+    if (gx_btl_bw_bps > 0) {
+      cwnd = gen_bbrx_inflight_cap(
+          gen_bbrx_bdp_bytes(gx_btl_bw_bps, gx_min_rtt_ns),
+          gen_bbrx_gain_num(gx_cycle_idx), mss);
+    }
+    return true;
+  }
+
+  bool gen_on_duplicate_ack(int count, int64_t snd_nxt, bool* retransmit) {
+    if (kind != CC_BBRX) return false;
+    *retransmit = false;
+    if (count == 3 && !in_fast_recovery) {
+      gx_btl_bw_bps = gen_bbrx_bw_decay(gx_btl_bw_bps);
+      ssthresh = gen_ssthresh_after_loss(cwnd, mss);
+      cwnd = gen_recovery_cwnd(ssthresh, mss);
+      in_fast_recovery = true;
+      recovery_point = snd_nxt;
+      *retransmit = true;
+      return true;
+    }
+    if (in_fast_recovery) cwnd += mss;
+    return true;
+  }
+
+  bool gen_on_timeout() {
+    if (kind != CC_BBRX) return false;
+    gx_btl_bw_bps = gen_bbrx_bw_decay(gx_btl_bw_bps);
+    ssthresh = gen_ssthresh_after_loss(cwnd, mss);
+    cwnd = mss;
+    in_fast_recovery = false;
+    avoid_acc = 0;
+    return true;
+  }
+  // <<< simgen:end region=c-congestion-logic
 };
 
 // ---- flat byte stream (deque-of-chunks equivalent; content-identical) ------
@@ -1210,17 +1360,10 @@ void tcp_autotune(Plane *pl, Sock *s, int64_t rtt_ns) {
 
 void tcp_rtt_sample(Plane *pl, Sock *s, int64_t sample_ns) {
   if (sample_ns <= 0) return;
-  if (s->srtt_ns == 0) {
-    s->srtt_ns = sample_ns;
-    s->rttvar_ns = sample_ns / 2;
-  } else {
-    int64_t err = sample_ns > s->srtt_ns ? sample_ns - s->srtt_ns
-                                         : s->srtt_ns - sample_ns;
-    s->rttvar_ns = (3 * s->rttvar_ns + err) / 4;
-    s->srtt_ns = (7 * s->srtt_ns + sample_ns) / 8;
-  }
-  s->rto_ns = std::max(RTO_MIN,
-                       std::min(s->srtt_ns + 4 * s->rttvar_ns, RTO_MAX));
+  // rttvar first: it reads the PRE-update srtt (RFC 6298 order)
+  s->rttvar_ns = gen_rttvar_update(s->srtt_ns, s->rttvar_ns, sample_ns);
+  s->srtt_ns = gen_srtt_update(s->srtt_ns, sample_ns);
+  s->rto_ns = gen_rto_from_estimate(s->srtt_ns, s->rttvar_ns);
   tcp_autotune(pl, s, sample_ns);
 }
 
@@ -2191,7 +2334,7 @@ bool plane_exec(Plane *pl, Ev &ev) {
         return tcp_fail_connection(pl, s, E_TIMEDOUT);
       if (s->has_cong) s->cong.on_timeout();
       s->dup_ack_count = 0;
-      s->rto_ns = std::min(s->rto_ns * 2, RTO_MAX);
+      s->rto_ns = gen_rto_backoff(s->rto_ns);
       CK(tcp_retransmit_segment(pl, s, seg));
       tcp_arm_rto(pl, s);
       return true;

@@ -413,6 +413,169 @@ def _run_probe(stripped: str, pattern, env) -> Optional[Tuple[object, int]]:
 
 
 # ---------------------------------------------------------------------------
+# logic-function read-back (ISSUE 19): parse the generator's
+# ``static inline int64_t gen_<name>(...) { return <expr>; }`` bodies
+# back into logic IR so SIM206 / simgen's readback can structurally
+# compare them against the spec.  Comments inside the expression are
+# blanked by strip_comments first, so a comment-split expression parses
+# the same as a one-liner; identity casts like ``(int64_t)`` are
+# stripped (every IR value is int64 by contract).
+
+_LOGIC_FN_RE = re.compile(
+    r"static\s+inline\s+int64_t\s+gen_([A-Za-z_]\w*)\s*\(([^)]*)\)\s*"
+    r"\{\s*return\s+(.*?);\s*\}", re.S)
+# the two call-shaped min/max helpers the emitter leans on — they match
+# the function regex but are vocabulary, not logic functions
+_LOGIC_HELPERS = {"i64_min", "i64_max"}
+
+_C_TOK_RE = re.compile(
+    r"\s*(?:(?P<num>0[xX][0-9a-fA-F]+[uUlL]*|\d+[uUlL]*)"
+    r"|(?P<name>[A-Za-z_]\w*)"
+    r"|(?P<op><<|>>|<=|>=|==|!=|[-+*/%<>?:(),]))")
+
+_C_CMP_OPS = {"==": "eq", "!=": "ne", "<": "lt", "<=": "le",
+              ">": "gt", ">=": "ge"}
+_C_MUL_OPS = {"*": "mul", "/": "floordiv", "%": "mod"}
+_C_ADD_OPS = {"+": "add", "-": "sub"}
+_C_SHIFT_OPS = {"<<": "shl", ">>": "shr"}
+
+
+class CExprError(ValueError):
+    pass
+
+
+def _c_tokens(text: str) -> List[str]:
+    toks: List[str] = []
+    pos = 0
+    while pos < len(text):
+        m = _C_TOK_RE.match(text, pos)
+        if not m or m.end() == pos:
+            rest = text[pos:].strip()
+            if not rest:
+                break
+            raise CExprError(f"unexpected token at {rest[:20]!r}")
+        pos = m.end()
+        toks.append(m.group("num") or m.group("name") or m.group("op"))
+    return toks
+
+
+class _CExprParser:
+    """Recursive descent over the emitted C expression subset, with real
+    C precedence (mul > add > shift > relational > equality > ternary) so
+    hand-edited spellings still parse to the tree they mean."""
+
+    def __init__(self, toks: List[str]):
+        self.toks = toks
+        self.i = 0
+
+    def peek(self) -> Optional[str]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def take(self, want: Optional[str] = None) -> str:
+        tok = self.peek()
+        if tok is None:
+            raise CExprError("unexpected end of expression")
+        if want is not None and tok != want:
+            raise CExprError(f"expected {want!r}, got {tok!r}")
+        self.i += 1
+        return tok
+
+    def parse(self):
+        ir = self.ternary()
+        if self.peek() is not None:
+            raise CExprError(f"trailing tokens at {self.peek()!r}")
+        return ir
+
+    def ternary(self):
+        cond = self.equality()
+        if self.peek() != "?":
+            return cond
+        self.take("?")
+        t = self.ternary()
+        self.take(":")
+        f = self.ternary()
+        if not (isinstance(cond, list) and cond[0] in _C_CMP_OPS.values()):
+            raise CExprError("ternary condition must be a comparison")
+        return ["select", cond, t, f]
+
+    def _binchain(self, ops: Dict[str, str], sub):
+        ir = sub()
+        while self.peek() in ops:
+            op = ops[self.take()]
+            ir = [op, ir, sub()]
+        return ir
+
+    def equality(self):
+        return self._binchain({"==": "eq", "!=": "ne"}, self.relational)
+
+    def relational(self):
+        return self._binchain({"<": "lt", "<=": "le", ">": "gt",
+                               ">=": "ge"}, self.shift)
+
+    def shift(self):
+        return self._binchain(_C_SHIFT_OPS, self.additive)
+
+    def additive(self):
+        return self._binchain(_C_ADD_OPS, self.multiplicative)
+
+    def multiplicative(self):
+        return self._binchain(_C_MUL_OPS, self.primary)
+
+    def primary(self):
+        tok = self.take()
+        if tok == "(":
+            ir = self.ternary()
+            self.take(")")
+            return ir
+        if re.fullmatch(r"0[xX][0-9a-fA-F]+[uUlL]*|\d+[uUlL]*", tok):
+            return int(tok.rstrip("uUlL"), 0)
+        if not re.fullmatch(r"[A-Za-z_]\w*", tok):
+            raise CExprError(f"unexpected token {tok!r}")
+        if self.peek() != "(":
+            return tok                     # argument reference
+        self.take("(")
+        args = [self.ternary()]
+        while self.peek() == ",":
+            self.take(",")
+            args.append(self.ternary())
+        self.take(")")
+        if tok in ("gen_i64_min", "gen_i64_max") and len(args) == 2:
+            return [tok[len("gen_i64_"):], args[0], args[1]]
+        raise CExprError(f"unsupported call {tok!r}")
+
+
+def parse_c_expr(text: str):
+    """One C expression -> logic IR.  Raises :class:`CExprError` when the
+    spelling falls outside the portable vocabulary."""
+    return _CExprParser(_c_tokens(_CAST_RE.sub("", text))).parse()
+
+
+def parse_c_logic_functions(text: str
+                            ) -> Dict[str, Tuple[List[str], object, int]]:
+    """Extract every emitted logic function from a C translation unit:
+    ``{logic_name: (arg_names, ir_or_None, lineno)}`` — the same shape as
+    :func:`logic_ir.parse_py_functions`, with ``ir=None`` for a body the
+    expression parser can't read (a finding, not a crash)."""
+    stripped, _ = strip_comments(text)
+    out: Dict[str, Tuple[List[str], object, int]] = {}
+    for m in _LOGIC_FN_RE.finditer(stripped):
+        name = m.group(1)
+        if name in _LOGIC_HELPERS:
+            continue
+        args: List[str] = []
+        for param in m.group(2).split(","):
+            words = re.findall(r"[A-Za-z_]\w*", param)
+            if words:
+                args.append(words[-1])
+        try:
+            ir = parse_c_expr(m.group(3))
+        except CExprError:
+            ir = None
+        out[name] = (args, ir, _line_of(stripped, m.start()))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # transition extraction: ...->state = ST_X under enclosing if-guards
 
 _TOK_RE = re.compile(

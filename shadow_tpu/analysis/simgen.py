@@ -42,6 +42,7 @@ import os
 import sys
 from typing import Callable, Dict, List, Optional, Tuple
 
+from . import logic_ir
 from .genmark import (SPEC_RELPATH, begin_marker, end_marker, scan_regions,
                       sha12)
 
@@ -277,13 +278,162 @@ def _r_congestion_variants(spec: Dict) -> List[str]:
             "",
             "",
         ]
+    lines += _r_family_classes(spec)
+    for name in sorted(spec["congestion"].get("families", {})):
+        generated.append((name, _family_class_name(spec, name)))
     lines.append("# config token -> generated class "
                  "(make_congestion_control consults this)")
     lines.append("CC_GENERATED = {")
-    for name, cls in generated:
+    for name, cls in sorted(generated):
         lines.append(f"    \"{name}\": {cls},")
     lines.append("}")
     return lines
+
+
+def _logic_functions(spec: Dict, group: Optional[str] = None
+                     ) -> List[Tuple[str, Dict]]:
+    fns = spec.get("logic", {}).get("functions", {})
+    return [(name, fns[name]) for name in sorted(fns)
+            if group is None or fns[name].get("group") == group]
+
+
+def _resolved_expr(spec: Dict, fn: Dict):
+    logic_ir.validate(fn["expr"], fn["args"], spec["constants"])
+    return logic_ir.resolve(fn["expr"], spec["constants"])
+
+
+def _bbrx_const_names(spec: Dict) -> List[str]:
+    return sorted(n for n in spec["constants"] if n.startswith("BBRX_"))
+
+
+def _py_logic_lines(spec: Dict, group: str) -> List[str]:
+    lines: List[str] = []
+    for name, fn in _logic_functions(spec, group):
+        expr = logic_ir.emit_py(_resolved_expr(spec, fn))
+        lines += [
+            f"def {logic_ir.plane_symbol(name, 'py')}"
+            f"({', '.join(fn['args'])}):",
+            f"    \"\"\"{fn['doc']}\"\"\"",
+            f"    return {expr}",
+            "",
+            "",
+        ]
+    while lines and lines[-1] == "":
+        lines.pop()
+    return lines
+
+
+def _r_tcp_logic(spec: Dict) -> List[str]:
+    lines = [
+        "# RTT/RTO update logic, generated from the spec's expression IR",
+        "# (SIM206 parses these bodies back and compares them to the "
+        "spec).",
+        "",
+    ]
+    lines += _py_logic_lines(spec, "rtt")
+    return lines
+
+
+def _r_congestion_logic(spec: Dict) -> List[str]:
+    c = spec["constants"]
+    lines = ["# bbrx estimator parameters (spec surface: congestion)"]
+    for name in _bbrx_const_names(spec):
+        lines.append(f"{name} = {c[name]}")
+    lines += [
+        "",
+        "",
+        "# congestion update logic, generated from the spec's "
+        "expression IR",
+        "",
+    ]
+    lines += _py_logic_lines(spec, "cc")
+    return lines
+
+
+def _family_class_name(spec: Dict, name: str) -> str:
+    return spec["congestion"]["families"][name]["class"]
+
+
+def _r_family_classes(spec: Dict) -> List[str]:
+    """The generated CC family classes (ISSUE 19).  The expressions come
+    from the spec's logic IR (via the ``_g_*`` helpers emitted into the
+    congestion-logic region); the hook scaffold below is the generator's
+    one estimator shape, so an unknown family fails generation loudly
+    instead of emitting garbage."""
+    fams = spec["congestion"].get("families", {})
+    unknown = sorted(set(fams) - {"bbrx"})
+    if unknown:
+        raise ValueError(
+            f"no generator scaffold for congestion families {unknown}; "
+            f"teach simgen._r_family_classes before adding them")
+    if "bbrx" not in fams:
+        return []
+    cls = _family_class_name(spec, "bbrx")
+    return [
+        f"class {cls}(CongestionControl):",
+        "    \"\"\"Spec-defined 'bbrx' (ISSUE 19): a BBR-flavored "
+        "family — windowed",
+        "    bandwidth (max filter + loss decay), min-RTT from ACK "
+        "spacing, a",
+        "    pacing-gain cycle, and an inflight cap from the BDP.  "
+        "Every update",
+        "    expression is generated from the spec's logic IR; this "
+        "class holds",
+        "    only the estimator state and the hook wiring.",
+        "    \"\"\"",
+        "",
+        "    name = \"bbrx\"",
+        "",
+        "    def __init__(self, mss, ssthresh=0,",
+        "                 init_segments=INIT_CWND_SEGMENTS):",
+        "        super().__init__(mss, ssthresh, init_segments)",
+        "        self.btl_bw_bps = 0",
+        "        self.min_rtt_ns = BBRX_RTT_CAP_NS",
+        "        self.last_ack_ns = 0",
+        "        self.cycle_idx = 0",
+        "        self.cycle_start_ns = 0",
+        "",
+        "    def on_new_ack(self, acked_bytes, snd_una, now_ns):",
+        "        if self.in_fast_recovery:",
+        "            if snd_una >= self.recovery_point:",
+        "                self._exit_recovery()",
+        "            else:",
+        "                return  # partial ACK: stay in recovery",
+        "        if self.last_ack_ns > 0:",
+        "            interval_ns = now_ns - self.last_ack_ns",
+        "            self.btl_bw_bps = _g_bbrx_btl_bw(",
+        "                self.btl_bw_bps,",
+        "                _g_bbrx_bw_sample(acked_bytes, interval_ns))",
+        "            self.min_rtt_ns = _g_bbrx_min_rtt(self.min_rtt_ns,",
+        "                                              interval_ns)",
+        "        self.last_ack_ns = now_ns",
+        "        if now_ns - self.cycle_start_ns >= BBRX_CYCLE_NS:",
+        "            self.cycle_idx = _g_bbrx_next_cycle(self.cycle_idx)",
+        "            self.cycle_start_ns = now_ns",
+        "        if self.btl_bw_bps > 0:",
+        "            self.cwnd = _g_bbrx_inflight_cap(",
+        "                _g_bbrx_bdp_bytes(self.btl_bw_bps, "
+        "self.min_rtt_ns),",
+        "                _g_bbrx_gain_num(self.cycle_idx), self.mss)",
+        "",
+        "    def _enter_recovery(self, snd_nxt):",
+        "        self.btl_bw_bps = _g_bbrx_bw_decay(self.btl_bw_bps)",
+        "        self.ssthresh = _g_ssthresh_after_loss(self.cwnd, "
+        "self.mss)",
+        "        self.cwnd = _g_recovery_cwnd(self.ssthresh, self.mss)",
+        "        self.in_fast_recovery = True",
+        "        self.recovery_point = snd_nxt",
+        "",
+        "    def on_timeout(self):",
+        "        self.btl_bw_bps = _g_bbrx_bw_decay(self.btl_bw_bps)",
+        "        self.ssthresh = _g_ssthresh_after_loss(self.cwnd, "
+        "self.mss)",
+        "        self.cwnd = self.mss",
+        "        self.in_fast_recovery = False",
+        "        self._avoid_acc = 0",
+        "",
+        "",
+    ]
 
 
 def _r_token_bucket_kernel(spec: Dict) -> List[str]:
@@ -430,6 +580,165 @@ def _r_c_congestion_params(spec: Dict) -> List[str]:
     return lines
 
 
+def _r_c_protocol_logic(spec: Dict) -> List[str]:
+    """All spec logic functions as pure int64 free functions, plus the
+    bbrx parameter constants.  ``gen_i64_min/max`` exist so the emitted
+    expressions stay call-shaped (parseable by the SIM206 read-back)
+    instead of template-instantiated ``std::max<int64_t>`` spellings."""
+    c = spec["constants"]
+    lines = [
+        "// generated int64 protocol-update logic (spec 'logic' IR); "
+        "SIM206",
+        "// parses each body back to the IR and compares it to the spec.",
+        "static inline int64_t gen_i64_min(int64_t a, int64_t b) "
+        "{ return a < b ? a : b; }",
+        "static inline int64_t gen_i64_max(int64_t a, int64_t b) "
+        "{ return a > b ? a : b; }",
+        "// bbrx estimator parameters (spec surface: congestion)",
+    ]
+    for name in _bbrx_const_names(spec):
+        lines.append(f"constexpr int64_t {name} = {c[name]}LL;")
+    for name, fn in _logic_functions(spec):
+        expr = logic_ir.emit_c(_resolved_expr(spec, fn))
+        args = ", ".join(f"int64_t {a}" for a in fn["args"])
+        lines += [
+            f"// {fn['doc']}",
+            f"static inline int64_t "
+            f"{logic_ir.plane_symbol(name, 'c')}({args}) {{",
+            f"  return {expr};",
+            "}",
+        ]
+    return lines
+
+
+def _r_c_congestion_logic(spec: Dict) -> List[str]:
+    """The generated-family estimator state + hook dispatch, emitted
+    INSIDE ``struct Cong`` (the hand hooks call ``gen_on_*`` first and
+    return when a generated family handled the event).  Mirrors the
+    Python ``BbrX`` scaffold statement for statement."""
+    fams = spec["congestion"].get("families", {})
+    unknown = sorted(set(fams) - {"bbrx"})
+    if unknown:
+        raise ValueError(
+            f"no generator scaffold for congestion families {unknown}; "
+            f"teach simgen._r_c_congestion_logic before adding them")
+    if "bbrx" not in fams:
+        return ["  // no generated congestion families in the spec",
+                "  void gen_init() {}",
+                "  bool gen_on_new_ack(int64_t, int64_t, int64_t) "
+                "{ return false; }",
+                "  bool gen_on_duplicate_ack(int, int64_t, bool*) "
+                "{ return false; }",
+                "  bool gen_on_timeout() { return false; }"]
+    return [
+        "  // generated 'bbrx' estimator state + dispatch (spec "
+        "congestion.families)",
+        "  int64_t gx_btl_bw_bps = 0;",
+        "  int64_t gx_min_rtt_ns = BBRX_RTT_CAP_NS;",
+        "  int64_t gx_last_ack_ns = 0;",
+        "  int64_t gx_cycle_idx = 0;",
+        "  int64_t gx_cycle_start_ns = 0;",
+        "",
+        "  void gen_init() {",
+        "    gx_btl_bw_bps = 0;",
+        "    gx_min_rtt_ns = BBRX_RTT_CAP_NS;",
+        "    gx_last_ack_ns = 0;",
+        "    gx_cycle_idx = 0;",
+        "    gx_cycle_start_ns = 0;",
+        "  }",
+        "",
+        "  // each hook returns true when a generated family handled "
+        "the event",
+        "  bool gen_on_new_ack(int64_t acked_bytes, int64_t snd_una, "
+        "int64_t now_ns) {",
+        "    if (kind != CC_BBRX) return false;",
+        "    if (in_fast_recovery) {",
+        "      if (snd_una >= recovery_point) exit_recovery();",
+        "      else return true;  // partial ACK: stay in recovery",
+        "    }",
+        "    if (gx_last_ack_ns > 0) {",
+        "      int64_t interval_ns = now_ns - gx_last_ack_ns;",
+        "      gx_btl_bw_bps = gen_bbrx_btl_bw(",
+        "          gx_btl_bw_bps, gen_bbrx_bw_sample(acked_bytes, "
+        "interval_ns));",
+        "      gx_min_rtt_ns = gen_bbrx_min_rtt(gx_min_rtt_ns, "
+        "interval_ns);",
+        "    }",
+        "    gx_last_ack_ns = now_ns;",
+        "    if (now_ns - gx_cycle_start_ns >= BBRX_CYCLE_NS) {",
+        "      gx_cycle_idx = gen_bbrx_next_cycle(gx_cycle_idx);",
+        "      gx_cycle_start_ns = now_ns;",
+        "    }",
+        "    if (gx_btl_bw_bps > 0) {",
+        "      cwnd = gen_bbrx_inflight_cap(",
+        "          gen_bbrx_bdp_bytes(gx_btl_bw_bps, gx_min_rtt_ns),",
+        "          gen_bbrx_gain_num(gx_cycle_idx), mss);",
+        "    }",
+        "    return true;",
+        "  }",
+        "",
+        "  bool gen_on_duplicate_ack(int count, int64_t snd_nxt, "
+        "bool* retransmit) {",
+        "    if (kind != CC_BBRX) return false;",
+        "    *retransmit = false;",
+        "    if (count == 3 && !in_fast_recovery) {",
+        "      gx_btl_bw_bps = gen_bbrx_bw_decay(gx_btl_bw_bps);",
+        "      ssthresh = gen_ssthresh_after_loss(cwnd, mss);",
+        "      cwnd = gen_recovery_cwnd(ssthresh, mss);",
+        "      in_fast_recovery = true;",
+        "      recovery_point = snd_nxt;",
+        "      *retransmit = true;",
+        "      return true;",
+        "    }",
+        "    if (in_fast_recovery) cwnd += mss;",
+        "    return true;",
+        "  }",
+        "",
+        "  bool gen_on_timeout() {",
+        "    if (kind != CC_BBRX) return false;",
+        "    gx_btl_bw_bps = gen_bbrx_bw_decay(gx_btl_bw_bps);",
+        "    ssthresh = gen_ssthresh_after_loss(cwnd, mss);",
+        "    cwnd = mss;",
+        "    in_fast_recovery = false;",
+        "    avoid_acc = 0;",
+        "    return true;",
+        "  }",
+    ]
+
+
+def _r_kernel_logic(spec: Dict) -> List[str]:
+    """The kernel plane's numpy mirror of every logic function (int64
+    in, int64 out; ``np.where``/``np.minimum``/``np.maximum`` spell
+    select/min/max so the same read-back grammar covers this plane)."""
+    c = spec["constants"]
+    lines = ["# bbrx estimator parameters (mirrors descriptor/"
+             "tcp_cong.py)"]
+    for name in _bbrx_const_names(spec):
+        lines.append(f"{name} = {c[name]}")
+    lines += [
+        "",
+        "",
+        "# protocol-update logic, generated from the spec's expression "
+        "IR;",
+        "# elementwise over int64 arrays (device-vs-numpy parity is "
+        "pinned in tests)",
+        "",
+    ]
+    for name, fn in _logic_functions(spec):
+        expr = logic_ir.emit_np(_resolved_expr(spec, fn))
+        lines += [
+            f"def {logic_ir.plane_symbol(name, 'kernel')}"
+            f"({', '.join(fn['args'])}):",
+            f"    \"\"\"{fn['doc']}\"\"\"",
+            f"    return {expr}",
+            "",
+            "",
+        ]
+    while lines and lines[-1] == "":
+        lines.pop()
+    return lines
+
+
 # ---------------------------------------------------------------------------
 # the emission table: every declared region, in file order
 
@@ -446,21 +755,29 @@ REGIONS: List[RegionDef] = [
     ("shadow_tpu/core/rng.py", "threefry", PY, _r_threefry),
     ("shadow_tpu/descriptor/tcp.py", "tcp-states", PY, _r_tcp_states),
     ("shadow_tpu/descriptor/tcp.py", "tcp-timers", PY, _r_tcp_timers),
+    ("shadow_tpu/descriptor/tcp.py", "tcp-logic", PY, _r_tcp_logic),
     ("shadow_tpu/host/router.py", "router-static", PY, _r_router_static),
     ("shadow_tpu/host/router.py", "codel-params", PY, _r_codel_params),
     ("shadow_tpu/descriptor/tcp_cong.py", "congestion-params", PY,
      _r_congestion_params),
+    ("shadow_tpu/descriptor/tcp_cong.py", "congestion-logic", PY,
+     _r_congestion_logic),
     ("shadow_tpu/descriptor/tcp_cong.py", "congestion-variants", PY,
      _r_congestion_variants),
     ("shadow_tpu/ops/bandwidth.py", "token-bucket-kernel", PY,
      _r_token_bucket_kernel),
     ("shadow_tpu/ops/protocol_tables.py", "protocol-tables", PY,
      _r_protocol_tables),
+    ("shadow_tpu/ops/protocol_tables.py", "kernel-logic", PY,
+     _r_kernel_logic),
     ("native/dataplane.cc", "c-protocol-constants", C, _r_c_constants),
     ("native/dataplane.cc", "c-epoll-bits", C, _r_c_epoll_bits),
     ("native/dataplane.cc", "c-tcp-states", C, _r_c_tcp_states),
     ("native/dataplane.cc", "c-congestion-params", C,
      _r_c_congestion_params),
+    ("native/dataplane.cc", "c-protocol-logic", C, _r_c_protocol_logic),
+    ("native/dataplane.cc", "c-congestion-logic", C,
+     _r_c_congestion_logic),
 ]
 
 SURFACE_OF_REGION: Dict[str, str] = {
@@ -475,6 +792,9 @@ SURFACE_OF_REGION: Dict[str, str] = {
     "protocol-tables": "transitions",
     "congestion-params": "congestion", "congestion-variants": "congestion",
     "c-congestion-params": "congestion",
+    "tcp-logic": "logic", "congestion-logic": "logic",
+    "kernel-logic": "logic", "c-protocol-logic": "logic",
+    "c-congestion-logic": "logic",
 }
 
 
@@ -580,9 +900,17 @@ def readback_diffs(root: str, spec: Dict) -> List[str]:
     out: List[str] = []
     want = spec["constants"]
     got = twin.constants_by_canonical()
+    # constants referenced by the logic IR are verified structurally by
+    # the expression read-back below (their regex probes are retired, so
+    # a plane no longer "spells" them as a named constant)
+    logic_covered = set()
+    for _name, fn in _logic_functions(spec):
+        logic_covered.update(logic_ir.referenced_constants(fn["expr"]))
     for canon in sorted(want):
         sites = got.get(canon)
         if not sites:
+            if canon in logic_covered:
+                continue
             out.append(f"readback: constant {canon} is in the spec but "
                        f"no plane spells it")
             continue
@@ -610,6 +938,72 @@ def readback_diffs(root: str, spec: Dict) -> List[str]:
         if set(table["states"]) != want_states:
             out.append(f"readback: state universe of {path} differs "
                        f"from the spec")
+    out.extend(logic_readback_diffs(root, spec))
+    return out
+
+
+def _logic_plane_files() -> Dict[str, List[str]]:
+    """plane -> list of relpaths carrying emitted logic functions (from
+    the emission table, so the read-back can never drift from what the
+    generator emits)."""
+    out: Dict[str, List[str]] = {"py": [], "c": [], "kernel": []}
+    for path, rname, lead, _ in REGIONS:
+        if SURFACE_OF_REGION.get(rname) != "logic":
+            continue
+        plane = ("c" if lead == C
+                 else "kernel" if "/ops/" in path else "py")
+        if path not in out[plane]:
+            out[plane].append(path)
+    return out
+
+
+def logic_readback_diffs(root: str, spec: Dict) -> List[str]:
+    """The expression read-back (ISSUE 19): parse every emitted logic
+    function on every plane back to IR and structurally compare against
+    the spec.  This is the same comparison SIM206 makes at lint time —
+    two independent processes, one meaning."""
+    from .cspec import parse_c_logic_functions
+    out: List[str] = []
+    fns = dict(_logic_functions(spec))
+    if not fns:
+        return out
+    planes: Dict[str, Dict] = {"py": {}, "c": {}, "kernel": {}}
+    for plane, paths in _logic_plane_files().items():
+        for path in paths:
+            try:
+                with open(os.path.join(root, path),
+                          encoding="utf-8") as f:
+                    text = f.read()
+            except OSError as e:
+                out.append(f"readback: {path}: unreadable: {e}")
+                continue
+            if plane == "c":
+                planes["c"].update(parse_c_logic_functions(text))
+            else:
+                planes[plane].update(
+                    logic_ir.parse_py_functions(text, plane))
+    for name in sorted(fns):
+        fn = fns[name]
+        resolved = _resolved_expr(spec, fn)
+        for plane in ("py", "c", "kernel"):
+            sym = logic_ir.plane_symbol(name, plane)
+            got = planes[plane].get(name)
+            if got is None:
+                out.append(f"readback: logic fn {name} ({sym}) missing "
+                           f"on the {plane} plane — run `make gen`")
+                continue
+            args, ir, _line = got
+            if list(args) != list(fn["args"]):
+                out.append(f"readback: {sym} args {list(args)} != spec "
+                           f"args {list(fn['args'])}")
+            elif ir is None:
+                out.append(f"readback: {sym} body is not a single "
+                           f"portable-IR expression")
+            else:
+                d = logic_ir.structural_diff(resolved, ir)
+                if d:
+                    out.append(f"readback: logic fn {name} drifted on "
+                               f"the {plane} plane: {d}")
     return out
 
 

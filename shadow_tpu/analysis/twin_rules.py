@@ -17,6 +17,10 @@ SIM204   error     dtype/overflow hazard in a device kernel (sim-ns
                    value narrowed to a 32-bit lane)
 SIM205   error     simgen-generated region hand-edited (body digest
                    drift) or stale vs spec/protocol_spec.json
+SIM206   error     emitted protocol-logic expression drifted from the
+                   spec's expression IR (read-back: the plane's parsed
+                   tree differs structurally from the resolved spec
+                   tree)
 =======  ========  ====================================================
 
 The extracted IR serializes to ``spec/protocol.json`` (``simtwin
@@ -109,6 +113,18 @@ CANON: Dict[str, str] = {
     "CUBIC_C": "CUBIC_C", "CUBIC_BETA": "CUBIC_BETA",
     "CubicX.C": "CUBICX_C", "CubicX.BETA": "CUBICX_BETA",
     "CUBICX_C": "CUBICX_C", "CUBICX_BETA": "CUBICX_BETA",
+    # bbrx estimator parameters: named identically on all three planes
+    # (generated logic regions, ISSUE 19)
+    "BBRX_BETA_DEN": "BBRX_BETA_DEN", "BBRX_BETA_NUM": "BBRX_BETA_NUM",
+    "BBRX_BW_CAP_BPS": "BBRX_BW_CAP_BPS", "BBRX_CYCLE_LEN": "BBRX_CYCLE_LEN",
+    "BBRX_CYCLE_NS": "BBRX_CYCLE_NS",
+    "BBRX_GAIN_CRUISE_NUM": "BBRX_GAIN_CRUISE_NUM",
+    "BBRX_GAIN_DEN": "BBRX_GAIN_DEN",
+    "BBRX_GAIN_DOWN_NUM": "BBRX_GAIN_DOWN_NUM",
+    "BBRX_GAIN_UP_NUM": "BBRX_GAIN_UP_NUM",
+    "BBRX_MIN_CWND_SEGMENTS": "BBRX_MIN_CWND_SEGMENTS",
+    "BBRX_RTT_CAP_NS": "BBRX_RTT_CAP_NS",
+    "BBRX_RTT_FLOOR_NS": "BBRX_RTT_FLOOR_NS",
 }
 
 # C-side regex probes for coefficients spelled inline (see cspec._run_probe)
@@ -117,16 +133,14 @@ C_PROBES: Dict[str, Tuple[str, str]] = {
     "DUP_ACK_THRESHOLD": (r"\bcount\s*==\s*(\d+)", "one"),
     "QUICK_ACKS_LIMIT": (r"quick_acks\s*<\s*(\d+)", "one"),
     "DELACK_DELAYS_NS": (r"\bdelay\s*=\s*([^;]+);", "set"),
-    "SSTHRESH_RULE": (r"cwnd\s*/\s*(\d+)\s*,\s*(\d+)\s*\*\s*mss", "pair"),
-    "RECOVERY_INFLATE_SEGMENTS": (r"ssthresh\s*\+\s*(\d+)\s*\*\s*mss", "one"),
-    "RTTVAR_GAIN": (r"rttvar_ns\s*=\s*\(\s*(\d+)\s*\*\s*[\w>.-]*rttvar_ns"
-                    r"\s*\+\s*\w+\s*\)\s*/\s*(\d+)", "pair"),
-    "SRTT_GAIN": (r"srtt_ns\s*=\s*\(\s*(\d+)\s*\*\s*[\w>.-]*srtt_ns"
-                  r"\s*\+\s*\w+\s*\)\s*/\s*(\d+)", "pair"),
-    "RTO_VAR_MULT": (r"srtt_ns\s*\+\s*(\d+)\s*\*\s*[\w>.-]*rttvar_ns", "one"),
     # CUBIC_C / CUBIC_BETA left the probe set at the simgen cut-over: the
     # C plane now spells them as named constexpr constants (generated
     # region c-congestion-params), extracted like any other constant.
+    # SRTT_GAIN / RTTVAR_GAIN / RTO_VAR_MULT / SSTHRESH_RULE /
+    # RECOVERY_INFLATE_SEGMENTS left at the logic-surface cut-over
+    # (ISSUE 19): the update expressions are generated from the spec's
+    # logic IR and SIM206 compares the parsed trees structurally —
+    # strictly stronger than a per-coefficient regex.
 }
 
 # sim-time-ish identifiers for the SIM204 dtype pass
@@ -376,54 +390,9 @@ def _py_probes(ctx: ModuleContext, env: Dict[str, object],
             if isinstance(v, (int, float)):
                 delack.append(v)
                 delack_line = delack_line or ln
-        # max(cwnd // D, F * mss)  ->  SSTHRESH_RULE [D, F]
-        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
-                and node.func.id == "max" and len(node.args) == 2:
-            a, b = node.args
-            if isinstance(a, ast.BinOp) and isinstance(a.op, ast.FloorDiv) \
-                    and _attr_name(a.left) == "cwnd" \
-                    and isinstance(a.right, ast.Constant) \
-                    and isinstance(b, ast.BinOp) \
-                    and isinstance(b.op, ast.Mult) \
-                    and isinstance(b.left, ast.Constant) \
-                    and _attr_name(b.right) == "mss":
-                out.setdefault("SSTHRESH_RULE",
-                               ([a.right.value, b.left.value], ln))
-        # ssthresh + K * mss  ->  RECOVERY_INFLATE_SEGMENTS
-        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add) \
-                and _attr_name(node.left) == "ssthresh" \
-                and isinstance(node.right, ast.BinOp) \
-                and isinstance(node.right.op, ast.Mult) \
-                and isinstance(node.right.left, ast.Constant) \
-                and _attr_name(node.right.right) == "mss":
-            out.setdefault("RECOVERY_INFLATE_SEGMENTS",
-                           (node.right.left.value, ln))
-        # x.rttvar_ns = (A * rttvar + err) // B ; same for srtt
-        if isinstance(node, ast.Assign) and len(node.targets) == 1:
-            tname = _attr_name(node.targets[0])
-            if tname in ("rttvar_ns", "srtt_ns") \
-                    and isinstance(node.value, ast.BinOp) \
-                    and isinstance(node.value.op, ast.FloorDiv) \
-                    and isinstance(node.value.right, ast.Constant) \
-                    and isinstance(node.value.left, ast.BinOp) \
-                    and isinstance(node.value.left.op, ast.Add):
-                mul = node.value.left.left
-                if isinstance(mul, ast.BinOp) \
-                        and isinstance(mul.op, ast.Mult) \
-                        and isinstance(mul.left, ast.Constant) \
-                        and _attr_name(mul.right) == tname:
-                    key = "RTTVAR_GAIN" if tname == "rttvar_ns" \
-                        else "SRTT_GAIN"
-                    out.setdefault(key, ([mul.left.value,
-                                          node.value.right.value], ln))
-        # srtt_ns + K * rttvar_ns  ->  RTO_VAR_MULT
-        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add) \
-                and _attr_name(node.left) == "srtt_ns" \
-                and isinstance(node.right, ast.BinOp) \
-                and isinstance(node.right.op, ast.Mult) \
-                and isinstance(node.right.left, ast.Constant) \
-                and _attr_name(node.right.right) == "rttvar_ns":
-            out.setdefault("RTO_VAR_MULT", (node.right.left.value, ln))
+        # SRTT/RTTVAR/RTO/ssthresh/recovery coefficient probes retired at
+        # the logic-surface cut-over (ISSUE 19): SIM206 structurally
+        # compares the generated update expressions instead.
         # def __init__(..., capacity_packets: int = N)  ->  STATIC_CAPACITY
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             args = node.args.args
@@ -612,6 +581,7 @@ class TwinModel:
         from .genmark import SPEC_RELPATH, sha12
         if spec_text is None:
             spec_text = sources.get(SPEC_RELPATH)
+        self.spec_text = spec_text
         self.spec_digest = sha12(spec_text) if spec_text is not None else None
         self.parse_errors: List[Finding] = []
         self.py_ctx: Dict[str, ModuleContext] = {}
@@ -706,6 +676,38 @@ class TwinModel:
                 add(canon, rel, val, line,
                     _nearest_symbol(ext.symbols, line) or "unit")
         return merged
+
+    def _region_bodies(self, rel: str) -> List[Tuple[int, str]]:
+        """(line_offset, body_text) for each simgen region in a mapped
+        file.  The logic surface lives only inside generated regions, so
+        the SIM206 read-back parses nothing else — a hand-written
+        ``*_np`` kernel helper is not a logic function."""
+        from .genmark import scan_regions
+        regions, _ = scan_regions(self.sources[rel])
+        return [(r.begin_line, r.body) for r in regions]
+
+    def logic_functions_by_plane(
+            self) -> Dict[str, Dict[str, Tuple[List[str], object, int, str]]]:
+        """plane -> {logic_name: (args, ir_or_None, line, path)} parsed
+        from the generated regions of every mapped source — the SIM206
+        read-back input.  Functions are recognized by the naming
+        convention logic_ir owns (``_g_*``, ``gen_*`` free functions,
+        ``*_np``); body line numbers are offset back to file lines."""
+        from . import logic_ir
+        out: Dict[str, Dict[str, Tuple[List[str], object, int, str]]] = {
+            "py": {}, "c": {}, "kernel": {}}
+        for rel in sorted(self.c_extracts):
+            for off, body in self._region_bodies(rel):
+                parsed = cspec.parse_c_logic_functions(body)
+                for name, (args, ir, line) in sorted(parsed.items()):
+                    out["c"][name] = (args, ir, off + line, rel)
+        for rel in sorted(self.py_extracts):
+            plane = "kernel" if rel in self.kernel_paths else "py"
+            for off, body in self._region_bodies(rel):
+                parsed = logic_ir.parse_py_functions(body, plane)
+                for name, (args, ir, line) in sorted(parsed.items()):
+                    out[plane][name] = (args, ir, off + line, rel)
+        return out
 
     def transition_tables(self) -> Dict[str, Dict]:
         """path -> {'pairs': {(from, to): line}, 'states': [..]} for every
@@ -891,12 +893,91 @@ class GeneratedRegionRule(TwinRule):
         return findings
 
 
+class LogicDriftRule(TwinRule):
+    id = "SIM206"
+    severity = "error"
+    short = "emitted logic expression drifted from the spec IR"
+
+    def run(self, twin: TwinModel) -> List[Finding]:
+        import json
+
+        from . import logic_ir
+        if twin.spec_text is None:
+            return []
+        try:
+            spec = json.loads(twin.spec_text)
+        except ValueError:
+            return []
+        fns = spec.get("logic", {}).get("functions", {})
+        constants = spec.get("constants", {})
+        if not fns:
+            return []
+        findings: List[Finding] = []
+        resolved: Dict[str, object] = {}
+        for name, fn in sorted(fns.items()):
+            try:
+                resolved[name] = logic_ir.resolve(fn["expr"], constants)
+            except logic_ir.IRError as exc:
+                findings.append(Finding(
+                    self.id, self.severity, "spec/protocol_spec.json", 1, 0,
+                    f"logic fn {name}: spec expression does not resolve: "
+                    f"{exc}"))
+        for plane, got in sorted(twin.logic_functions_by_plane().items()):
+            if not got:
+                # a source set without a logic surface on this plane
+                # (fixtures, partial maps) is not drift
+                continue
+            anchor = sorted(g[3] for g in got.values())[0]
+            for name in sorted(set(fns) - set(got)):
+                findings.append(Finding(
+                    self.id, self.severity, anchor, 1, 0,
+                    f"spec logic fn {name} has no "
+                    f"`{logic_ir.plane_symbol(name, plane)}` on the "
+                    f"{plane} plane — run `make gen`"))
+            for name, (args, ir, line, rel) in sorted(got.items()):
+                sym = logic_ir.plane_symbol(name, plane)
+                fn = fns.get(name)
+                if fn is None:
+                    findings.append(Finding(
+                        self.id, self.severity, rel, line, 0,
+                        f"`{sym}` matches the generated-logic naming "
+                        f"convention but the spec has no logic fn "
+                        f"{name!r}"))
+                    continue
+                if list(args) != list(fn["args"]):
+                    findings.append(Finding(
+                        self.id, self.severity, rel, line, 0,
+                        f"`{sym}` takes {list(args)} but the spec says "
+                        f"{list(fn['args'])}"))
+                    continue
+                if ir is None:
+                    findings.append(Finding(
+                        self.id, self.severity, rel, line, 0,
+                        f"`{sym}` body is not a single expression of the "
+                        f"portable logic vocabulary — the spec is "
+                        f"authoritative: edit spec/protocol_spec.json "
+                        f"and run `make gen`"))
+                    continue
+                want = resolved.get(name)
+                if want is None:
+                    continue    # unresolvable spec expr already reported
+                diff = logic_ir.structural_diff(want, ir)
+                if diff:
+                    findings.append(Finding(
+                        self.id, self.severity, rel, line, 0,
+                        f"`{sym}` drifted from the spec logic IR: {diff} "
+                        f"— the spec is authoritative: edit "
+                        f"spec/protocol_spec.json and run `make gen`"))
+        return findings
+
+
 CATALOG: List[TwinRule] = [
     ConstantDriftRule(),
     TransitionDriftRule(),
     SurfaceMapRule(),
     KernelDtypeRule(),
     GeneratedRegionRule(),
+    LogicDriftRule(),
 ]
 
 
@@ -935,10 +1016,23 @@ def build_spec(twin: TwinModel) -> Dict:
             per_file.setdefault(e.plane + ":" + e.path, []).append(
                 e.symbol or "*")
         surfaces[surface] = per_file
+    # the logic surface as read back from the authoritative python plane:
+    # parsed (literal) expression trees, one entry per emitted function
+    from . import logic_ir
+    logic: Dict[str, Dict] = {}
+    for name, (args, ir, _line, rel) in sorted(
+            twin.logic_functions_by_plane()["py"].items()):
+        if ir is None:
+            continue
+        logic[name] = {
+            "args": list(args), "expr": ir,
+            "source": f"{rel}#{logic_ir.plane_symbol(name, 'py')}",
+        }
     return {
         "version": SPEC_VERSION,
         "generator": "simtwin --emit-spec",
         "constants": constants,
         "transitions": transitions,
         "surfaces": surfaces,
+        "logic": logic,
     }

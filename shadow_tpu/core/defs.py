@@ -6,7 +6,7 @@ is comparable.  The protocol-spec surfaces below are GENERATED from
 fenced region.
 """
 
-# >>> simgen:begin region=wire-defs spec=f421682bce6f body=8d099a58ba06
+# >>> simgen:begin region=wire-defs spec=293c930bb679 body=8d099a58ba06
 # Ethernet/IP framing (reference definitions.h:169-193).
 CONFIG_HEADER_SIZE_UDPIPETH = 42    # UDP+IP+ETH header bytes
 CONFIG_HEADER_SIZE_TCPIPETH = 66    # TCP+IP+ETH header bytes (with options)

@@ -10,13 +10,35 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-from typing import List, Optional
+import json
+import os
+from typing import List, Optional, Tuple
 
 SCHEDULER_POLICIES = ("global", "host", "steal", "thread", "threadXthread",
                       "threadXhost", "tpu")
 QDISC_KINDS = ("fifo", "rr")
 ROUTER_QUEUE_KINDS = ("codel", "single", "static")
-TCP_CC_KINDS = ("reno", "aimd", "cubic", "cubicx")
+
+_SPEC_PATH = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))),
+    "spec", "protocol_spec.json")
+_FALLBACK_CC_KINDS = ("reno", "aimd", "cubic", "cubicx", "bbrx")
+
+
+def _cc_kinds_from_spec() -> Tuple[str, ...]:
+    """Valid --tcp-congestion-control tokens, in kind-id order, read from
+    the authoritative spec.  The JSON is read directly (NOT via
+    ops.protocol_tables) so importing options never pulls in jax; an
+    installed copy without the spec tree falls back to the baked list."""
+    try:
+        with open(_SPEC_PATH, encoding="utf-8") as f:
+            kinds = json.load(f)["congestion"]["kinds"]
+    except (OSError, KeyError, ValueError):
+        return _FALLBACK_CC_KINDS
+    return tuple(sorted(kinds, key=lambda k: kinds[k]))
+
+
+TCP_CC_KINDS = _cc_kinds_from_spec()
 
 
 @dataclasses.dataclass

@@ -28,7 +28,7 @@ from typing import Any, Tuple
 import numpy as np
 
 _MASK32 = np.uint32(0xFFFFFFFF)
-# >>> simgen:begin region=threefry spec=f421682bce6f body=73de375b3b8e
+# >>> simgen:begin region=threefry spec=293c930bb679 body=73de375b3b8e
 # Threefry-2x32 rotation constants (Salmon et al., Table 2).
 _ROTATIONS = (13, 15, 26, 6, 17, 29, 16, 24)
 _PARITY = 0x1BD11BDA  # SKEIN_KS_PARITY32

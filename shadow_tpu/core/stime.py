@@ -14,7 +14,7 @@ code uses int64 and the simulator asserts times stay below 2**63.
 
 from __future__ import annotations
 
-# >>> simgen:begin region=clock spec=f421682bce6f body=0992823276f8
+# >>> simgen:begin region=clock spec=293c930bb679 body=0992823276f8
 # One simulated nanosecond is the base unit.
 SIM_TIME_NS = 1
 SIM_TIME_US = 1000

@@ -18,7 +18,7 @@ from __future__ import annotations
 from collections import deque
 from typing import Callable, List, Optional, Set
 
-# >>> simgen:begin region=status-bits spec=f421682bce6f body=dab61b8b2aea
+# >>> simgen:begin region=status-bits spec=293c930bb679 body=dab61b8b2aea
 # Status bits (reference descriptor.h DS_*).
 S_NONE = 0
 S_ACTIVE = 1

@@ -14,7 +14,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .base import Descriptor, S_CLOSED, S_READABLE, S_WRITABLE
 
-# >>> simgen:begin region=epoll-bits spec=f421682bce6f body=d97e3afb8d41
+# >>> simgen:begin region=epoll-bits spec=293c930bb679 body=d97e3afb8d41
 EPOLLIN = 0x001
 EPOLLOUT = 0x004
 EPOLLERR = 0x008

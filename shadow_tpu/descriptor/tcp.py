@@ -48,7 +48,7 @@ from .retransmit_tally import make_tally
 from .tcp_cong import make_congestion_control
 from ..core.worker import current_worker
 
-# >>> simgen:begin region=tcp-states spec=f421682bce6f body=c91ef6656a5d
+# >>> simgen:begin region=tcp-states spec=293c930bb679 body=c91ef6656a5d
 # states (reference tcp.c enum TCPState :42-47)
 CLOSED = "closed"
 LISTEN = "listen"
@@ -84,7 +84,7 @@ TCP_TRANSITIONS = (
 
 MSS = defs.CONFIG_TCP_MAX_SEGMENT_SIZE
 
-# >>> simgen:begin region=tcp-timers spec=f421682bce6f body=21bb9e099dc9
+# >>> simgen:begin region=tcp-timers spec=293c930bb679 body=21bb9e099dc9
 RTO_INIT_NS = 1000000000
 RTO_MIN_NS = 200000000
 RTO_MAX_NS = 120000000000
@@ -93,6 +93,30 @@ MAX_SYN_RETRIES = 6                           # Linux tcp_syn_retries default
 MAX_RETRIES = 15                              # Linux tcp_retries2
 MAX_SACK_BLOCKS = 4
 # <<< simgen:end region=tcp-timers
+
+# >>> simgen:begin region=tcp-logic spec=293c930bb679 body=cc99e04c0aa5
+# RTT/RTO update logic, generated from the spec's expression IR
+# (SIM206 parses these bodies back and compares them to the spec).
+
+def _g_rto_backoff(rto_ns):
+    """exponential backoff on retransmission timeout"""
+    return min((rto_ns * 2), 120000000000)
+
+
+def _g_rto_from_estimate(srtt_ns, rttvar_ns):
+    """RTO = clamp(srtt + 4*rttvar) into [RTO_MIN, RTO_MAX]"""
+    return max(200000000, min((srtt_ns + (4 * rttvar_ns)), 120000000000))
+
+
+def _g_rttvar_update(srtt_ns, rttvar_ns, sample_ns):
+    """RFC 6298 RTT variance over the PRE-update srtt; |err| spelled max-min so every plane stays in non-negative int64"""
+    return ((sample_ns // 2) if (srtt_ns == 0) else (((3 * rttvar_ns) + (max(sample_ns, srtt_ns) - min(sample_ns, srtt_ns))) // 4))
+
+
+def _g_srtt_update(srtt_ns, sample_ns):
+    """RFC 6298 smoothed RTT; first sample seeds the filter"""
+    return (sample_ns if (srtt_ns == 0) else (((7 * srtt_ns) + sample_ns) // 8))
+# <<< simgen:end region=tcp-logic
 
 
 class _Segment:
@@ -573,7 +597,7 @@ class TCPSocket(Socket):
         if self.cong is not None:
             self.cong.on_timeout()
         self.dup_ack_count = 0
-        self.rto_ns = min(self.rto_ns * 2, RTO_MAX_NS)
+        self.rto_ns = _g_rto_backoff(self.rto_ns)
         self._retransmit_segment(seg)
         self._arm_rto()
 
@@ -628,15 +652,11 @@ class TCPSocket(Socket):
     def _rtt_sample(self, sample_ns: int) -> None:
         if sample_ns <= 0:
             return
-        if self.srtt_ns == 0:
-            self.srtt_ns = sample_ns
-            self.rttvar_ns = sample_ns // 2
-        else:
-            err = abs(sample_ns - self.srtt_ns)
-            self.rttvar_ns = (3 * self.rttvar_ns + err) // 4
-            self.srtt_ns = (7 * self.srtt_ns + sample_ns) // 8
-        self.rto_ns = max(RTO_MIN_NS,
-                          min(self.srtt_ns + 4 * self.rttvar_ns, RTO_MAX_NS))
+        # rttvar first: it reads the PRE-update srtt (RFC 6298 order)
+        self.rttvar_ns = _g_rttvar_update(self.srtt_ns, self.rttvar_ns,
+                                          sample_ns)
+        self.srtt_ns = _g_srtt_update(self.srtt_ns, sample_ns)
+        self.rto_ns = _g_rto_from_estimate(self.srtt_ns, self.rttvar_ns)
         self._autotune(sample_ns)
 
     def _recv_autotune(self) -> None:

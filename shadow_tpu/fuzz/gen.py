@@ -153,6 +153,15 @@ def app_modes(rng, n_hosts: int) -> List[Dict]:
                            events_comparable=False,
                            engine_fault="shard-exit-resurrect:1:2",
                            max_resurrections=3))
+    # the spec-defined CC family (ISSUE 19), appended AFTER all rng draws
+    # so every historical seed's scenario replays unchanged.  bbrx takes a
+    # legitimately different trajectory from the reno-default legs, so the
+    # pair carries its own digest_group: the parity/events oracles compare
+    # bbrx-vs-bbrx (the generated logic must land one digest across the
+    # table on/off axis), never bbrx-vs-base.
+    modes.append(_mode("bbrx", tcpcc="bbrx", digest_group="bbrx"))
+    modes.append(_mode("bbrx-table-off", tcpcc="bbrx", host_table="off",
+                       digest_group="bbrx"))
     return modes
 
 

@@ -37,6 +37,18 @@ def _live(results: List[Dict]) -> List[Dict]:
             if not r.get("skipped") and r.get("rc") == 0]
 
 
+def _by_group(live: List[Dict]) -> Dict[str, List[Dict]]:
+    """Partition results by digest group.  A mode that legitimately takes
+    a different trajectory (the bbrx CC legs, ISSUE 19) carries its own
+    ``digest_group``; parity/conservation hold WITHIN each group, never
+    across them.  Absent key = the historical "base" group, so old corpus
+    records replay unchanged."""
+    groups: Dict[str, List[Dict]] = {}
+    for r in live:
+        groups.setdefault(r.get("digest_group") or "base", []).append(r)
+    return groups
+
+
 @oracle("rc_log")
 def oracle_rc_log(spec: Dict, results: List[Dict]) -> List[Violation]:
     """Every non-skipped mode exits rc 0 inside its wall bound, with no
@@ -87,18 +99,20 @@ def oracle_stability(spec: Dict, results: List[Dict]) -> List[Violation]:
 def oracle_parity(spec: Dict, results: List[Dict]) -> List[Violation]:
     """Cross-mode digest parity: every mode of the matrix — device/numpy
     twins, K=1/K=8, table on/off, threaded, procs, mesh — ends in the
-    same state digest."""
-    live = [r for r in _live(results) if r.get("digest")]
-    if len(live) < 2:
-        return []
-    ref = live[0]
+    same state digest, within its digest group (a group per legitimate
+    trajectory: base, bbrx)."""
     out = []
-    for r in live[1:]:
-        if r["digest"] != ref["digest"]:
-            out.append(_v("parity",
-                          f"{r['mode']} digest {r['digest']!r} != "
-                          f"{ref['mode']} {ref['digest']!r}",
-                          [ref["mode"], r["mode"]]))
+    for _, live in sorted(_by_group(
+            [r for r in _live(results) if r.get("digest")]).items()):
+        if len(live) < 2:
+            continue
+        ref = live[0]
+        for r in live[1:]:
+            if r["digest"] != ref["digest"]:
+                out.append(_v("parity",
+                              f"{r['mode']} digest {r['digest']!r} != "
+                              f"{ref['mode']} {ref['digest']!r}",
+                              [ref["mode"], r["mode"]]))
     return out
 
 
@@ -106,19 +120,23 @@ def oracle_parity(spec: Dict, results: List[Dict]) -> List[Violation]:
 def oracle_events(spec: Dict, results: List[Dict]) -> List[Violation]:
     """Event-count conservation across the serial single-process modes
     (device/numpy, K=1/K=8, table on/off execute the identical event
-    stream; threaded/procs modes are digest-checked only)."""
-    live = [r for r in _live(results)
-            if r.get("events_comparable") and r.get("events") is not None]
-    if len(live) < 2:
-        return []
-    ref = live[0]
+    stream; threaded/procs modes are digest-checked only).  Conservation
+    holds within each digest group — a different CC trajectory schedules
+    a different event stream."""
     out = []
-    for r in live[1:]:
-        if r["events"] != ref["events"]:
-            out.append(_v("events",
-                          f"{r['mode']} executed {r['events']} events != "
-                          f"{ref['mode']}'s {ref['events']}",
-                          [ref["mode"], r["mode"]]))
+    for _, live in sorted(_by_group(
+            [r for r in _live(results)
+             if r.get("events_comparable")
+             and r.get("events") is not None]).items()):
+        if len(live) < 2:
+            continue
+        ref = live[0]
+        for r in live[1:]:
+            if r["events"] != ref["events"]:
+                out.append(_v("events",
+                              f"{r['mode']} executed {r['events']} events "
+                              f"!= {ref['mode']}'s {ref['events']}",
+                              [ref["mode"], r["mode"]]))
     return out
 
 
@@ -179,21 +197,24 @@ def oracle_mesh(spec: Dict, results: List[Dict]) -> List[Violation]:
 def oracle_completion(spec: Dict, results: List[Dict]) -> List[Violation]:
     """Flow-completion conservation: every mode sees the same circuit
     count and completes the same number of them (completion inside the
-    stoptime is scenario-dependent; its CONSISTENCY is not)."""
-    live = [r for r in _live(results)
-            if "plane.circuits" in (r.get("scrape") or {})]
-    if len(live) < 2:
-        return []
-    ref = live[0]
+    stoptime is scenario-dependent; its CONSISTENCY is not).  Judged
+    within each digest group, like parity."""
     out = []
-    for r in live[1:]:
-        for key in ("plane.circuits", "plane.completed"):
-            if r["scrape"].get(key) != ref["scrape"].get(key):
-                out.append(_v("completion",
-                              f"{r['mode']} {key}="
-                              f"{r['scrape'].get(key)} != {ref['mode']}'s "
-                              f"{ref['scrape'].get(key)}",
-                              [ref["mode"], r["mode"]]))
+    for _, live in sorted(_by_group(
+            [r for r in _live(results)
+             if "plane.circuits" in (r.get("scrape") or {})]).items()):
+        if len(live) < 2:
+            continue
+        ref = live[0]
+        for r in live[1:]:
+            for key in ("plane.circuits", "plane.completed"):
+                if r["scrape"].get(key) != ref["scrape"].get(key):
+                    out.append(_v("completion",
+                                  f"{r['mode']} {key}="
+                                  f"{r['scrape'].get(key)} != "
+                                  f"{ref['mode']}'s "
+                                  f"{ref['scrape'].get(key)}",
+                                  [ref["mode"], r["mode"]]))
     return out
 
 

@@ -47,6 +47,7 @@ def _mode_options(spec: Dict, mode: Dict):
         seed=int(spec.get("engine_seed", 1)),
         host_table=mode.get("host_table", "on"),
         dataplane=mode.get("dataplane", "python"),
+        tcp_congestion_control=mode.get("tcpcc", "reno"),
         device_plane=mode.get("device_plane", "device"),
         superwindow_rounds=int(mode.get("superwindow_rounds", 8)),
         device_plane_sync=bool(mode.get("device_plane_sync", False)),
@@ -134,6 +135,7 @@ def run_one_mode(spec: Dict, mode: Dict, lane=None) -> Dict:
                  "repeat_of": mode.get("repeat_of"),
                  "events_comparable": bool(
                      mode.get("events_comparable", True)),
+                 "digest_group": mode.get("digest_group", "base"),
                  "engine_fault": mode.get("engine_fault"),
                  "skipped": None, "rc": None, "digest": None,
                  "events": None, "rounds": None, "supervision": None,

@@ -20,7 +20,7 @@ from .network_interface import NetworkInterface
 from .router import Router, make_queue
 from .tracker import Tracker
 
-# >>> simgen:begin region=port-alloc spec=f421682bce6f body=00a7ffddc53c
+# >>> simgen:begin region=port-alloc spec=293c930bb679 body=00a7ffddc53c
 MIN_EPHEMERAL_PORT = 10000
 MAX_PORT = 65535
 # <<< simgen:end region=port-alloc

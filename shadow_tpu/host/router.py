@@ -18,7 +18,7 @@ from typing import Optional
 
 from ..core.worker import current_worker
 
-# >>> simgen:begin region=router-static spec=f421682bce6f body=424e965b21b5
+# >>> simgen:begin region=router-static spec=293c930bb679 body=424e965b21b5
 STATIC_CAPACITY = 1024  # packets (reference router_queue_static.c)
 # <<< simgen:end region=router-static
 
@@ -95,7 +95,7 @@ class CoDelQueue(QueueManager):
     size cap to bound memory like the kernel's implementation.
     """
 
-    # >>> simgen:begin region=codel-params spec=f421682bce6f body=eb7dab75d865
+    # >>> simgen:begin region=codel-params spec=293c930bb679 body=eb7dab75d865
     TARGET_NS = 10000000
     INTERVAL_NS = 100000000
     HARD_LIMIT = 1000  # packets

@@ -50,7 +50,7 @@ import jax.numpy as jnp
 
 from ..core import defs, stime
 
-# >>> simgen:begin region=token-bucket-kernel spec=f421682bce6f body=ae8bb8568cdc
+# >>> simgen:begin region=token-bucket-kernel spec=293c930bb679 body=ae8bb8568cdc
 REFILL_NS = 1000000   # == defs.INTERFACE_REFILL_INTERVAL_NS (1 ms)
 # <<< simgen:end region=token-bucket-kernel
 

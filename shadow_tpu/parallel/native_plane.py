@@ -141,7 +141,22 @@ def native_available() -> bool:
     return _load_module() is not None
 
 
-_CC_KINDS = {"reno": 0, "aimd": 1, "cubic": 2, "cubicx": 3}
+def _cc_kinds() -> dict:
+    """config-token -> C-plane CcKind id, from the authoritative spec so
+    a spec-defined family (cubicx, bbrx) is selectable here with no hand
+    edit.  Read as JSON — this module must not import ops.protocol_tables
+    (jax import side effect; see tests/test_simgen.py)."""
+    import json
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(pkg, "..", "spec", "protocol_spec.json")
+    try:
+        with open(path, encoding="utf-8") as f:
+            return dict(json.load(f)["congestion"]["kinds"])
+    except (OSError, KeyError, ValueError):
+        return {"reno": 0, "aimd": 1, "cubic": 2, "cubicx": 3, "bbrx": 4}
+
+
+_CC_KINDS = _cc_kinds()
 _RQ_KINDS = {"codel": 0, "single": 1, "static": 2}
 
 

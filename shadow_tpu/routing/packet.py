@@ -64,7 +64,7 @@ class TCPHeader:
         self.timestamp_echo = timestamp_echo
 
 
-# >>> simgen:begin region=tcp-flags spec=f421682bce6f body=5c389b66fae3
+# >>> simgen:begin region=tcp-flags spec=293c930bb679 body=5c389b66fae3
 # TCP header flag bits (reference tcp.c enum ProtocolTCPFlags).
 TCP_NONE = 0
 TCP_RST = 2

@@ -283,8 +283,59 @@ def swarm(n_peers: int = 2_000, pieces: int = 4, stoptime: int = 120,
     return cfg
 
 
+def mixnet(n_hosts: int = 2_000, stoptime: int = 120,
+           down_bytes: int = 16 * 1024, up_bytes: int = 2 * 1024,
+           cover_cell_bytes: int = 512, cover_cells: int = 8,
+           cover_interval_sec: float = 2.0, start_sec: float = 2.0,
+           stagger_waves: int = 4, stagger_step_sec: float = 1.0,
+           seed: int = 7) -> Configuration:
+    """mixnet2k: an onion-route variant with constant-rate cover traffic
+    (ROADMAP item 5's device-plane best case).  The tor shape — ~10%
+    relays, ~1% fat exits, the rest clients on distinct seeded 3-hop
+    circuits — plus, per client, ``cover_cells`` fixed-size cover cells
+    fired at a constant ``cover_interval_sec`` cadence over distinct
+    seeded circuits (a mixnet's loop cover: traffic flows whether or not
+    payload does).  Every cell is a processless 5-hop device chain, so
+    the plane carries cells-per-second x clients with zero host events —
+    the highest chain-count-per-host shape in the family set."""
+    if cover_cells < 1:
+        raise ValueError("mixnet needs at least one cover cell")
+    n_relays = max(3, n_hosts // 10)
+    n_servers = max(1, n_hosts // 100)
+    n_clients = max(1, n_hosts - n_relays - n_servers)
+    cfg = Configuration(stop_time_sec=stoptime)
+    cfg.hosts.append(HostConfig(
+        id="mixrelay", quantity=n_relays,
+        bandwidth_down_kibps=102400, bandwidth_up_kibps=102400))
+    cfg.hosts.append(HostConfig(
+        id="mixdest", quantity=n_servers,
+        bandwidth_down_kibps=1048576, bandwidth_up_kibps=1048576))
+    tor_kw = dict(tor_path_seed=seed, tor_relays=n_relays,
+                  tor_relay_prefix="mixrelay", tor_servers=n_servers,
+                  tor_server_prefix="mixdest")
+    # the payload circuit, then the constant-rate cover cells — each cell
+    # wave rides its own seeded circuit (route diversity is the point of
+    # cover), launched at a fixed cadence with no stagger so the rate the
+    # plane sees is genuinely constant per client
+    flows = [FlowConfig(dest="", start_time_sec=start_sec,
+                        down_bytes=down_bytes, up_bytes=up_bytes,
+                        stagger_waves=stagger_waves,
+                        stagger_step_sec=stagger_step_sec, **tor_kw)]
+    for k in range(cover_cells):
+        flows.append(FlowConfig(
+            dest="", start_time_sec=start_sec + k * cover_interval_sec,
+            down_bytes=cover_cell_bytes, up_bytes=cover_cell_bytes,
+            **dict(tor_kw, tor_path_seed=seed * 8191 + k + 1)))
+    cfg.hosts.append(HostConfig(
+        id="mixclient", quantity=n_clients,
+        bandwidth_down_kibps=51200, bandwidth_up_kibps=25600,
+        flows=flows))
+    return cfg
+
+
 FAMILIES: Dict[str, object] = {
     "star": star, "phold": phold, "tor": tor, "cdn": cdn, "swarm": swarm,
+    "mixnet": mixnet,
 }
 
 # name -> (family, preset kwargs).  build() MERGES overrides onto the
@@ -305,6 +356,9 @@ PRESETS: Dict[str, tuple] = {
     "cdn20k": ("cdn", dict(n_clients=20_000, n_origins=4)),
     "swarm500": ("swarm", dict(n_peers=500, pieces=3, stoptime=60)),
     "swarm2k": ("swarm", dict(n_peers=2_000, pieces=4)),
+    "mixnet500": ("mixnet", dict(n_hosts=500, stoptime=60,
+                                 cover_cells=4)),
+    "mixnet2k": ("mixnet", dict(n_hosts=2_000, cover_cells=8)),
 }
 
 # kept for callers that list/run the presets directly

@@ -324,6 +324,46 @@ def test_cdn_generator_shape():
     assert dests == {"origin1", "origin2", "origin3", "origin4"}
 
 
+def test_mixnet_generator_shape_and_determinism():
+    """ISSUE 19 satellite: the mixnet family — tor shape plus per-client
+    constant-rate cover cells, each cover wave on its own seeded circuit
+    with NO stagger (the rate the plane sees must be genuinely
+    constant)."""
+    cfg = genscen.build("mixnet500")
+    groups = [(h.id, h.quantity, len(h.flows or ())) for h in cfg.hosts]
+    assert groups == [("mixrelay", 50, 0), ("mixdest", 5, 0),
+                      ("mixclient", 445, 5)]
+    assert genscen.config_digest(cfg) == \
+        genscen.config_digest(genscen.build("mixnet500"))
+    assert genscen.config_digest(cfg) != \
+        genscen.config_digest(genscen.build("mixnet500", cover_cells=3))
+    payload, *cover = cfg.hosts[2].flows
+    assert payload.stagger_waves == 4
+    assert [f.start_time_sec for f in cover] == \
+        [2.0 + 2.0 * k for k in range(4)]
+    for f in cover:
+        assert f.stagger_waves == 1 and f.down_bytes == f.up_bytes == 512
+    seeds = {f.tor_path_seed for f in cover}
+    assert len(seeds) == 4 and payload.tor_path_seed not in seeds
+    with pytest.raises(ValueError, match="cover cell"):
+        genscen.mixnet(500, cover_cells=0)
+
+
+def test_mixnet_cover_traffic_all_on_device():
+    """Every payload circuit and every cover cell completes as a
+    processless 5-hop device chain — zero Host objects materialize."""
+    ctrl = _run_scenario(genscen.build("mixnet500", stoptime=60))
+    e = ctrl.engine
+    st = e.device_plane.stats()
+    assert st["circuits"] == 445 * 5
+    assert st["completed"] == st["circuits"]
+    assert e.host_table.materialized_count == 0
+    table = e.host_table
+    moved = sum(int(table.rx_bytes[r]) + int(table.tx_bytes[r])
+                for r in range(50))          # relays are the first group
+    assert moved > 0
+
+
 def test_swarm_generator_no_self_flows():
     cfg = genscen.swarm(60, pieces=3, stoptime=60)
     from shadow_tpu.scale.genscen import expand_flows

@@ -71,7 +71,7 @@ def test_mode_matrix_axes_all_engaged():
             "exchange_ppermute": False, "autotune_on": False,
             "autotune_off": False, "resume": False,
             "fault_resurrect": False, "fault_device_lost": False,
-            "fault_repromote": False}
+            "fault_repromote": False, "bbrx": False}
     for seed in range(40):
         spec = draw_spec(seed)
         seen_fams.add(spec["family"])
@@ -121,6 +121,11 @@ def test_mode_matrix_axes_all_engaged():
             if ef.startswith("demote-repromote:"):
                 axes["fault_repromote"] = True
                 assert int(m.get("repromote_after", 0)) > 0
+            # the spec-defined CC axis (ISSUE 19): the bbrx legs run in
+            # their own digest group so parity is judged bbrx-vs-bbrx
+            if m.get("tcpcc") == "bbrx":
+                axes["bbrx"] = True
+                assert m.get("digest_group") == "bbrx"
     missing = sorted(k for k, v in axes.items() if not v)
     assert not missing, f"axes never engaged: {missing} ({seen_modes})"
     assert seen_fams == {"star", "tor", "cdn", "swarm", "phold", "appmix"}

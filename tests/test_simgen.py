@@ -507,6 +507,157 @@ def test_cubicx_per_host_selection_with_parity():
 
 
 # ---------------------------------------------------------------------------
+# the ISSUE 19 payoff: bbrx defined ONLY in the spec, live on all planes
+
+
+def test_bbrx_is_defined_only_in_the_spec():
+    """Acceptance: zero hand-written bbrx logic outside fenced regions —
+    every line mentioning the family on any plane file lives inside a
+    simgen region, and the materialized coefficients/kind ids are the
+    spec's."""
+    from shadow_tpu.descriptor.tcp_cong import (BbrX, CongestionControl,
+                                                make_congestion_control)
+    from shadow_tpu.ops import protocol_tables as pt
+    cc = make_congestion_control("bbrx", 1448)
+    assert isinstance(cc, BbrX) and isinstance(cc, CongestionControl)
+    assert pt.CC_KIND_IDS["bbrx"] == SPEC["congestion"]["kinds"]["bbrx"]
+    c = SPEC["constants"]
+    assert pt.BBRX_CYCLE_LEN == c["BBRX_CYCLE_LEN"]
+    assert pt.BBRX_RTT_CAP_NS == c["BBRX_RTT_CAP_NS"]
+    for path in ("shadow_tpu/descriptor/tcp.py",
+                 "shadow_tpu/descriptor/tcp_cong.py",
+                 "shadow_tpu/ops/protocol_tables.py",
+                 "native/dataplane.cc"):
+        with open(os.path.join(REPO, path), encoding="utf-8") as f:
+            text = f.read()
+        regions, problems = scan_regions(text)
+        assert not problems, (path, problems)
+        inside = set()
+        for r in regions:
+            inside.update(range(r.begin_line, r.end_line + 1))
+        outside = [(i, line) for i, line in
+                   enumerate(text.splitlines(), start=1)
+                   if "bbrx" in line.lower() and i not in inside]
+        assert not outside, (
+            f"{path} carries hand-written bbrx lines outside generated "
+            f"regions: {outside[:3]}")
+
+
+def test_logic_surface_four_way_parity_on_value_grids():
+    """Every spec logic function agrees BIT-EXACTLY across (1) the IR
+    reference interpreter, (2) the emitted python plane ``_g_*``, (3) the
+    emitted kernel numpy twin ``*_np``, and (4) the same kernel spelling
+    traced by jax.jit over device int64 arrays — the device-vs-numpy leg
+    of the acceptance criteria, on value grids instead of one scenario."""
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from shadow_tpu.analysis import logic_ir
+    from shadow_tpu.descriptor import tcp, tcp_cong
+    from shadow_tpu.ops import protocol_tables as pt
+
+    def values_for(arg):
+        if arg == "cycle_idx":
+            return list(range(SPEC["constants"]["BBRX_CYCLE_LEN"]))
+        if arg == "gain_num":
+            return [3, 4, 5]
+        if arg == "mss":
+            return [536, 1448]
+        if arg.endswith("_bps"):
+            return [0, 1000, 10**9, 10**12]
+        if arg.endswith("_ns"):
+            return [0, 1, 100_000, 25_000_000, 10**9]
+        return [0, 1448, 65_536, 10**7]     # byte/window quantities
+
+    fns = SPEC["logic"]["functions"]
+    assert len(fns) >= 14
+    for name, fn in sorted(fns.items()):
+        args = fn["args"]
+        ir = logic_ir.resolve(fn["expr"], SPEC["constants"])
+        pts = list(itertools.product(*(values_for(a) for a in args)))
+        want = [logic_ir.evaluate(ir, dict(zip(args, p))) for p in pts]
+        py_fn = getattr(tcp, "_g_" + name, None) \
+            or getattr(tcp_cong, "_g_" + name)
+        assert [py_fn(*p) for p in pts] == want, name
+        np_fn = getattr(pt, name + "_np")
+        cols = [np.array(c, dtype=np.int64) for c in zip(*pts)]
+        np.testing.assert_array_equal(
+            np.asarray(np_fn(*cols)), np.array(want), err_msg=name)
+        pt.np = jnp        # the emitted spelling IS the device kernel
+        try:
+            got_dev = np.asarray(jax.jit(np_fn)(
+                *[jnp.asarray(col) for col in cols]))
+        finally:
+            pt.np = np
+        np.testing.assert_array_equal(got_dev, np.array(want), err_msg=name)
+
+
+def test_bbrx_runtime_parity_python_vs_native():
+    """The generated C-plane bbrx must reproduce the generated
+    Python-plane bbrx bit-exactly — and actually take the family's
+    trajectory (digest differs from cubicx on the same scenario)."""
+    _native_or_skip()
+    from shadow_tpu.core.checkpoint import state_digest
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from test_tcp_e2e import two_host_xml
+    xml = two_host_xml("tcp client server 8000 3 65536", loss=0.1, stop=300)
+    rc_p, eng_p = _run_sim(xml, "python", 300, "bbrx")
+    rc_n, eng_n = _run_sim(xml, "native", 300, "bbrx")
+    assert rc_p == 0 and rc_n == 0
+    assert eng_n.native_plane is not None and eng_p.native_plane is None
+    assert eng_p.events_executed == eng_n.events_executed
+    assert state_digest(eng_p) == state_digest(eng_n)
+    rc_x, eng_x = _run_sim(xml, "python", 300, "cubicx")
+    assert rc_x == 0
+    assert state_digest(eng_p) != state_digest(eng_x), (
+        "bbrx trajectory is indistinguishable from cubicx — the "
+        "spec-defined estimator never engaged")
+
+
+def test_bbrx_per_host_selection_with_parity():
+    """<host tcpcc=\"bbrx\"> selects the family for ONE host while the
+    rest keep the engine default — in both planes, digest-identically."""
+    _native_or_skip()
+    from shadow_tpu.core.checkpoint import state_digest
+    xml = textwrap.dedent("""\
+        <shadow stoptime="200">
+          <plugin id="app" path="python:echo" />
+          <host id="server" bandwidthdown="10240" bandwidthup="10240"
+                iphint="10.0.0.1">
+            <process plugin="app" starttime="1" arguments="tcp server 8000" />
+          </host>
+          <host id="client" bandwidthdown="10240" bandwidthup="10240"
+                iphint="10.0.0.2" tcpcc="bbrx">
+            <process plugin="app" starttime="2"
+                     arguments="tcp client server 8000 4 8192" />
+          </host>
+        </shadow>
+    """)
+    rc_p, eng_p = _run_sim(xml, "python", 200)
+    rc_n, eng_n = _run_sim(xml, "native", 200)
+    assert rc_p == 0 and rc_n == 0
+    assert eng_p.host_by_name("client").params.tcp_cc == "bbrx"
+    assert state_digest(eng_p) == state_digest(eng_n)
+
+
+def test_unknown_engine_tcpcc_fails_at_parse_naming_spec_kinds():
+    """The CLI rejects an unknown --tcp-congestion-control at PARSE time,
+    and the choice list is read from the spec (bbrx is in it without any
+    hand edit) — the ISSUE 19 small-fix regression pin."""
+    from shadow_tpu.core.options import TCP_CC_KINDS, build_parser
+    assert "bbrx" in TCP_CC_KINDS
+    assert set(TCP_CC_KINDS) == set(SPEC["congestion"]["kinds"])
+    parser = build_parser()
+    with pytest.raises(SystemExit):
+        parser.parse_args(["--tcp-congestion-control", "vegas"])
+    ns = parser.parse_args(["--tcp-congestion-control", "bbrx"])
+    assert ns.tcp_congestion_control == "bbrx"
+
+
+# ---------------------------------------------------------------------------
 # THE GATE: zero problems, zero unsuppressed findings
 
 

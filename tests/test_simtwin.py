@@ -280,6 +280,115 @@ def test_sim204_quiet_on_counts_and_non_kernel_files():
 
 
 # ---------------------------------------------------------------------------
+# SIM206 — emitted logic expression drifted from the spec IR
+
+
+def _logic_fixture(coeff=7, def_suffix=""):
+    """A minimal spec plus a python-plane fenced logic region whose
+    ``_g_srtt_update`` carries ``coeff`` as the SRTT gain numerator.
+    The region hashes are CONSISTENT (``spec=`` matches the fixture
+    spec bytes, ``body=`` matches the body), so SIM205 stays quiet and
+    only the SIM206 structural read-back can object."""
+    from shadow_tpu.analysis.genmark import (SPEC_RELPATH, begin_marker,
+                                             end_marker, sha12)
+    spec_text = json.dumps({
+        "constants": {"SRTT_GAIN": [7, 8]},
+        "logic": {"functions": {"srtt_update": {
+            "args": ["srtt_ns", "sample_ns"],
+            "expr": ["select", ["eq", "srtt_ns", 0], "sample_ns",
+                     ["floordiv",
+                      ["add", ["mul", ["ref", "SRTT_GAIN", 0], "srtt_ns"],
+                       "sample_ns"],
+                      ["ref", "SRTT_GAIN", 1]]]}}},
+    }, indent=2, sort_keys=True)
+    body = (f"def _g_srtt_update(srtt_ns, sample_ns):{def_suffix}\n"
+            "    return (sample_ns if (srtt_ns == 0) else "
+            f"((({coeff} * srtt_ns) + sample_ns) // 8))\n")
+    src = (begin_marker("tcp-logic", "#", sha12(spec_text), sha12(body))
+           + "\n" + body + end_marker("tcp-logic", "#") + "\n")
+    return {SPEC_RELPATH: spec_text, "shadow_tpu/fake/tcp.py": src}
+
+
+_LOGIC_MAP = {"tcp-logic": ["py:shadow_tpu/fake/tcp.py"]}
+
+
+def test_sim206_quiet_when_logic_matches_spec():
+    assert _twin(_logic_fixture(), _LOGIC_MAP) == []
+
+
+def test_sim206_fires_on_hand_drifted_logic():
+    # a hand edit flipped the SRTT gain 7 -> 6 INSIDE the fenced region
+    # (hashes recomputed, so this models a malicious/accidental edit that
+    # kept `make gen-check` green on the marker level) — the structural
+    # read-back still names the drifted node by path
+    out = _twin(_logic_fixture(coeff=6), _LOGIC_MAP)
+    assert _rules_of(out) == ["SIM206"]
+    (f,) = out
+    assert f.path == "shadow_tpu/fake/tcp.py"
+    assert f.line == 2                      # the def line, file-relative
+    assert "_g_srtt_update" in f.message and "drifted" in f.message
+    assert "at /select[2]/floordiv[0]/add[0]/mul[0]" in f.message
+    assert "spec has 7, plane has 6" in f.message
+    assert "spec/protocol_spec.json" in f.message   # the fix pointer
+
+
+def test_sim206_suppressible_with_reason():
+    out = _twin(_logic_fixture(
+        coeff=6, def_suffix="  # simtwin: disable=SIM206 -- fixture drift"),
+        _LOGIC_MAP)
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM206"]
+    assert out[0].reason == "fixture drift"
+
+
+def test_sim206_fires_on_convention_match_without_spec_fn():
+    # a hand-written `_g_*` function inside a generated region that the
+    # spec does not define is exactly the transcription-drift shape the
+    # rule exists for; note a `_g_`/`*_np` helper OUTSIDE a fenced region
+    # is never parsed (region-scoped read-back)
+    from shadow_tpu.analysis.genmark import sha12
+    srcs = _logic_fixture()
+    spec_hash = sha12(srcs["spec/protocol_spec.json"])
+    body = "def _g_bogus_rule(x):\n    return (x * 2)\n"
+    srcs["shadow_tpu/fake/tcp.py"] += (
+        f"# >>> simgen:begin region=extra spec={spec_hash} "
+        f"body={sha12(body)}\n" + body
+        + "# <<< simgen:end region=extra\n")
+    out = _twin(srcs, _LOGIC_MAP)
+    assert _rules_of(out) == ["SIM206"]
+    msgs = [f.message for f in out]
+    assert any("spec has no logic fn 'bogus_rule'" in m for m in msgs)
+
+
+def test_sim206_fires_on_unportable_body_and_missing_emission():
+    # body that is not a single portable-vocabulary expression -> named
+    # finding (not a crash); and a spec fn with no plane emission at all
+    # -> "run `make gen`" once the plane has ANY logic surface
+    from shadow_tpu.analysis.genmark import (SPEC_RELPATH, begin_marker,
+                                             end_marker, sha12)
+    srcs = _logic_fixture()
+    spec = json.loads(srcs[SPEC_RELPATH])
+    spec["logic"]["functions"]["rto_backoff"] = {
+        "args": ["rto_ns"], "expr": ["min", ["mul", "rto_ns", 2], 5]}
+    srcs[SPEC_RELPATH] = json.dumps(spec, indent=2, sort_keys=True)
+    body = ("def _g_srtt_update(srtt_ns, sample_ns):\n"
+            "    total = float(srtt_ns)\n"
+            "    return total\n")
+    srcs["shadow_tpu/fake/tcp.py"] = (
+        begin_marker("tcp-logic", "#", sha12(srcs[SPEC_RELPATH]),
+                     sha12(body))
+        + "\n" + body + end_marker("tcp-logic", "#") + "\n")
+    out = _twin(srcs, _LOGIC_MAP)
+    assert _rules_of(out) == ["SIM206"]
+    msgs = sorted(f.message for f in out)
+    assert len(msgs) == 2
+    assert any("not a single expression of the portable logic vocabulary"
+               in m for m in msgs)
+    assert any("no `_g_rto_backoff` on the py plane — run `make gen`"
+               in m for m in msgs)
+
+
+# ---------------------------------------------------------------------------
 # the deliberately drifted C/Python/kernel triple (ISSUE acceptance)
 
 
@@ -441,10 +550,18 @@ def test_spec_content_proves_extraction_is_alive():
     assert py_pairs == c_pairs
     assert len(spec["surfaces"]) >= 10
     # a surface mapping several symbols of ONE file keeps them all
-    # (CubicX is the simgen-generated spec-defined variant, ISSUE 11)
+    # (CubicX and BbrX are the simgen-generated spec-defined variants,
+    # ISSUE 11 / ISSUE 19)
     cong = spec["surfaces"]["congestion-control"]
     assert cong["py:shadow_tpu/descriptor/tcp_cong.py"] == [
-        "CongestionControl", "Cubic", "CubicX"]
+        "BbrX", "CongestionControl", "Cubic", "CubicX"]
+    # the logic surface (ISSUE 19): every spec-defined protocol-update
+    # expression reads back from the python plane into the emitted IR
+    logic = spec["logic"]
+    assert len(logic) >= 14
+    for name, fn in logic.items():
+        assert fn["args"] and fn["expr"] is not None, name
+        assert fn["source"].endswith(f"#_g_{name}"), (name, fn["source"])
     # symbol-anchored source attribution (ISSUE 11 satellite): no raw
     # line offsets anywhere in the spec — a generated region changing a
     # file's length can never churn this artifact
@@ -623,6 +740,54 @@ def test_cspec_multiline_constexpr_arrays_extract():
     assert ext.constants["TF"][0] == [13, 15, 26, 6, 17, 29, 16, 24]
 
 
+def test_cspec_logic_expr_casts_strip_to_the_portable_tree():
+    """Identity casts are vocabulary noise — every IR value is int64 by
+    contract, so ``(int64_t)`` disappears before parsing."""
+    from shadow_tpu.analysis.cspec import parse_c_expr
+    assert parse_c_expr("(int64_t)(a + 2)") == ["add", "a", 2]
+    assert parse_c_expr("((int64_t)a * (uint32_t)b)") == ["mul", "a", "b"]
+    assert parse_c_expr("(int64_t)1000LL") == 1000
+
+
+def test_cspec_logic_expr_nested_ternaries():
+    from shadow_tpu.analysis.cspec import CExprError, parse_c_expr
+    ir = parse_c_expr(
+        "(a == 0 ? b : (a < b ? (a + 1) : gen_i64_max(a, b)))")
+    assert ir == ["select", ["eq", "a", 0], "b",
+                  ["select", ["lt", "a", "b"], ["add", "a", 1],
+                   ["max", "a", "b"]]]
+    # a non-comparison condition is outside the portable vocabulary
+    try:
+        parse_c_expr("(a ? b : c)")
+        raise AssertionError("bare-name ternary condition parsed")
+    except CExprError:
+        pass
+
+
+def test_cspec_logic_fn_comment_split_expression():
+    """An expression split across lines by comments parses to the same
+    tree as the one-liner — comments are blanked before the regex."""
+    from shadow_tpu.analysis.cspec import parse_c_logic_functions
+    src = ("static inline int64_t gen_rto_backoff(int64_t rto_ns) {\n"
+           "  return gen_i64_min((rto_ns * 2),  /* exponential */\n"
+           "                     120000000000LL);  // RTO_MAX\n"
+           "}\n")
+    parsed = parse_c_logic_functions(src)
+    assert parsed["rto_backoff"] == (
+        ["rto_ns"], ["min", ["mul", "rto_ns", 2], 120000000000], 1)
+
+
+def test_cspec_logic_fn_unportable_body_is_none_not_a_crash():
+    from shadow_tpu.analysis.cspec import parse_c_logic_functions
+    src = ("static inline int64_t gen_x(int64_t a) { return a & 3; }\n"
+           "static inline int64_t gen_i64_min(int64_t a, int64_t b) {\n"
+           "  return a < b ? a : b;\n"
+           "}\n")
+    parsed = parse_c_logic_functions(src)
+    assert parsed["x"] == (["a"], None, 1)
+    assert "i64_min" not in parsed          # helper, not a logic fn
+
+
 def test_spec_sources_stable_when_a_region_grows():
     """ISSUE 11 satellite: SIM201/202 sources anchor to SYMBOLS, so a
     generated fenced region growing by 3 lines must leave the emitted
@@ -732,7 +897,8 @@ def test_cli_exit_codes(tmp_path):
          "--list-rules"],
         capture_output=True, text=True, cwd=REPO, timeout=120)
     assert rules.returncode == 0
-    for rid in ("SIM201", "SIM202", "SIM203", "SIM204", "SIM205"):
+    for rid in ("SIM201", "SIM202", "SIM203", "SIM204", "SIM205",
+                "SIM206"):
         assert rid in rules.stdout
 
 
