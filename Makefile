@@ -16,11 +16,13 @@ lint: gen-check
 	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu
 	$(PY) -m shadow_tpu.analysis.simrace shadow_tpu
 	$(PY) -m shadow_tpu.analysis.simtwin shadow_tpu native
+	$(PY) -m shadow_tpu.analysis.simjit shadow_tpu
 
 lint-diff:
 	$(PY) -m shadow_tpu.analysis.simlint shadow_tpu --diff $(BASE)
 	$(PY) -m shadow_tpu.analysis.simrace shadow_tpu --diff $(BASE)
 	$(PY) -m shadow_tpu.analysis.simtwin shadow_tpu native --diff $(BASE)
+	$(PY) -m shadow_tpu.analysis.simjit shadow_tpu --diff $(BASE)
 
 # ISSUE 11: spec/protocol_spec.json is AUTHORITATIVE.  `make gen`
 # materializes its surfaces into the fenced regions of all three planes
@@ -100,6 +102,9 @@ fault-smoke:
 # concurrent lanes over ONE shared vmapped device program — digest-gated
 # bit for bit, and fail-closed on a fleet that never fired a batched
 # launch.  `simfleet smoke` prints one JSON summary line, like bench.py.
+# Also the runtime half of the SIM305 compile-budget contract (ISSUE 20):
+# measured fleet.compiles / device_plane.sharded_variants are checked
+# against the [tool.simjit.budget] table, failing on drift either way.
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) -m shadow_tpu.fleet smoke --lanes 8 --seeds 8
 

@@ -1749,6 +1749,16 @@ def main() -> None:
                         os.path.join(_repo, "native")], _cfg,
                        load_map(None, _cfg))
     simtwin_sec = round(time.perf_counter() - _twin_t0, 3)
+    # simjit (ISSUE 20): the compile-surface pass — recompile hazards,
+    # hidden syncs, and the checked-in SIM305 compile budget; fail-closed
+    # like the other three (findings must stay 0)
+    from shadow_tpu.analysis.simjit import jit_paths, load_jit_config
+    _jcfg, _jbudget, _jkernel = load_jit_config(
+        os.path.join(_repo, "pyproject.toml"))
+    _jit_t0 = time.perf_counter()
+    _jit = jit_paths([os.path.join(_repo, "shadow_tpu")], _jcfg,
+                     budget=_jbudget, kernel=_jkernel)
+    simjit_sec = round(time.perf_counter() - _jit_t0, 3)
     # simgen (ISSUE 11): the spec-authoritative codegen gate — every
     # generated region current + hand-edit-free and the planes read back
     # to the authoritative spec's IR; plus the CUBIC payoff's runtime
@@ -1802,6 +1812,9 @@ def main() -> None:
         "simtwin_findings": len(_twin.unsuppressed),
         "simtwin_suppressed": len(_twin.suppressed),
         "simtwin_sec": simtwin_sec,
+        "simjit_findings": len(_jit.unsuppressed),
+        "simjit_suppressed": len(_jit.suppressed),
+        "simjit_sec": simjit_sec,
         "simgen_problems": len(_gen_diags),
         "simgen_surfaces": simgen_surfaces,
         "simgen_logic_surfaces": simgen_logic_surfaces,
@@ -1913,6 +1926,8 @@ def main() -> None:
         "simrace_sec": simrace_sec,
         "simtwin_findings": out["simtwin_findings"],
         "simtwin_sec": simtwin_sec,
+        "simjit_findings": out["simjit_findings"],
+        "simjit_sec": simjit_sec,
         # simgen spec-authoritative codegen gates (ISSUE 11/19): problems
         # must be 0, surfaces 5 (incl. the logic surface), and the
         # spec-defined CC families (cubicx, bbrx) must hold

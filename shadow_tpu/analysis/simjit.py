@@ -1,0 +1,340 @@
+"""simjit: whole-package compile-surface static analysis.
+
+Where simlint proves per-file determinism contracts and simrace proves
+package-wide concurrency contracts, simjit proves the COMPILE SURFACE:
+it parses every module, resolves every jit program identity
+(jit_rules.JitPackage — decorated defs, ``partial(jax.jit, ...)``
+wrappers, vmapped/shard_map-wrapped variants, factory functions,
+``self`` attribute handles, literal-capped variant caches) and runs the
+SIM3xx catalog over it:
+
+=======  ========  ====================================================
+SIM301   error     recompile hazard (unbucketed widths at a jit
+                   boundary, varying traced closures)
+SIM302   error     implicit host<->device sync inside the pipelined
+                   dispatch window
+SIM303   error     dtype-promotion drift against the non-negative
+                   int64 contract in kernel-tagged files
+SIM304   error     donation misuse (shared donated jit, donation on
+                   the CPU backend)
+SIM305   error     compile-key count drifted from the checked-in
+                   [tool.simjit.budget] table
+=======  ========  ====================================================
+
+Usage::
+
+    python -m shadow_tpu.analysis.simjit [paths...] [--json]
+        [--list-rules] [--config pyproject.toml] [--diff BASE]
+
+Exit status: 0 = no unsuppressed findings, 1 = findings, 2 = usage error.
+
+Everything else is shared with the family: the severity model, the
+``# simjit: disable=SIMxxx -- <why>`` pragma syntax (one pragma
+vocabulary across simlint/simrace/simtwin/simjit; each tool judges
+staleness only for the rules it RUNS), the per-rule path allowlists
+(``[tool.simjit.allow]``, unioned with ``[tool.simlint.allow]``), and
+the JSON schema (``"tool": "simjit"``).  ``--diff BASE`` still analyzes
+the WHOLE package (the model is cross-module — a second call site added
+in an untouched file completes a SIM304 pair) but reports only findings
+in files changed since the git ref.
+
+Two config sections are simjit's own:
+
+``[tool.simjit]`` — ``kernel = [globs]`` names the kernel-tagged files
+SIM303's int64-contract arithmetic checks run over (default: the ops/
+and mesh kernel planes).
+
+``[tool.simjit.budget]`` — the checked-in compile budget.  Quoted keys
+ending in ``.py`` are module paths whose statically enumerable compile-
+key count must EQUAL the declared value (SIM305 fails on either
+direction of drift).  Dotted non-module keys (``fleet.compiles``,
+``device_plane.sharded_variants``) budget the RUNTIME caches; simjit
+statically pins literal cache caps against them and ``simfleet smoke``
+cross-checks the measured counts via :func:`crosscheck_budget`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import jit_rules
+from .simlint import (Config, Finding, LintResult, ModuleContext,
+                      _toml_section, apply_pragmas, changed_py_files,
+                      iter_py_files, load_config)
+
+# SIM303's default kernel-tagged set: the device-kernel planes where the
+# non-negative int64 contract is load-bearing (overridden by
+# [tool.simjit] kernel = [...])
+DEFAULT_KERNEL = [
+    "shadow_tpu/ops/*.py",
+    "shadow_tpu/parallel/mesh/*.py",
+    "shadow_tpu/fleet/plane.py",
+]
+
+# quoted-key scalar lines inside [tool.simjit.budget]:  "path" = 3
+_BUDGET_LINE_RE = re.compile(r'^"((?:[^"\\]|\\.)+)"\s*=\s*(\d+)\s*(?:#.*)?$')
+
+
+def parse_budget(text: str) -> Dict[str, int]:
+    """The ``[tool.simjit.budget]`` table from a pyproject document.
+    The shared ``_toml_section`` helper only parses bare-identifier
+    array keys; budget keys are quoted paths mapping to integers, so
+    this dedicated scan handles exactly that shape."""
+    out: Dict[str, int] = {}
+    in_section = False
+    for raw in text.splitlines():
+        line = raw.strip()
+        if line.startswith("["):
+            in_section = line == "[tool.simjit.budget]"
+            continue
+        if not in_section or not line or line.startswith("#"):
+            continue
+        m = _BUDGET_LINE_RE.match(line)
+        if m:
+            out[m.group(1)] = int(m.group(2))
+    return out
+
+
+def load_jit_config(path: Optional[str], start: Optional[str] = None
+                    ) -> Tuple[Config, Dict[str, int], List[str]]:
+    """(shared Config with [tool.simjit.allow] unioned in, budget table,
+    kernel globs).  Missing file/sections degrade to the shared config,
+    an empty budget, and the default kernel set."""
+    config = load_config(path, start=start)
+    if path is None:
+        cand = os.path.join(config.root, "pyproject.toml")
+        path = cand if os.path.isfile(cand) else None
+    budget: Dict[str, int] = {}
+    kernel = list(DEFAULT_KERNEL)
+    if path is not None:
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            return config, budget, kernel
+        budget = parse_budget(text)
+        top = _toml_section(text, "tool.simjit")
+        if "kernel" in top:
+            kernel = top["kernel"]
+        for rule_id, pats in _toml_section(text,
+                                           "tool.simjit.allow").items():
+            config.allow.setdefault(rule_id.upper(), []).extend(pats)
+    return config, budget, kernel
+
+
+def default_rules() -> List[jit_rules.JitRule]:
+    return list(jit_rules.CATALOG)
+
+
+def active_ids(rules: Optional[List] = None) -> Set[str]:
+    return {r.id for r in (rules or default_rules())} | {"SIM000"}
+
+
+def jit_contexts(contexts: List[ModuleContext],
+                 config: Optional[Config] = None,
+                 rules: Optional[List] = None,
+                 budget: Optional[Dict[str, int]] = None,
+                 kernel: Optional[List[str]] = None) -> List[Finding]:
+    """Run the compile-surface passes over parsed modules and apply the
+    pragma / allowlist machinery — the core shared by the CLI and the
+    fixtures."""
+    config = config or Config()
+    rules = rules if rules is not None else default_rules()
+    pkg = jit_rules.JitPackage(contexts, config, budget=budget,
+                               kernel=kernel if kernel is not None
+                               else DEFAULT_KERNEL)
+    per_module: Dict[str, List[Finding]] = {c.relpath: [] for c in contexts}
+    loose: List[Finding] = []
+    for rule in rules:
+        for f in rule.run(pkg):
+            if config.is_allowed(f.rule, f.path):
+                continue
+            if f.path in per_module:
+                per_module[f.path].append(f)
+            else:
+                # findings anchored outside the parsed set (the stale-
+                # budget pyproject.toml anchor) can't carry pragmas
+                loose.append(f)
+    out: List[Finding] = list(loose)
+    ids = {r.id for r in rules} | {"SIM000"}
+    for ctx in contexts:
+        out.extend(apply_pragmas(ctx, per_module.get(ctx.relpath, []), ids))
+    return sorted(out, key=Finding.sort_key)
+
+
+def jit_sources(sources: Dict[str, str],
+                config: Optional[Config] = None,
+                rules: Optional[List] = None,
+                budget: Optional[Dict[str, int]] = None,
+                kernel: Optional[List[str]] = None) -> List[Finding]:
+    """Analyze in-memory modules ({relpath: source}) — the test-fixture
+    entry point (the package analog of simlint.lint_source)."""
+    contexts: List[ModuleContext] = []
+    bad: List[Finding] = []
+    for rel, src in sorted(sources.items()):
+        try:
+            contexts.append(ModuleContext(rel, src))
+        except SyntaxError as e:
+            bad.append(Finding("SIM000", "error", rel, e.lineno or 1,
+                               (e.offset or 1) - 1,
+                               f"file does not parse: {e.msg}"))
+    return sorted(jit_contexts(contexts, config, rules, budget, kernel)
+                  + bad, key=Finding.sort_key)
+
+
+def jit_paths(paths: List[str], config: Optional[Config] = None,
+              rules: Optional[List] = None,
+              only: Optional[Set[str]] = None,
+              budget: Optional[Dict[str, int]] = None,
+              kernel: Optional[List[str]] = None) -> LintResult:
+    """Analyze every .py under ``paths`` as one package.  ``only``
+    restricts REPORTING (not analysis — the model is cross-module) to
+    the given relpaths, the ``--diff BASE`` mode.  When ``budget`` /
+    ``kernel`` are None they are loaded from the nearest pyproject."""
+    if config is None or budget is None or kernel is None:
+        lc, lb, lk = load_jit_config(None,
+                                     start=paths[0] if paths else ".")
+        config = config if config is not None else lc
+        budget = budget if budget is not None else lb
+        kernel = kernel if kernel is not None else lk
+    files = iter_py_files(paths, config)
+    contexts: List[ModuleContext] = []
+    findings: List[Finding] = []
+    for abspath, rel in files:
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding("SIM000", "error", rel, 1, 0,
+                                    f"file is unreadable: {e}"))
+            continue
+        try:
+            contexts.append(ModuleContext(rel, source))
+        except SyntaxError as e:
+            findings.append(Finding("SIM000", "error", rel, e.lineno or 1,
+                                    (e.offset or 1) - 1,
+                                    f"file does not parse: {e.msg}"))
+    findings.extend(jit_contexts(contexts, config, rules, budget, kernel))
+    if only is not None:
+        findings = [f for f in findings if f.path in only]
+    findings.sort(key=Finding.sort_key)
+    return LintResult(findings, len(files), tool="simjit")
+
+
+# ---------------------------------------------------------------------------
+# the runtime half of the SIM305 cross-check (wired into `simfleet smoke`)
+
+
+def crosscheck_budget(measured: Dict[str, int],
+                      budget: Dict[str, int],
+                      require_nonzero: Tuple[str, ...] = ()) -> List[str]:
+    """Compare RUNTIME cache counts against the checked-in budget's
+    runtime keys (the dotted non-``.py`` entries) and fail on either
+    direction of drift: a measured count ABOVE its budget means the
+    compile surface grew without a conscious bump; a budgeted cache the
+    run never even reported means the budget went stale against a
+    dropped metric.  A measured ZERO is fine for mode-gated caches (the
+    sharded-variant cache only engages on the mesh path — its VALUE is
+    pinned statically by SIM305's literal-cap check) but fails for keys
+    in ``require_nonzero``, the caches the calling smoke is guaranteed
+    to exercise (``fleet.compiles``: the gate already demands launches,
+    and a launch without a first compile is impossible).  Returns
+    problem strings; empty = consistent."""
+    problems: List[str] = []
+    runtime = {k: v for k, v in sorted(budget.items())
+               if not k.endswith(".py")}
+    for key, declared in runtime.items():
+        got = measured.get(key)
+        if got is None:
+            problems.append(
+                f"budgeted runtime cache `{key}` (= {declared}) was not "
+                "measured — stale budget entry or dropped metric")
+        elif got > declared:
+            problems.append(
+                f"measured `{key}` = {got} exceeds its "
+                f"[tool.simjit.budget] = {declared} — the compile "
+                "surface grew; bump the budget consciously or fix the "
+                "recompile churn")
+        elif got == 0 and key in require_nonzero:
+            problems.append(
+                f"measured `{key}` = 0 against a budget of {declared} — "
+                "the budgeted cache never compiled in a run that must "
+                "exercise it (dead path or stale entry)")
+    for key in sorted(measured):
+        if "." in key and not key.endswith(".py") and key not in runtime:
+            problems.append(
+                f"runtime cache `{key}` = {measured[key]} has no "
+                "[tool.simjit.budget] entry — declare it so drift is "
+                "checkable")
+    return problems
+
+
+def load_runtime_budget(start: str = ".") -> Dict[str, int]:
+    """The runtime (non-module) budget entries from the nearest
+    pyproject — the `simfleet smoke` entry point."""
+    _cfg, budget, _kernel = load_jit_config(None, start=start)
+    return {k: v for k, v in budget.items() if not k.endswith(".py")}
+
+
+# ---------------------------------------------------------------------------
+# CLI
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="simjit",
+        description="compile-surface static analysis (shadow-tpu)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories (default: shadow_tpu/)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON on stdout")
+    ap.add_argument("--config", default=None,
+                    help="pyproject.toml carrying [tool.simjit] "
+                         "(default: nearest to the first path)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--diff", metavar="BASE", default=None,
+                    help="report only findings in .py files changed "
+                         "since git ref BASE (analysis stays package-"
+                         "wide)")
+    args = ap.parse_args(argv)
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.severity:<7}  {r.short}")
+        return 0
+    paths = args.paths or ["shadow_tpu"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"simjit: no such path: {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    config, budget, kernel = load_jit_config(args.config, start=paths[0])
+    only = None
+    if args.diff is not None:
+        try:
+            only = changed_py_files(args.diff, config.root)
+        except RuntimeError as e:
+            print(f"simjit: --diff {args.diff}: {e}", file=sys.stderr)
+            return 2
+    result = jit_paths(paths, config, rules, only=only, budget=budget,
+                       kernel=kernel)
+    if args.json:
+        json.dump(result.to_json(), sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        for f in result.unsuppressed:
+            print(f.render())
+        print(f"simjit: {len(result.unsuppressed)} finding(s), "
+              f"{len(result.suppressed)} suppressed, "
+              f"{result.files} file(s)")
+    return 1 if result.unsuppressed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

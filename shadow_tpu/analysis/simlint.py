@@ -222,26 +222,29 @@ class ModuleContext:
 # suppression pragmas
 
 # one pragma vocabulary for the whole analysis family: the introducer may
-# be spelled simlint:/simrace:/simtwin: (all equivalent), and the rule ids
-# scope ownership — each tool judges staleness only for rules it runs
+# be spelled simlint:/simrace:/simtwin:/simjit: (all equivalent), and the
+# rule ids scope ownership — each tool judges staleness only for rules it
+# runs
 PRAGMA_RE = re.compile(
-    r"#\s*sim(?:lint|race|twin):\s*disable=([A-Za-z0-9_,\s]*?)"
+    r"#\s*sim(?:lint|race|twin|jit):\s*disable=([A-Za-z0-9_,\s]*?)"
     r"\s*(?:--\s*(.*))?$")
 _KNOWN_RULES_CACHE: Optional[set] = None
 
 
 def known_rule_ids() -> set:
     """Every rule id any tool in this package owns: simlint's SIM00x
-    catalog, simrace's SIM1xx concurrency catalog, and simtwin's SIM2xx
-    cross-plane catalog.  Pragmas may name any of them; each TOOL only
-    judges staleness for the rules it RUNS (a ``disable=SIM103`` pragma
-    is invisible to simlint, not stale)."""
+    catalog, simrace's SIM1xx concurrency catalog, simtwin's SIM2xx
+    cross-plane catalog, and simjit's SIM3xx compile-surface catalog.
+    Pragmas may name any of them; each TOOL only judges staleness for
+    the rules it RUNS (a ``disable=SIM103`` pragma is invisible to
+    simlint, not stale)."""
     global _KNOWN_RULES_CACHE
     if _KNOWN_RULES_CACHE is None:
         ids = {r.id for r in default_rules()} | {"SIM000"}
-        from . import race_rules, twin_rules
+        from . import jit_rules, race_rules, twin_rules
         ids |= {r.id for r in race_rules.CATALOG}
         ids |= {r.id for r in twin_rules.CATALOG}
+        ids |= {r.id for r in jit_rules.CATALOG}
         _KNOWN_RULES_CACHE = ids
     return _KNOWN_RULES_CACHE
 

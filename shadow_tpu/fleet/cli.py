@@ -96,7 +96,32 @@ def cmd_smoke(args) -> int:
     if not launched:
         _say("no batched launches fired — the fleet plane was never "
              "exercised (gate fails closed)")
-    ok = matched and launched
+    # the runtime half of the SIM305 compile-budget contract: measured
+    # cache counts vs the checked-in [tool.simjit.budget] table, failing
+    # on either direction of drift (growth past the budget, or a
+    # budgeted metric the run no longer reports)
+    from ..analysis.simjit import crosscheck_budget, load_runtime_budget
+    from ..parallel.device_plane import DeviceTrafficPlane
+    budget = load_runtime_budget(os.getcwd())
+    measured = {
+        "fleet.compiles": int(stats.get("fleet.compiles", 0)),
+        "device_plane.sharded_variants":
+            int(DeviceTrafficPlane.sharded_variants_high_water),
+    }
+    if args.numpy:
+        # the numpy twin compiles nothing by design — the budget
+        # contract is about the jit path
+        budget_problems: List[str] = []
+    elif not budget:
+        _say("no [tool.simjit.budget] runtime entries found; "
+             "compile-budget cross-check skipped")
+        budget_problems = []
+    else:
+        budget_problems = crosscheck_budget(
+            measured, budget, require_nonzero=("fleet.compiles",))
+        for p in budget_problems:
+            _say(f"compile-budget drift: {p}")
+    ok = matched and launched and not budget_problems
     summary = {"simfleet": {
         "lanes": args.lanes,
         "scenarios": len(picks),
@@ -106,6 +131,8 @@ def cmd_smoke(args) -> int:
         "fleet_wall_sec": round(t2 - t1, 2),
         "numpy": bool(args.numpy),
         "rows": rows,
+        "budget_measured": measured,
+        "budget_problems": budget_problems,
         **stats},
         "pass": ok}
     if args.out:

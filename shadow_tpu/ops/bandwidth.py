@@ -150,4 +150,5 @@ class BandwidthKernel:
             jnp.asarray(np.asarray(tokens0, dtype=np.int64)),
             self.refill, self.capacity)
         self.device_calls += 1
+        # simjit: disable=SIM302 -- designed collect: admit() is a synchronous batch query (one launch, one read); no dispatch window exists here
         return np.asarray(admits)[:n][inv]

@@ -252,6 +252,13 @@ class DeviceTrafficPlane:
     """Owns the device-resident state for all registered bulk flows and the
     engine-side activation/wake bookkeeping."""
 
+    # process-wide high-water mark of the quiet-tick sharded-variant
+    # cache, reported by `simfleet smoke` against the checked-in
+    # [tool.simjit.budget] "device_plane.sharded_variants" entry (the
+    # runtime half of the SIM305 compile-budget cross-check; the static
+    # half pins the literal cap in _pick_sharded_step to the same value)
+    sharded_variants_high_water = 0
+
     def __init__(self, engine, specs: List[_FlowSpec], mode: str = "device"):
         if engine.shard_count > 1:
             raise RuntimeError(
@@ -912,6 +919,7 @@ class DeviceTrafficPlane:
             # (shape class, width) at the first launch — a per-lane
             # warmup would compile the UNBATCHED kernel nobody calls
             return
+        import jax
         import jax.numpy as jnp
         from ..ops.torcells_device import (RING_DTYPE,
                                            step_window_flush_for_backend)
@@ -931,7 +939,7 @@ class DeviceTrafficPlane:
                 lay["flow_node_local"], lay["succ_global"],
                 lay["seg_start_local"], lay["refill"], lay["capacity"],
                 lay["arr_lat"], lay["shard_base"])
-            np.asarray(out[9])
+            jax.block_until_ready(out)
             return
         f, h = self.n_flows, self.n_nodes
         z = np.zeros(f, dtype=np.int64)
@@ -945,7 +953,7 @@ class DeviceTrafficPlane:
             self.flow_node, self.flow_lat_steps, self.flow_succ,
             self.seg_start, self.refill_step, self.capacity_step,
             self.last_flow, ring_len=self.ring_len)
-        np.asarray(out[9])
+        jax.block_until_ready(out)
         if self._flush_caps is not None:
             # the tuned dispatch runs the CAPPED flush kernel — compile
             # it here too so the first timed dispatch pays no XLA wall
@@ -957,7 +965,7 @@ class DeviceTrafficPlane:
                 self.seg_start, self.refill_step, self.capacity_step,
                 self.last_flow, ring_len=self.ring_len,
                 cap_chains=cc, cap_nodes=hh)
-            np.asarray(out[9])
+            jax.block_until_ready(out)
 
     def _pad_targets(self, targets: List[int]) -> np.ndarray:
         """Pad a superwindow's boundary list to the static kernel shape by
@@ -1508,6 +1516,7 @@ class DeviceTrafficPlane:
         out = self._flush_step(*state, inject, inject_target, tvec, idle,
                                *self._flow_args(), ring_len=self.ring_len)
         self.device_calls += 1          # the recovery dispatch + read
+        # simjit: disable=SIM302 -- designed collect: overflow recovery exists to READ the complete flush; the window is already lost
         return np.asarray(out[9])
 
     def _pick_sharded_step(self):
@@ -1539,6 +1548,9 @@ class DeviceTrafficPlane:
             mask = tuple(bool(bits >> k & 1) for k in range(n_legs))
             step = self._mesh_make_step(mask)
             self._sharded_variants[bits] = step
+            DeviceTrafficPlane.sharded_variants_high_water = max(
+                DeviceTrafficPlane.sharded_variants_high_water,
+                len(self._sharded_variants))
         if self._meshinfo is not None:
             self._meshinfo.legs_active = bin(bits).count("1")
         return step

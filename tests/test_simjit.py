@@ -1,0 +1,772 @@
+"""simjit (shadow_tpu/analysis/simjit.py): the compile-surface
+static-analysis pass, ISSUE 20's tentpole.
+
+Fixture pairs (fire + suppress) for every SIM3xx rule over the
+package-wide jit-program model (decorated defs, partial(jax.jit, ...),
+factories, attr handles, literal-capped variant caches), the checked-in
+[tool.simjit.budget] audit in both drift directions, the runtime
+cross-check half (crosscheck_budget / load_runtime_budget, wired into
+`simfleet smoke`), the cross-tool pragma-ownership semantics (simlint /
+simrace ignore SIM3xx pragmas, simjit ignores SIM00x/SIM1xx pragmas —
+each tool judges staleness only for rules it runs), the ``--diff BASE``
+reporting filter over a still-package-wide analysis, the JSON schema
+and CLI — and THE GATE: simjit over all of shadow_tpu/ must report ZERO
+unsuppressed findings, so every recompile hazard, hidden sync, int64
+promotion, donation misuse and budget drift a future PR introduces
+fails with the file:line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from shadow_tpu.analysis.simlint import Config, lint_source
+from shadow_tpu.analysis.simrace import race_sources
+from shadow_tpu.analysis.simjit import (crosscheck_budget, jit_paths,
+                                        jit_sources, load_jit_config,
+                                        load_runtime_budget, parse_budget)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _jit(srcs, config: Config = None, budget=None, kernel=None):
+    if isinstance(srcs, str):
+        srcs = {"shadow_tpu/fake/mod.py": srcs}
+    return jit_sources({k: textwrap.dedent(v) for k, v in srcs.items()},
+                       config, budget=budget, kernel=kernel)
+
+
+def _rules_of(findings):
+    return sorted({f.rule for f in findings if not f.suppressed})
+
+
+# ---------------------------------------------------------------------------
+# SIM301 — recompile hazard
+
+
+_SIM301_FIXTURE = """
+    from functools import partial
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnums=(1,))
+    def run(x, width):
+        return x[:width]
+
+    def drive(batch):
+        return run(jnp.asarray(batch), len(batch)){P}
+"""
+
+
+def test_sim301_fires_on_unbucketed_static_width():
+    out = _jit(_SIM301_FIXTURE.replace("{P}", ""),
+               budget={"shadow_tpu/fake/mod.py": 1})
+    assert _rules_of(out) == ["SIM301"]
+    assert "one compilation per distinct value" in out[0].message
+
+
+def test_sim301_suppressible_with_reason():
+    out = _jit(_SIM301_FIXTURE.replace(
+        "{P}", "  # simjit: disable=SIM301 -- fixture justification"),
+        budget={"shadow_tpu/fake/mod.py": 1})
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM301"]
+
+
+def test_sim301_quiet_when_width_is_bucketed():
+    # the pad_state contract: a pad/pow2/bucket-named wrapper bounds the
+    # class set, so the width is no longer one-compile-per-value
+    out = _jit("""
+        from functools import partial
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnums=(1,))
+        def run(x, width):
+            return x[:width]
+
+        def pad_pow2(n):
+            return max(8, 1 << (n - 1).bit_length())
+
+        def drive(batch):
+            return run(jnp.asarray(batch), pad_pow2(len(batch)))
+    """, budget={"shadow_tpu/fake/mod.py": 1})
+    assert out == []
+
+
+def test_sim301_fires_on_global_mutated_traced_closure():
+    out = _jit("""
+        import jax
+
+        WIDTH = 8
+
+        def bump():
+            global WIDTH
+            WIDTH += 1
+
+        def body(x):
+            return x * WIDTH
+
+        step = jax.jit(body)
+    """, budget={"shadow_tpu/fake/mod.py": 1})
+    assert _rules_of(out) == ["SIM301"]
+    assert "closes over global `WIDTH`" in out[0].message
+
+
+def test_sim301_fires_on_loop_varying_closure():
+    # the traced def reads `width`, which the enclosing function rebinds
+    # per loop iteration AFTER tracing — one iteration's value is baked
+    out = _jit("""
+        import jax
+
+        def sweep(xs):
+            width = 0
+
+            def body(x):
+                return x * width
+
+            if len(xs) >= 4:
+                pass
+            step = jax.jit(body)
+            outs = []
+            for width in range(4):
+                outs.append(step(xs))
+            return outs
+    """, budget={"shadow_tpu/fake/mod.py": 4})
+    assert _rules_of(out) == ["SIM301"]
+    assert "rebinds per iteration" in out[0].message
+
+
+# ---------------------------------------------------------------------------
+# SIM302 — implicit host<->device sync in the dispatch window
+
+
+_SIM302_FIXTURE = """
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def step(x):
+        return x + 1
+
+    def drive(x):
+        out = step(x)
+        return np.asarray(out){P}
+"""
+
+
+def test_sim302_fires_on_asarray_of_live_result():
+    out = _jit(_SIM302_FIXTURE.replace("{P}", ""),
+               budget={"shadow_tpu/fake/mod.py": 1})
+    assert _rules_of(out) == ["SIM302"]
+    assert "pulls the buffer" in out[0].message
+
+
+def test_sim302_suppressible_with_reason():
+    out = _jit(_SIM302_FIXTURE.replace(
+        "{P}", "  # simjit: disable=SIM302 -- fixture justification"),
+        budget={"shadow_tpu/fake/mod.py": 1})
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM302"]
+
+
+def test_sim302_quiet_after_explicit_block_until_ready():
+    # an explicit sync point makes every later pull in the function a
+    # designed collect, not an implicit one (the phold_device idiom)
+    out = _jit("""
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def drive(x):
+            out = step(x)
+            jax.block_until_ready(out)
+            return np.asarray(out)
+    """, budget={"shadow_tpu/fake/mod.py": 1})
+    assert out == []
+
+
+def test_sim302_quiet_on_metadata_and_none_checks():
+    # len()/.shape/.dtype and `is None` read host metadata, not the
+    # buffer — no sync
+    out = _jit("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def drive(x):
+            out = step(x)
+            if out is None:
+                return 0
+            return out.shape[0] + out.ndim
+    """, budget={"shadow_tpu/fake/mod.py": 1})
+    assert out == []
+
+
+def test_sim302_fires_on_item_and_device_branch():
+    out = _jit("""
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def drive(x):
+            out = step(x)
+            if out > 0:
+                return 1
+            return out.item()
+    """, budget={"shadow_tpu/fake/mod.py": 1})
+    assert _rules_of(out) == ["SIM302"]
+    assert len([f for f in out if not f.suppressed]) == 2
+
+
+# ---------------------------------------------------------------------------
+# SIM303 — int64-contract promotion drift (kernel-tagged files only)
+
+
+_SIM303_FIXTURE = """
+    def halve(lat_ns):
+        return lat_ns / 2{P}
+"""
+
+
+def test_sim303_fires_on_true_division_of_time_lane():
+    out = _jit({"shadow_tpu/fake/kern.py":
+                _SIM303_FIXTURE.replace("{P}", "")},
+               kernel=["shadow_tpu/fake/*.py"])
+    assert _rules_of(out) == ["SIM303"]
+    assert "promotes the int64 ns value to float" in out[0].message
+
+
+def test_sim303_suppressible_with_reason():
+    out = _jit({"shadow_tpu/fake/kern.py": _SIM303_FIXTURE.replace(
+        "{P}", "  # simjit: disable=SIM303 -- fixture justification")},
+        kernel=["shadow_tpu/fake/*.py"])
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM303"]
+
+
+def test_sim303_scoped_to_kernel_tagged_files():
+    # identical source outside the [tool.simjit] kernel globs is quiet —
+    # host-side float math is not the contract's concern
+    out = _jit({"shadow_tpu/fake/kern.py":
+                _SIM303_FIXTURE.replace("{P}", "")},
+               kernel=["shadow_tpu/ops/*.py"])
+    assert out == []
+
+
+def test_sim303_fires_on_float_literal_and_cast_quiet_on_floordiv():
+    out = _jit({"shadow_tpu/fake/kern.py": """
+        import jax.numpy as jnp
+
+        def scale(delay_ns, arrive):
+            a = delay_ns * 0.5
+            b = arrive.astype(jnp.float32)
+            c = delay_ns // 2
+            return a, b, c
+    """}, kernel=["shadow_tpu/fake/*.py"])
+    assert _rules_of(out) == ["SIM303"]
+    assert len(out) == 2
+    assert "weak-type-promotes" in out[0].message
+    assert "lose integer exactness" in out[1].message
+
+
+# ---------------------------------------------------------------------------
+# SIM304 — donation misuse
+
+
+_SIM304_CPU_FIXTURE = """
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, donate_argnums=(0,), backend="cpu")
+    def f(x):{P}
+        return x + 1
+"""
+
+
+def test_sim304_fires_on_cpu_backend_donation():
+    out = _jit(_SIM304_CPU_FIXTURE.replace("{P}", ""),
+               budget={"shadow_tpu/fake/mod.py": 1})
+    assert _rules_of(out) == ["SIM304"]
+    assert "donates buffers on the CPU backend" in out[0].message
+
+
+def test_sim304_suppressible_with_reason():
+    out = _jit(_SIM304_CPU_FIXTURE.replace(
+        "{P}", "  # simjit: disable=SIM304 -- fixture justification"),
+        budget={"shadow_tpu/fake/mod.py": 1})
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM304"]
+
+
+def test_sim304_fires_on_shared_donated_program():
+    # two distinct enclosing functions calling ONE donated program alias
+    # each other's invalidated buffers — one finding per call site
+    out = _jit("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def f(x):
+            return x + 1
+
+        def one(x):
+            return f(x)
+
+        def two(x):
+            return f(x)
+    """, budget={"shadow_tpu/fake/mod.py": 1})
+    assert _rules_of(out) == ["SIM304"]
+    assert len(out) == 2
+    assert "multiple owners" in out[0].message
+
+
+def test_sim304_quiet_on_single_owner_donation():
+    out = _jit("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def f(x):
+            return x + 1
+
+        def one(x):
+            return f(f(x))
+    """, budget={"shadow_tpu/fake/mod.py": 1})
+    assert out == []
+
+
+# ---------------------------------------------------------------------------
+# SIM305 — compile-budget audit
+
+
+_SIM305_MODULE = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x + 1
+"""
+
+
+def test_sim305_fires_when_module_has_no_budget_entry():
+    out = _jit(_SIM305_MODULE, budget={})
+    assert _rules_of(out) == ["SIM305"]
+    assert "has no [tool.simjit.budget] entry" in out[0].message
+
+
+def test_sim305_quiet_when_budget_matches():
+    assert _jit(_SIM305_MODULE, budget={"shadow_tpu/fake/mod.py": 1}) == []
+
+
+def test_sim305_fires_on_drift_both_directions():
+    over = _jit(_SIM305_MODULE, budget={"shadow_tpu/fake/mod.py": 3})
+    assert _rules_of(over) == ["SIM305"]
+    assert "shrank below its budget" in over[0].message
+    grew = _jit("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x + 1
+
+        @jax.jit
+        def g(x):
+            return x - 1
+    """, budget={"shadow_tpu/fake/mod.py": 1})
+    assert _rules_of(grew) == ["SIM305"]
+    assert "grew past its budget" in grew[0].message
+
+
+def test_sim305_stale_entry_is_anchored_at_pyproject():
+    out = _jit(_SIM305_MODULE,
+               budget={"shadow_tpu/fake/mod.py": 1,
+                       "shadow_tpu/fake/gone.py": 2})
+    assert _rules_of(out) == ["SIM305"]
+    (f,) = out
+    assert f.path == "pyproject.toml"
+    assert "is stale" in f.message
+
+
+def test_sim305_unbounded_in_function_creation_always_fires():
+    # no literal cache bound around a function-scope jit creation: every
+    # call mints a fresh compiled program — a finding regardless of any
+    # budget entry
+    out = _jit("""
+        import jax
+
+        def make(scale):
+            step = jax.jit(lambda x: x * scale)
+            return step
+    """, budget={"shadow_tpu/fake/mod.py": 99})
+    assert "SIM305" in _rules_of(out)
+    assert any("no literal cache bound" in f.message for f in out)
+
+
+_SIM305_CAPPED_CACHE = """
+    import jax
+
+    class Plane:
+        def __init__(self):
+            self._variants = {}
+
+        def pick(self, bits, fn):
+            if bits not in self._variants:
+                if len(self._variants) >= 4:
+                    raise RuntimeError("cap")
+                step = jax.jit(fn)
+                self._variants[bits] = step
+            return self._variants[bits]
+"""
+
+
+def test_sim305_literal_cap_must_match_runtime_budget():
+    # the static half of the fleet-smoke cross-check: the literal cache
+    # cap in device_plane must equal `device_plane.sharded_variants`
+    rel = "shadow_tpu/parallel/device_plane.py"
+    bad = _jit({rel: _SIM305_CAPPED_CACHE},
+               budget={rel: 4, "device_plane.sharded_variants": 8})
+    assert _rules_of(bad) == ["SIM305"]
+    assert "variant-cache literal cap 4" in bad[0].message
+    ok = _jit({rel: _SIM305_CAPPED_CACHE},
+              budget={rel: 4, "device_plane.sharded_variants": 4})
+    assert ok == []
+
+
+# ---------------------------------------------------------------------------
+# the budget table: parsing + the runtime cross-check half
+
+
+def test_parse_budget_reads_quoted_keys_and_ignores_other_sections():
+    budget = parse_budget(textwrap.dedent("""
+        [tool.simjit]
+        kernel = ["shadow_tpu/ops/*.py"]
+
+        [tool.simjit.budget]
+        # a comment line
+        "shadow_tpu/ops/mod.py" = 3   # trailing comment
+        "fleet.compiles" = 64
+
+        [tool.other]
+        "shadow_tpu/ops/mod.py" = 99
+    """))
+    assert budget == {"shadow_tpu/ops/mod.py": 3, "fleet.compiles": 64}
+
+
+def test_load_runtime_budget_returns_only_dotted_entries():
+    runtime = load_runtime_budget(REPO)
+    assert runtime.get("fleet.compiles", 0) > 0
+    assert runtime.get("device_plane.sharded_variants", 0) > 0
+    assert not any(k.endswith(".py") for k in runtime)
+
+
+def test_crosscheck_budget_consistent_is_empty():
+    assert crosscheck_budget({"fleet.compiles": 3},
+                             {"fleet.compiles": 64,
+                              "shadow_tpu/ops/mod.py": 1}) == []
+
+
+def test_crosscheck_budget_fails_on_growth_past_budget():
+    (p,) = crosscheck_budget({"fleet.compiles": 65},
+                             {"fleet.compiles": 64})
+    assert "exceeds its" in p
+
+
+def test_crosscheck_budget_fails_on_unmeasured_budget_entry():
+    (p,) = crosscheck_budget({}, {"fleet.compiles": 64})
+    assert "was not measured" in p
+
+
+def test_crosscheck_budget_zero_semantics():
+    # a measured zero is fine for mode-gated caches, but fails for keys
+    # the calling smoke is guaranteed to exercise
+    assert crosscheck_budget({"device_plane.sharded_variants": 0},
+                             {"device_plane.sharded_variants": 4}) == []
+    (p,) = crosscheck_budget({"fleet.compiles": 0}, {"fleet.compiles": 64},
+                             require_nonzero=("fleet.compiles",))
+    assert "never compiled" in p
+
+
+def test_crosscheck_budget_fails_on_unbudgeted_runtime_key():
+    (p,) = crosscheck_budget({"fleet.compiles": 1, "new.cache": 2},
+                             {"fleet.compiles": 64})
+    assert "no [tool.simjit.budget] entry" in p
+
+
+# ---------------------------------------------------------------------------
+# cross-tool pragma ownership (one vocabulary, per-tool staleness)
+
+
+def test_simjit_pragma_invisible_to_simlint_and_simrace():
+    # a used SIM302 pragma: simjit consumes it; simlint/simrace neither
+    # honor it nor flag it stale (they don't run SIM3xx)
+    src = _SIM302_FIXTURE.replace(
+        "{P}", "  # simjit: disable=SIM302 -- fixture justification")
+    out = _jit(src, budget={"shadow_tpu/fake/mod.py": 1})
+    assert _rules_of(out) == []
+    assert [f.rule for f in out if f.suppressed] == ["SIM302"]
+    assert lint_source(textwrap.dedent(src)) == []
+    assert race_sources(
+        {"shadow_tpu/fake/mod.py": textwrap.dedent(src)}) == []
+
+
+def test_simlint_and_simrace_pragmas_invisible_to_simjit():
+    # reverse direction: SIM00x/SIM1xx pragmas on their own findings are
+    # owned by their tools — simjit reports neither stale nor suppressed
+    src = """
+        import time as _wt
+        import threading
+
+        def stall():
+            _wt.sleep(1.0)  # simlint: disable=SIM005 -- fault harness
+
+        class S:
+            def __init__(self):
+                self.alock = threading.Lock()
+                self.block = threading.Lock()
+
+            def one(self, conn):
+                with self.alock:
+                    return conn.recv()  # simlint: disable=SIM103 -- t
+    """
+    assert _jit(src) == []
+
+
+def test_stale_simjit_pragma_is_sim000():
+    out = _jit("""
+        x = 1  # simjit: disable=SIM301 -- nothing here anymore
+    """)
+    assert _rules_of(out) == ["SIM000"]
+    assert "matched no finding" in out[0].message
+    # ...and that staleness is invisible to simlint (SIM3xx not its rule)
+    assert lint_source(textwrap.dedent(
+        "x = 1  # simjit: disable=SIM301 -- nothing here\n")) == []
+
+
+def test_unknown_rule_pragma_flagged():
+    out = _jit("""
+        x = 1  # simjit: disable=SIM999 -- no such rule
+    """)
+    assert _rules_of(out) == ["SIM000"]
+
+
+# ---------------------------------------------------------------------------
+# allowlists
+
+
+def test_allowlist_exempts_by_rule_and_path():
+    cfg = Config(allow={"SIM302": ["shadow_tpu/prof/*"]})
+    src = _SIM302_FIXTURE.replace("{P}", "")
+    assert _jit({"shadow_tpu/prof/probe.py": src}, cfg,
+                budget={"shadow_tpu/prof/probe.py": 1}) == []
+    assert _rules_of(_jit({"shadow_tpu/core/hot.py": src}, cfg,
+                          budget={"shadow_tpu/core/hot.py": 1})) \
+        == ["SIM302"]
+
+
+def test_repo_config_unions_simjit_allow_section():
+    cfg, budget, kernel = load_jit_config(
+        os.path.join(REPO, "pyproject.toml"))
+    assert "shadow_tpu/prof/*" in cfg.allow.get("SIM302", [])
+    assert budget.get("fleet.compiles", 0) > 0
+    assert any(g.endswith("ops/*.py") for g in kernel)
+
+
+def test_unparsable_file_is_a_finding_not_a_crash():
+    out = jit_sources({"shadow_tpu/bad.py": "def f(:\n"})
+    assert [f.rule for f in out] == ["SIM000"]
+    assert "parse" in out[0].message
+
+
+# ---------------------------------------------------------------------------
+# --diff: reporting filters to changed files, analysis stays package-wide
+
+
+def _git(cwd, *args):
+    return subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t"] + list(args),
+        cwd=cwd, capture_output=True, text=True, timeout=60)
+
+
+def test_diff_mode_reports_only_changed_files_but_analyzes_package(
+        tmp_path):
+    # the SIM304 pair spans two modules: a.py owns the donated program
+    # and one call site, b.py adds the second owner.  With only b.py
+    # changed, the cross-module finding still COMPLETES (analysis is
+    # package-wide) but only b.py's half is reported.
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "a.py").write_text(textwrap.dedent("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def f(x):
+            return x + 1
+
+        def one(x):
+            return f(x)
+    """))
+    (pkg / "b.py").write_text("y = 1\n")
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.simjit.budget]
+        "pkg/a.py" = 1
+    """))
+    assert _git(tmp_path, "init", "-q").returncode == 0
+    assert _git(tmp_path, "add", "-A").returncode == 0
+    assert _git(tmp_path, "commit", "-qm", "base").returncode == 0
+    (pkg / "b.py").write_text(textwrap.dedent("""
+        from a import f
+
+        def two(x):
+            return f(x)
+    """))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    full = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simjit",
+         str(pkg), "--json", "--config", str(tmp_path / "pyproject.toml")],
+        capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+    doc = json.loads(full.stdout)
+    assert doc["summary"]["by_rule"] == {"SIM304": 2}
+    assert sorted(f["path"] for f in doc["findings"]) \
+        == ["pkg/a.py", "pkg/b.py"]
+    diffed = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simjit",
+         str(pkg), "--json", "--diff", "HEAD",
+         "--config", str(tmp_path / "pyproject.toml")],
+        capture_output=True, text=True, cwd=tmp_path, env=env, timeout=120)
+    doc = json.loads(diffed.stdout)
+    assert doc["summary"]["by_rule"] == {"SIM304": 1}
+    (f,) = doc["findings"]
+    assert f["path"] == "pkg/b.py"
+
+
+def test_diff_mode_bad_ref_is_usage_error():
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simjit",
+         "shadow_tpu", "--diff", "no-such-ref-xyz"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert run.returncode == 2
+    assert "--diff" in run.stderr
+
+
+def test_make_lint_target_runs_simjit():
+    with open(os.path.join(REPO, "Makefile"), encoding="utf-8") as f:
+        text = f.read()
+    assert "simjit" in text and "lint:" in text
+
+
+# ---------------------------------------------------------------------------
+# JSON schema + CLI round trip
+
+
+def test_json_schema_and_cli_roundtrip(tmp_path):
+    (tmp_path / "pyproject.toml").write_text(textwrap.dedent("""
+        [tool.simjit.budget]
+        "mod.py" = 1
+    """))
+    mod = tmp_path / "mod.py"
+    mod.write_text(textwrap.dedent("""
+        import numpy as np
+        import jax
+
+        @jax.jit
+        def step(x):
+            return x + 1
+
+        def ok(x):
+            out = step(x)
+            return np.asarray(out)  # simjit: disable=SIM302 -- t
+
+        def bad(x):
+            out = step(x)
+            return out.item()
+    """))
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simjit",
+         str(mod), "--json", "--config", str(tmp_path / "pyproject.toml")],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert run.returncode == 1, run.stderr
+    doc = json.loads(run.stdout)
+    assert doc["version"] == 1 and doc["tool"] == "simjit"
+    assert doc["files"] == 1
+    assert doc["summary"]["findings"] == 1
+    assert doc["summary"]["suppressed"] == 1
+    assert doc["summary"]["by_rule"] == {"SIM302": 1}
+    (f,) = doc["findings"]
+    assert set(f) == {"rule", "severity", "path", "line", "col", "message"}
+    assert f["rule"] == "SIM302" and f["severity"] == "error"
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    ok = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simjit", str(clean)],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert ok.returncode == 0
+    missing = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simjit",
+         str(tmp_path / "nope.py")],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert missing.returncode == 2
+    rules = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simjit",
+         "--list-rules"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert rules.returncode == 0
+    for rid in ("SIM301", "SIM302", "SIM303", "SIM304", "SIM305"):
+        assert rid in rules.stdout
+
+
+# ---------------------------------------------------------------------------
+# THE GATE: zero unsuppressed findings over the whole package
+
+
+def test_gate_zero_findings_over_shadow_tpu():
+    """Every compile-surface violation in shadow_tpu/ is fixed, budgeted
+    or justified.
+
+    A future PR that adds an unbucketed width at a jit boundary, pulls a
+    live jit result mid-window, float-promotes a ns lane in a kernel
+    file, shares a donated program, or mints a jit identity without
+    bumping [tool.simjit.budget] fails HERE with the file:line — the
+    only ways out are to fix it, budget it consciously, or justify it
+    with a reasoned `# simjit: disable=<RULE> -- <why>` pragma."""
+    cfg, budget, kernel = load_jit_config(
+        os.path.join(REPO, "pyproject.toml"))
+    result = jit_paths([os.path.join(REPO, "shadow_tpu")], cfg,
+                       budget=budget, kernel=kernel)
+    assert result.files > 50, "package discovery looks broken"
+    pretty = "\n".join(f.render() for f in result.unsuppressed)
+    assert not result.unsuppressed, (
+        f"simjit found unsuppressed violations:\n{pretty}\n"
+        "fix them, budget them, or justify with "
+        "`# simjit: disable=<RULE> -- <why>`")
+    for f in result.suppressed:
+        assert f.reason, f"reasonless suppression survived: {f.render()}"
+
+
+def test_gate_cli_matches_api():
+    run = subprocess.run(
+        [sys.executable, "-m", "shadow_tpu.analysis.simjit",
+         "shadow_tpu", "--json"],
+        capture_output=True, text=True, cwd=REPO, timeout=300)
+    assert run.returncode == 0, run.stdout + run.stderr
+    doc = json.loads(run.stdout)
+    assert doc["findings"] == []
